@@ -1,0 +1,35 @@
+"""coinstac_dinunet_tpu — TPU-native federated deep-learning framework.
+
+A brand-new JAX/XLA/pjit/Pallas re-design with the capabilities of
+``trendscenter/coinstac-dinunet`` (see SURVEY.md): federated site/aggregator
+training with pluggable gradient-aggregation engines (dSGD, PowerSGD, rankDAD),
+k-fold orchestration, cross-site metric reduction, checkpointing, early
+stopping, and training-curve logging.  Two transports share one set of
+compiled kernels:
+
+- **mesh transport** — simulated sites are ranks on a ``jax.sharding.Mesh``;
+  the gradient plane lowers to XLA collectives over ICI/DCN.
+- **engine transport** — the reference-compatible file+JSON protocol driven by
+  an external engine (or the bundled in-process simulator).
+"""
+__version__ = "0.1.0"
+
+from .config import keys  # noqa: F401
+from .data import COINNDataHandle, COINNDataset  # noqa: F401
+from .metrics import (  # noqa: F401
+    AUCROCMetrics,
+    COINNAverages,
+    COINNMetrics,
+    ConfusionMatrix,
+    Prf1a,
+)
+
+__all__ = [
+    "COINNDataset",
+    "COINNDataHandle",
+    "COINNMetrics",
+    "COINNAverages",
+    "Prf1a",
+    "ConfusionMatrix",
+    "AUCROCMetrics",
+]

@@ -1,0 +1,67 @@
+"""Framework-wide constants and wire-format knobs.
+
+Capability parity with the reference's ``coinstac_dinunet/config/__init__.py:5-30``
+(grads filenames, metric precision/eps, score delta, accelerator detection,
+per-process seed, ``boolean_string``), re-thought for a JAX/TPU runtime:
+accelerator detection asks the XLA backend instead of CUDA, and the wire dtype
+is expressed as a numpy/jnp dtype selected by ``precision_bits``.
+"""
+import os
+import random
+
+import numpy as np
+
+from .keys import AggEngine, GatherMode, Key, Mode, Phase  # noqa: F401 (re-export)
+
+# ---- wire filenames (file/engine transport) --------------------------------
+grads_file = "grads.npy"
+avg_grads_file = "avg_grads.npy"
+weights_file = "weights.ckpt"
+dad_data_file = "dad_data.npy"
+powersgd_P_file = "powerSGD_P.npy"
+powersgd_Q_file = "powerSGD_Q.npy"
+
+# ---- numeric behavior ------------------------------------------------------
+metrics_eps = 1e-5  # epsilon guarding divide-by-zero in metric ratios
+metrics_num_precision = 5  # decimal places for reported scores
+score_delta = 0.0  # minimum improvement to count as "better"
+
+# default floating point width of tensors on the wire; 32 or 16
+default_precision_bits = 32
+
+
+def wire_dtype(precision_bits=None):
+    """numpy dtype used to serialize gradients/activations for transport."""
+    bits = int(precision_bits or default_precision_bits)
+    return {16: np.float16, 32: np.float32, 64: np.float64}[bits]
+
+
+# ---- accelerator detection -------------------------------------------------
+def backend():
+    """Resolved JAX backend name ('tpu' | 'gpu' | 'cpu')."""
+    import jax
+
+    return jax.default_backend()
+
+
+def num_devices():
+    import jax
+
+    return jax.device_count()
+
+
+def accelerator_available():
+    return backend() != "cpu"
+
+
+# ---- per-process seed (≙ config/__init__.py:23 current_seed) ---------------
+current_seed = int(os.environ.get("COINN_SEED", random.randint(0, 2**16)))
+
+
+def boolean_string(s):
+    """Parse a string flag into a bool; accepts true/false in any case."""
+    if isinstance(s, bool):
+        return s
+    if str(s).lower() not in ("true", "false"):
+        raise ValueError(f"Not a valid boolean string: {s!r}")
+    return str(s).lower() == "true"

@@ -1,0 +1,67 @@
+"""Protocol vocabulary for the federated control plane.
+
+The phase/mode strings below ARE the wire protocol between site nodes and the
+aggregator: every control decision (epoch barriers, validation cadence, fold
+transitions) is communicated as one of these values inside the JSON ``output``
+dict a node returns.  Capability parity with the reference enums at
+``coinstac_dinunet/config/keys.py:4-49`` (Phase/Mode/Key/AGG_Engine/GatherMode);
+this is a fresh TPU-first design — the same vocabulary drives both the
+file+JSON engine transport and the on-pod mesh transport.
+"""
+from enum import Enum
+
+
+class _StrEnum(str, Enum):
+    def __str__(self) -> str:  # plays nicely inside JSON payloads
+        return str(self.value)
+
+
+class Phase(_StrEnum):
+    """Run-level lifecycle of a node (coarse state machine)."""
+    INIT_RUNS = "init_runs"
+    NEXT_RUN = "next_run"
+    PRE_COMPUTATION = "pre_computation"
+    COMPUTATION = "computation"
+    NEXT_RUN_WAITING = "next_run_waiting"
+    SUCCESS = "success"
+
+
+class Mode(_StrEnum):
+    """Within-COMPUTATION activity of a site (fine state machine).
+
+    The ``*_WAITING`` modes are the epoch/validation barrier signals: a site
+    that exhausts its batch cursor flips to VALIDATION_WAITING; the aggregator
+    releases all sites at once when every site is waiting.
+    """
+    PRE_TRAIN = "pre_train"
+    TRAIN = "train"
+    VALIDATION = "validation"
+    TEST = "test"
+    VALIDATION_WAITING = "validation_waiting"
+    TRAIN_WAITING = "train_waiting"
+
+
+class Key(_StrEnum):
+    """Well-known cache / wire dictionary keys."""
+    TRAIN_SERIALIZABLE = "train_serializable"
+    VALIDATION_SERIALIZABLE = "validation_serializable"
+    TEST_SERIALIZABLE = "test_serializable"
+    TRAIN_LOG = "train_log"
+    VALIDATION_LOG = "validation_log"
+    TEST_METRICS = "test_metrics"
+    GLOBAL_TEST_SERIALIZABLE = "global_test_serializable"
+    ARGS_CACHED = "args_cached"
+    DATA_CURSOR = "data_cursor"
+
+
+class AggEngine(_StrEnum):
+    """Built-in gradient-aggregation engines (≙ AGG_Engine dSGD/powerSGD/rankDAD)."""
+    DSGD = "dSGD"
+    POWER_SGD = "powerSGD"
+    RANK_DAD = "rankDAD"
+
+
+class GatherMode(_StrEnum):
+    """How the aggregator merges a key across sites."""
+    APPEND = "append"
+    EXTEND = "extend"

@@ -1,0 +1,25 @@
+from .data import (
+    COINNDataHandle,
+    COINNDataLoader,
+    COINNDataset,
+    EmptyDataHandle,
+    safe_collate,
+)
+from .datautils import (
+    create_k_fold_splits,
+    create_ratio_split,
+    init_k_folds,
+    split_place_holder,
+)
+
+__all__ = [
+    "COINNDataset",
+    "COINNDataHandle",
+    "COINNDataLoader",
+    "EmptyDataHandle",
+    "safe_collate",
+    "create_k_fold_splits",
+    "create_ratio_split",
+    "split_place_holder",
+    "init_k_folds",
+]

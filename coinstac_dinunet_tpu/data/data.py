@@ -1,0 +1,269 @@
+"""Data layer: user-subclassable dataset, static-shape batch loader, handle.
+
+Capability parity with the reference ``data/data.py:23-242`` (COINNDataset,
+safe_collate, COINNDataHandle with cursor-based ``next_iter``,
+COINNPaddedDataSampler), re-designed for XLA:
+
+- No torch DataLoader.  Batches are numpy dict-of-arrays with **static
+  shapes**: the tail batch is padded to full ``batch_size`` and carries a
+  ``_mask`` vector (1=real, 0=padding) — under jit, padding+masking replaces
+  the reference's padded sampler, and every site can be padded to the same
+  number of batches for lockstep federated epochs (ref ``data/data.py:203-242``).
+- The loader is deterministic given (seed, epoch) so federated sites shuffle
+  reproducibly, and its cursor is a plain int that survives across engine
+  invocations in the node cache (ref ``next_iter`` ``data/data.py:175-191``).
+"""
+import math
+import os
+
+import numpy as np
+
+from ..config.keys import Mode
+from . import datautils
+
+
+def safe_collate(samples):
+    """Stack a list of sample dicts into a batch dict, dropping failed (None)
+    samples (ref ``data/data.py:23-27``)."""
+    samples = [s for s in samples if s is not None]
+    if not samples:
+        return None
+    keys = samples[0].keys()
+    return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in keys}
+
+
+class COINNDataset:
+    """User-subclassable dataset.
+
+    Users implement ``load_index(dataset_name, file)`` — inspect one input
+    file and append one or more index entries via ``self.indices.append(...)``
+    — and ``__getitem__(ix) -> dict`` returning numpy arrays (e.g.
+    ``{'inputs': x, 'labels': y}``).
+    """
+
+    def __init__(self, mode=Mode.TRAIN, limit=None, **kw):
+        self.mode = mode
+        self.limit = limit or float("inf")
+        self.indices = []
+        self.state = {}
+        self.cache = {}
+        self.data_conf = {}
+
+    # ---- user hooks ------------------------------------------------------
+    def load_index(self, dataset_name, file):
+        self.indices.append([dataset_name, file])
+
+    def __getitem__(self, ix):
+        raise NotImplementedError
+
+    # ---- framework API ---------------------------------------------------
+    def __len__(self):
+        return len(self.indices)
+
+    def path(self, dataset_name=None, cache_key="data_dir"):
+        """Resolve a data path from the engine ``state`` + cached conf."""
+        base = self.state.get(dataset_name, self.state).get("baseDirectory", ".") \
+            if isinstance(self.state.get(dataset_name), dict) else self.state.get("baseDirectory", ".")
+        sub = self.data_conf.get(cache_key, self.cache.get(cache_key, ""))
+        return os.path.join(base, sub) if sub else base
+
+    def add(self, files, cache=None, state=None, data_conf=None, dataset_name="site"):
+        self.cache = cache or self.cache
+        self.state = state or self.state
+        self.data_conf = data_conf or self.data_conf
+        for f in files:
+            if len(self.indices) >= self.limit:
+                break
+            self.load_index(dataset_name, f)
+
+
+class COINNDataLoader:
+    """Deterministic static-shape batch iterator.
+
+    Pads the tail batch (and optionally the whole epoch up to
+    ``target_batches``, wrapping indices like the reference's padded sampler)
+    and marks padded entries with ``_mask=0`` so metrics/losses ignore them.
+    """
+
+    def __init__(self, dataset, batch_size=16, shuffle=False, seed=0, epoch=0,
+                 drop_last=False, target_batches=None):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = int(seed)
+        self.epoch = int(epoch)
+        self.drop_last = drop_last
+        n = len(dataset)
+        if drop_last:
+            self.num_batches = n // self.batch_size
+        else:
+            self.num_batches = math.ceil(n / self.batch_size)
+        if target_batches is not None:
+            self.num_batches = max(self.num_batches, int(target_batches))
+        self._order = self._make_order()
+
+    def _make_order(self):
+        n = len(self.dataset)
+        idx = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(idx)
+        total = self.num_batches * self.batch_size
+        if total <= n:
+            order = idx[:total]
+            mask = np.ones(total, dtype=np.float32)
+        else:
+            # wrap-pad: repeat indices to fill equal-length epochs; padding
+            # beyond the real n carries mask 0 (metrics-exact lockstep)
+            reps = math.ceil(total / max(n, 1)) if n else 0
+            order = np.tile(idx, reps)[:total] if n else np.zeros(total, dtype=int)
+            mask = np.zeros(total, dtype=np.float32)
+            mask[:n] = 1.0
+        return order, mask
+
+    def __len__(self):
+        return self.num_batches
+
+    def __iter__(self):
+        order, mask = self._order
+        for b in range(self.num_batches):
+            sl = slice(b * self.batch_size, (b + 1) * self.batch_size)
+            batch_ix, batch_mask = order[sl], mask[sl]
+            samples = [self.dataset[int(i)] for i in batch_ix]
+            keep = np.array([s is not None for s in samples])
+            batch = safe_collate(samples)
+            if batch is None:
+                continue
+            if not keep.all():
+                batch_mask = batch_mask[keep]
+            batch["_mask"] = batch_mask.astype(np.float32)
+            yield batch
+
+    def batch_at(self, cursor):
+        """Random access for cursor-based streaming (``next_iter``)."""
+        order, mask = self._order
+        sl = slice(cursor * self.batch_size, (cursor + 1) * self.batch_size)
+        samples = [self.dataset[int(i)] for i in order[sl]]
+        keep = np.array([s is not None for s in samples])
+        batch = safe_collate(samples)
+        if batch is not None:
+            batch_mask = mask[sl]
+            if not keep.all():
+                batch_mask = batch_mask[keep]
+            batch["_mask"] = batch_mask.astype(np.float32)
+        return batch
+
+
+class COINNDataHandle:
+    """Owns per-mode datasets built from the current fold's split JSON and the
+    loader configuration; provides cursor-based batch streaming that survives
+    across engine invocations (ref ``data/data.py:84-200``)."""
+
+    def __init__(self, cache=None, input=None, state=None, dataloader_args=None,
+                 dataset_cls=COINNDataset):
+        self.cache = cache if cache is not None else {}
+        self.input = input if input is not None else {}
+        self.state = state if state is not None else {}
+        self.dataloader_args = dataloader_args or {}
+        self.dataset_cls = dataset_cls
+        self.datasets = {}
+
+    # ---- split / dataset construction -----------------------------------
+    def list_files(self):
+        data_dir = os.path.join(
+            self.state.get("baseDirectory", "."),
+            self.cache.get("data_dir", self.cache.get("task_id", "")),
+        )
+        if not os.path.isdir(data_dir):
+            data_dir = self.state.get("baseDirectory", ".")
+        return sorted(os.listdir(data_dir))
+
+    def prepare_data(self):
+        """k-fold init (ref ``init_k_folds`` precedence)."""
+        files = self.list_files()
+        return datautils.init_k_folds(files, self.cache, self.state,
+                                      self.cache.get("data_conf", {}))
+
+    def get_split(self):
+        import json
+
+        split_file = self.cache["splits"][str(self.cache.get("split_ix", 0))]
+        with open(os.path.join(self.cache["split_dir"], split_file)) as f:
+            return json.load(f)
+
+    def get_dataset(self, handle_key, files, mode=None):
+        ds = self.dataset_cls(mode=mode or handle_key, limit=self.cache.get("load_limit"))
+        ds.add(files, cache=self.cache, state=self.state,
+               data_conf=self.cache.get("data_conf", {}))
+        self.datasets[handle_key] = ds
+        return ds
+
+    def get_train_dataset(self):
+        return self.get_dataset("train", self.get_split().get("train", []), Mode.TRAIN)
+
+    def get_validation_dataset(self):
+        return self.get_dataset("validation", self.get_split().get("validation", []), Mode.VALIDATION)
+
+    def get_test_dataset(self, load_sparse=False):
+        files = self.get_split().get("test", [])
+        if load_sparse and files:
+            # one dataset per file — lets save_predictions work per-subject
+            out = []
+            for i, f in enumerate(files):
+                ds = self.dataset_cls(mode=Mode.TEST, limit=self.cache.get("load_limit"))
+                ds.add([f], cache=self.cache, state=self.state,
+                       data_conf=self.cache.get("data_conf", {}))
+                out.append(ds)
+            self.datasets["test"] = out
+            return out
+        return self.get_dataset("test", files, Mode.TEST)
+
+    # ---- loaders ---------------------------------------------------------
+    def get_loader(self, handle_key="train", dataset=None, **kw):
+        """Merge precedence: call kwargs > per-key cached args > global args."""
+        args = dict(self.dataloader_args.get(handle_key, {}))
+        for k in ("batch_size", "seed"):
+            if k in self.cache and k not in args:
+                args[k] = self.cache[k]
+        args.update(kw)
+        args.setdefault("batch_size", 16)
+        ds = dataset or self.datasets.get(handle_key)
+        return COINNDataLoader(ds, **args)
+
+    # ---- cursor-based streaming (engine transport) -----------------------
+    def next_iter(self, out=None):
+        """Return the next training batch; on epoch exhaustion reset the
+        cursor and signal VALIDATION_WAITING (the epoch barrier)."""
+        out = out if out is not None else {}
+        cursor = int(self.cache.get("cursor", 0))
+        if "train" not in self.datasets:
+            self.get_train_dataset()
+        loader = self.get_loader(
+            "train",
+            shuffle=True,
+            seed=int(self.cache.get("seed", 0)),
+            epoch=int(self.cache.get("epoch", 0)),
+            target_batches=self.cache.get("target_batches"),
+        )
+        # skip over batches where every sample failed to load (batch_at → None)
+        batch = None
+        while cursor < len(loader) and batch is None:
+            batch = loader.batch_at(cursor)
+            cursor += 1
+        if batch is None:
+            self.cache["cursor"] = 0
+            out["mode"] = Mode.VALIDATION_WAITING.value
+            return None, out
+        self.cache["cursor"] = cursor
+        out["mode"] = self.cache.get("mode", Mode.TRAIN.value)
+        return batch, out
+
+
+class EmptyDataHandle(COINNDataHandle):
+    """The aggregator holds no data (ref ``remote.py:22-26``)."""
+
+    def list_files(self):
+        return []
+
+    def prepare_data(self):
+        return {}

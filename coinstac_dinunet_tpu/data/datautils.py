@@ -1,0 +1,110 @@
+"""Dataset split generation: ratio splits, rotating k-fold, precedence rules.
+
+Capability parity with the reference ``data/datautils.py:11-98``
+(create_ratio_split, create_k_fold_splits with rotating val/test folds,
+split_place_holder, init_k_folds precedence: pre-supplied splits dir >
+split_files > num_folds > split_ratio > placeholder).
+"""
+import json
+import os
+import shutil
+
+import numpy as np
+
+
+def create_ratio_split(files, save_to_dir=None, ratio=(0.6, 0.2, 0.2), first_key="train", name="SPLIT", seed=None):
+    """Single split by ratio.  Keys ordered train/validation/test (or starting
+    at ``first_key``); a 2-tuple ratio yields train/validation only."""
+    keys = ["train", "validation", "test"]
+    keys = keys[keys.index(first_key):]
+    files = list(files)
+    rng = np.random.default_rng(len(files) if seed is None else seed)
+    rng.shuffle(files)
+    n = len(files)
+    sizes = [int(round(r * n)) for r in ratio]
+    sizes[0] = n - sum(sizes[1:])  # absorb rounding into train
+    split, off = {}, 0
+    for key, sz in zip(keys, sizes):
+        split[key] = files[off : off + sz]
+        off += sz
+    if save_to_dir:
+        os.makedirs(save_to_dir, exist_ok=True)
+        with open(os.path.join(save_to_dir, f"{name}.json"), "w") as f:
+            json.dump(split, f, indent=2)
+    return split
+
+
+def create_k_fold_splits(files, k, save_to_dir=None, shuffle_files=True, name="SPLIT", seed=None):
+    """K rotating splits: split i uses fold i as test, fold i+1 (mod k) as
+    validation, the rest as train — every sample is tested exactly once."""
+    files = list(files)
+    if shuffle_files:
+        rng = np.random.default_rng(len(files) if seed is None else seed)
+        rng.shuffle(files)
+    folds = [list(part) for part in np.array_split(np.asarray(files, dtype=object), k)]
+    splits = []
+    for i in range(k):
+        test = folds[i]
+        val = folds[(i + 1) % k]
+        train = [f for j, fold in enumerate(folds) if j not in (i, (i + 1) % k) for f in fold]
+        split = {"train": train, "validation": val, "test": test}
+        splits.append(split)
+        if save_to_dir:
+            os.makedirs(save_to_dir, exist_ok=True)
+            with open(os.path.join(save_to_dir, f"{name}_{i}.json"), "w") as f:
+                json.dump(split, f, indent=2)
+    return splits
+
+
+def split_place_holder(files, save_to_dir=None, name="SPLIT"):
+    """Everything in train — used when the task needs no held-out data."""
+    split = {"train": list(files), "validation": [], "test": []}
+    if save_to_dir:
+        os.makedirs(save_to_dir, exist_ok=True)
+        with open(os.path.join(save_to_dir, f"{name}.json"), "w") as f:
+            json.dump(split, f, indent=2)
+    return split
+
+
+def init_k_folds(files, cache, state, data_conf=None):
+    """Materialize split JSONs under ``outputDirectory/<task_id>/splits`` and
+    register them in ``cache['splits']`` (index → filename).
+
+    Precedence (highest first):
+      1. ``data_conf['split_dir']`` — pre-supplied split JSONs, copied in.
+      2. ``cache['split_files']`` — explicit list of split JSONs in data dir.
+      3. ``cache['num_folds']`` — generate rotating k-fold splits.
+      4. ``cache['split_ratio']`` — one ratio split.
+      5. placeholder — everything in train.
+    """
+    data_conf = data_conf or {}
+    out_dir = os.path.join(
+        state.get("outputDirectory", "."), cache.get("task_id", "task"), "splits"
+    )
+    # clear stale split JSONs from a previous run with a different split config
+    if os.path.isdir(out_dir):
+        shutil.rmtree(out_dir)
+    os.makedirs(out_dir, exist_ok=True)
+
+    pre_dir = data_conf.get("split_dir")
+    if pre_dir:
+        pre_dir = os.path.join(state.get("baseDirectory", "."), pre_dir)
+    if pre_dir and os.path.isdir(pre_dir) and os.listdir(pre_dir):
+        for f in sorted(os.listdir(pre_dir)):
+            shutil.copy(os.path.join(pre_dir, f), out_dir)
+    elif cache.get("split_files"):
+        for f in cache["split_files"]:
+            shutil.copy(os.path.join(state.get("baseDirectory", "."), f), out_dir)
+    elif cache.get("num_folds"):
+        create_k_fold_splits(files, int(cache["num_folds"]), save_to_dir=out_dir,
+                             seed=cache.get("seed"))
+    elif cache.get("split_ratio"):
+        create_ratio_split(files, save_to_dir=out_dir, ratio=tuple(cache["split_ratio"]),
+                           seed=cache.get("seed"))
+    else:
+        split_place_holder(files, save_to_dir=out_dir)
+
+    split_files = sorted(os.listdir(out_dir))
+    cache["split_dir"] = out_dir
+    cache["splits"] = {str(i): f for i, f in enumerate(split_files)}
+    return cache["splits"]

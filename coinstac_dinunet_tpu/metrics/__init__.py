@@ -1,0 +1,21 @@
+from .loss import binary_cross_entropy_with_logits, cross_entropy, dice_loss_binary
+from .metrics import (
+    AUCROCMetrics,
+    COINNAverages,
+    COINNMetrics,
+    ConfusionMatrix,
+    Prf1a,
+    new_metrics,
+)
+
+__all__ = [
+    "COINNMetrics",
+    "COINNAverages",
+    "Prf1a",
+    "ConfusionMatrix",
+    "AUCROCMetrics",
+    "new_metrics",
+    "dice_loss_binary",
+    "cross_entropy",
+    "binary_cross_entropy_with_logits",
+]

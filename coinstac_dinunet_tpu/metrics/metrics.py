@@ -1,0 +1,392 @@
+"""Metrics: pure-pytree accumulators with exact cross-site reduction.
+
+Capability parity with the reference ``metrics/metrics.py:17-329``
+(COINNMetrics ABC + COINNAverages/Prf1a/ConfusionMatrix/AUCROCMetrics), with a
+TPU-first contract:
+
+- Every metric's raw statistics live in a small fixed-shape numpy/jnp *state*
+  pytree, so ``update_state`` can run **inside a jit-compiled train step**; the
+  host object merely wraps the state for the reference-style OO API
+  (``add/accumulate/reset/get/extract/serialize/reduce_sites``).
+- Classification metrics' ``update_state`` take an optional per-sample
+  ``mask`` so padded (lockstep) batches contribute nothing — padding is
+  mandatory under XLA's static shapes, masking replaces the reference's padded
+  sampler trick.  (``COINNAverages`` instead weighs by ``n`` — pass
+  ``mask.sum()`` for padded batches.)
+- ``serialize()`` ships **raw counts**, and ``reduce_sites`` merges counts
+  before deriving scores — exact global P/R/F1 rather than the reference's
+  mean-of-site-scores approximation (ref ``metrics/metrics.py:217-218,288-289``).
+"""
+import numpy as np
+
+from .. import config
+
+_EPS = config.metrics_eps
+
+
+def _round(x):
+    return round(float(x), config.metrics_num_precision)
+
+
+class COINNMetrics:
+    """Base contract every metric obeys.
+
+    State-centric: subclasses define ``empty_state`` and pure ``update_state``;
+    the instance holds a current state and exposes the host-side API.
+    """
+
+    monitor = None  # attribute name used for early-stopping extraction
+
+    def __init__(self):
+        self.state = self.empty_state()
+
+    # ---- pure/functional API (jit-safe) ---------------------------------
+    @staticmethod
+    def empty_state():
+        raise NotImplementedError
+
+    @staticmethod
+    def update_state(state, pred, true, mask=None):
+        raise NotImplementedError
+
+    @staticmethod
+    def merge_states(a, b):
+        """Default: states are addable count pytrees."""
+        import jax
+
+        return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
+
+    # ---- host-side OO API ------------------------------------------------
+    def add(self, pred, true, mask=None):
+        # compute the per-call delta on device, fold into the f64 accumulator
+        self.update(self.update_state(self.empty_state(), pred, true, mask))
+
+    def accumulate(self, other):
+        if isinstance(other, COINNMetrics):
+            other = other.state
+        self.state = self.merge_states(self.state, other)
+        return self
+
+    def update(self, state):
+        """Fold a state pytree produced inside a jitted step into this metric.
+
+        Device states are f32 (per-batch counts, exact below 2^24); they are
+        promoted to host numpy f64 here so the running totals stay exact.
+        """
+        import jax
+
+        state = jax.tree_util.tree_map(
+            lambda x: np.asarray(x, dtype=np.float64), state
+        )
+        self.state = self.merge_states(self.state, state)
+        return self
+
+    def reset(self):
+        self.state = self.empty_state()
+
+    def get(self):
+        raise NotImplementedError
+
+    def extract(self, name):
+        return getattr(self, name)
+
+    def serialize(self):
+        """Raw-count payload for the wire (JSON-able)."""
+        import jax
+
+        return [np.asarray(l).tolist() for l in jax.tree_util.tree_leaves(self.state)]
+
+    @classmethod
+    def deserialize(cls, payload):
+        import jax
+
+        m = cls()
+        leaves, treedef = jax.tree_util.tree_flatten(m.state)
+        new = [np.asarray(p, dtype=np.asarray(l).dtype) for l, p in zip(leaves, payload)]
+        m.state = jax.tree_util.tree_unflatten(treedef, new)
+        return m
+
+    @classmethod
+    def reduce_sites(cls, site_payloads):
+        """Merge N sites' serialized payloads exactly (count merge)."""
+        merged = cls()
+        for payload in site_payloads:
+            merged.accumulate(cls.deserialize(payload))
+        return merged
+
+    def new(self):
+        return type(self)()
+
+
+class COINNAverages(COINNMetrics):
+    """K simultaneous (sum, count) averages (e.g. per-loss-term tracking)."""
+
+    monitor = "average"
+
+    def __init__(self, num_averages=1):
+        self.num_averages = int(num_averages)
+        super().__init__()
+
+    def empty_state(self):
+        return {
+            "sum": np.zeros(self.num_averages, dtype=np.float64),
+            "count": np.zeros(self.num_averages, dtype=np.float64),
+        }
+
+    @staticmethod
+    def update_state(state, values, n=1):
+        """``values`` are per-batch aggregates; ``n`` is the weight — pass
+        ``mask.sum()`` for padded batches to exclude padding."""
+        import jax.numpy as jnp
+
+        # float32 in the jit path (TPU-friendly); host-side merges stay f64
+        values = jnp.atleast_1d(jnp.asarray(values, dtype=jnp.float32))
+        n = jnp.asarray(n, dtype=jnp.float32)
+        return {"sum": state["sum"] + values * n, "count": state["count"] + n * jnp.ones_like(values)}
+
+    def add(self, values, n=1):
+        self.update(self.update_state(self.empty_state(), values, n))
+
+    def serialize(self):
+        # explicit [sums, counts] payload order (wire contract)
+        return [np.asarray(self.state["sum"]).tolist(), np.asarray(self.state["count"]).tolist()]
+
+    @classmethod
+    def deserialize(cls, payload):
+        m = cls(num_averages=len(payload[0]))
+        m.state = {
+            "sum": np.asarray(payload[0], dtype=np.float64),
+            "count": np.asarray(payload[1], dtype=np.float64),
+        }
+        return m
+
+    @property
+    def averages(self):
+        s, c = np.asarray(self.state["sum"]), np.asarray(self.state["count"])
+        return s / np.where(c == 0, 1.0, c)
+
+    @property
+    def average(self):
+        return _round(self.averages[0])
+
+    def get(self):
+        return [_round(a) for a in self.averages]
+
+    def new(self):
+        return COINNAverages(self.num_averages)
+
+    @classmethod
+    def reduce_sites(cls, site_payloads):
+        merged = None
+        for payload in site_payloads:
+            m = cls.deserialize(payload)
+            merged = m if merged is None else merged.accumulate(m)
+        return merged if merged is not None else cls()
+
+
+class Prf1a(COINNMetrics):
+    """Binary precision/recall/F1/accuracy/IoU from TP/FP/TN/FN counts."""
+
+    monitor = "f1"
+
+    @staticmethod
+    def empty_state():
+        return {"tp": np.float64(0), "fp": np.float64(0), "tn": np.float64(0), "fn": np.float64(0)}
+
+    @staticmethod
+    def update_state(state, pred, true, mask=None):
+        # float32 inside jit: per-batch counts are < 2^24 so exact; fold each
+        # batch's state into the host-side f64 accumulator for exact totals
+        import jax.numpy as jnp
+
+        pred = jnp.asarray(pred).reshape(-1).astype(jnp.float32)
+        true = jnp.asarray(true).reshape(-1).astype(jnp.float32)
+        w = jnp.ones_like(pred) if mask is None else jnp.asarray(mask).reshape(-1).astype(jnp.float32)
+        tp = jnp.sum(w * pred * true)
+        fp = jnp.sum(w * pred * (1 - true))
+        fn = jnp.sum(w * (1 - pred) * true)
+        tn = jnp.sum(w * (1 - pred) * (1 - true))
+        return {
+            "tp": state["tp"] + tp,
+            "fp": state["fp"] + fp,
+            "tn": state["tn"] + tn,
+            "fn": state["fn"] + fn,
+        }
+
+    def _c(self, k):
+        return float(np.asarray(self.state[k]))
+
+    @property
+    def precision(self):
+        tp, fp = self._c("tp"), self._c("fp")
+        return _round(tp / max(tp + fp, _EPS))
+
+    @property
+    def recall(self):
+        tp, fn = self._c("tp"), self._c("fn")
+        return _round(tp / max(tp + fn, _EPS))
+
+    @property
+    def f1(self):
+        p, r = self.precision, self.recall
+        return _round(2 * p * r / max(p + r, _EPS))
+
+    @property
+    def accuracy(self):
+        tp, fp, tn, fn = (self._c(k) for k in ("tp", "fp", "tn", "fn"))
+        return _round((tp + tn) / max(tp + fp + tn + fn, _EPS))
+
+    @property
+    def overlap(self):
+        """Intersection-over-union of the positive class."""
+        tp, fp, fn = self._c("tp"), self._c("fp"), self._c("fn")
+        return _round(tp / max(tp + fp + fn, _EPS))
+
+    def prfa(self):
+        return [self.precision, self.recall, self.f1, self.accuracy]
+
+    def get(self):
+        return self.prfa()
+
+
+class ConfusionMatrix(COINNMetrics):
+    """Multi-class K×K confusion matrix with per-class and macro P/R/F1."""
+
+    monitor = "f1"
+
+    def __init__(self, num_classes=2):
+        self.num_classes = int(num_classes)
+        super().__init__()
+
+    def empty_state(self):
+        return {"matrix": np.zeros((self.num_classes, self.num_classes), dtype=np.float64)}
+
+    @staticmethod
+    def update_state(state, pred, true, mask=None):
+        import jax.numpy as jnp
+
+        k = state["matrix"].shape[0]
+        pred = jnp.asarray(pred).reshape(-1).astype(jnp.int32)
+        true = jnp.asarray(true).reshape(-1).astype(jnp.int32)
+        w = (
+            jnp.ones(pred.shape, dtype=jnp.float32)
+            if mask is None
+            else jnp.asarray(mask).reshape(-1).astype(jnp.float32)
+        )
+        # row = true class, col = predicted class; scatter-add via one flat bincount
+        idx = true * k + pred
+        counts = jnp.zeros(k * k, dtype=jnp.float32).at[idx].add(w)
+        return {"matrix": state["matrix"] + counts.reshape(k, k)}
+
+    @property
+    def matrix(self):
+        return np.asarray(self.state["matrix"])
+
+    def _per_class(self):
+        m = self.matrix
+        tp = np.diag(m)
+        fp = m.sum(axis=0) - tp  # predicted-as-c but not c
+        fn = m.sum(axis=1) - tp  # is-c but predicted otherwise
+        precision = tp / np.maximum(tp + fp, _EPS)
+        recall = tp / np.maximum(tp + fn, _EPS)
+        f1 = 2 * precision * recall / np.maximum(precision + recall, _EPS)
+        return precision, recall, f1
+
+    @property
+    def precision(self):
+        return _round(self._per_class()[0].mean())
+
+    @property
+    def recall(self):
+        return _round(self._per_class()[1].mean())
+
+    @property
+    def f1(self):
+        return _round(self._per_class()[2].mean())
+
+    @property
+    def accuracy(self):
+        m = self.matrix
+        return _round(np.diag(m).sum() / max(m.sum(), _EPS))
+
+    def get(self):
+        # same column order as Prf1a.get() so log headers stay valid when
+        # new_metrics() swaps the metric class on num_classes
+        return [self.precision, self.recall, self.f1, self.accuracy]
+
+    def new(self):
+        return ConfusionMatrix(self.num_classes)
+
+    @classmethod
+    def reduce_sites(cls, site_payloads):
+        if not site_payloads:
+            return cls()
+        merged = None
+        for payload in site_payloads:
+            mat = np.asarray(payload[0], dtype=np.float64)
+            m = cls(num_classes=mat.shape[0])
+            m.state = {"matrix": mat}
+            merged = m if merged is None else merged.accumulate(m)
+        return merged
+
+
+class AUCROCMetrics(COINNMetrics):
+    """Binary AUC-ROC.  Accumulates (probability, label) pairs; the wire ships
+    the raw pairs so the aggregator computes the *exact global* AUC (the
+    reference averages per-site AUCs — an approximation)."""
+
+    monitor = "auc"
+
+    @staticmethod
+    def empty_state():
+        return {"probs": np.zeros((0,), np.float64), "labels": np.zeros((0,), np.float64)}
+
+    @staticmethod
+    def update_state(state, probs, labels, mask=None):
+        probs = np.asarray(probs, dtype=np.float64).reshape(-1)
+        labels = np.asarray(labels, dtype=np.float64).reshape(-1)
+        if mask is not None:
+            keep = np.asarray(mask).reshape(-1) > 0
+            probs, labels = probs[keep], labels[keep]
+        return {
+            "probs": np.concatenate([state["probs"], probs]),
+            "labels": np.concatenate([state["labels"], labels]),
+        }
+
+    @staticmethod
+    def merge_states(a, b):
+        return {
+            "probs": np.concatenate([np.asarray(a["probs"]), np.asarray(b["probs"])]),
+            "labels": np.concatenate([np.asarray(a["labels"]), np.asarray(b["labels"])]),
+        }
+
+    @property
+    def auc(self):
+        probs, labels = self.state["probs"], self.state["labels"]
+        n_pos = float((labels > 0.5).sum())
+        n_neg = float(len(labels) - n_pos)
+        if n_pos == 0 or n_neg == 0:
+            return 0.0
+        # rank-sum (Mann-Whitney) AUC with tie handling — no sklearn dependency
+        order = np.argsort(probs, kind="mergesort")
+        ranks = np.empty(len(probs), dtype=np.float64)
+        sorted_p = probs[order]
+        i = 0
+        while i < len(sorted_p):
+            j = i
+            while j + 1 < len(sorted_p) and sorted_p[j + 1] == sorted_p[i]:
+                j += 1
+            ranks[order[i : j + 1]] = 0.5 * (i + j) + 1.0
+            i = j + 1
+        pos_rank_sum = ranks[labels > 0.5].sum()
+        return _round((pos_rank_sum - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+    def get(self):
+        return [self.auc]
+
+
+def new_metrics(num_classes=2, binary_as_auc=False):
+    """Metric factory by task shape (≙ COINNTrainer.new_metrics)."""
+    if num_classes <= 2:
+        return AUCROCMetrics() if binary_as_auc else Prf1a()
+    return ConfusionMatrix(num_classes)

@@ -1,0 +1,96 @@
+"""L0 utilities: frozen config dicts, score/cache persistence, log thinning.
+
+Capability parity with the reference ``coinstac_dinunet/utils/__init__.py:8-80``
+(FrozenDict, save_scores, jsonable/clean_recursive, save_cache, lazy_debug),
+extended to understand JAX arrays when sanitizing payloads to JSON.
+"""
+import json
+import os
+
+import numpy as np
+
+from .logger import lazy_debug  # noqa: F401 (re-export)
+
+
+class FrozenDict(dict):
+    """Write-once dict: re-assigning an existing key raises.
+
+    Used to freeze the ``input``/``state``/resolved-args mappings so the phase
+    state machine cannot silently corrupt configuration mid-run.
+    """
+
+    def __setitem__(self, key, value):
+        if key in self:
+            raise ValueError(f"Attempt to modify frozen key {key!r} (={self[key]!r})")
+        super().__setitem__(key, value)
+
+    def promote(self, key, value):
+        """Deliberate override — the single sanctioned escape hatch."""
+        super().__setitem__(key, value)
+
+    def update(self, other=None, **kw):
+        for k, v in dict(other or {}, **kw).items():
+            self[k] = v
+
+
+def jsonable(obj):
+    try:
+        json.dumps(obj)
+        return True
+    except (TypeError, ValueError, OverflowError):
+        return False
+
+
+def clean_recursive(obj):
+    """In-place-ish sanitization of a nested structure to JSON-able values.
+
+    numpy / JAX scalars and arrays become Python scalars / lists; anything
+    still non-serializable is stringified.
+    """
+    if isinstance(obj, dict):
+        return {k: clean_recursive(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [clean_recursive(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if hasattr(obj, "__array__") and not isinstance(obj, (str, bytes)):
+        try:
+            return np.asarray(obj).tolist()
+        except Exception:
+            return str(obj)
+    if jsonable(obj):
+        return obj
+    return str(obj)
+
+
+def save_cache(cache, state, name="logs"):
+    """Dump the node cache as JSON into the node's output directory."""
+    out_dir = state.get("outputDirectory", ".")
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, f"{name}.json"), "w") as f:
+        json.dump(clean_recursive(dict(cache)), f, indent=2)
+
+
+def save_scores(cache, experiment_id="", file_keys=None, log_dir=None):
+    """Write accumulated score rows to CSV, one file per log key.
+
+    Column header comes from ``cache['log_header']`` (``|``-separated groups,
+    ``,``-separated columns — same convention the plotter uses).
+    """
+    log_dir = log_dir or cache.get("log_dir", ".")
+    os.makedirs(log_dir, exist_ok=True)
+    header = cache.get("log_header", "")
+    cols = [c.strip() for grp in header.split("|") for c in grp.split(",") if c.strip()]
+    for key in file_keys or []:
+        rows = cache.get(key, [])
+        path = os.path.join(log_dir, f"{experiment_id}_{key}.csv".lstrip("_"))
+        with open(path, "w") as f:
+            if cols:
+                f.write(",".join(cols) + "\n")
+            for row in rows:
+                row = row if isinstance(row, (list, tuple)) else [row]
+                f.write(",".join(str(v) for v in clean_recursive(list(row))) + "\n")

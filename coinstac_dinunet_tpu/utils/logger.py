@@ -1,0 +1,36 @@
+"""Tiny leveled logger gated on a debug flag.
+
+Parity: reference ``utils/logger.py:4-24`` (error/warn/info/success + lazy
+thinning).  Print-based on purpose — node stdout is captured by the engine.
+"""
+import math
+
+_COLORS = {"error": "\033[91m", "warn": "\033[93m", "success": "\033[92m", "info": ""}
+_END = "\033[0m"
+
+
+def _emit(level, msg, debug=True):
+    if debug:
+        color = _COLORS.get(level, "")
+        print(f"{color}{msg}{_END}" if color else str(msg))
+
+
+def error(msg, debug=True):
+    _emit("error", f"ERROR! {msg}", debug)
+
+
+def warn(msg, debug=True):
+    _emit("warn", f"WARNING! {msg}", debug)
+
+
+def info(msg, debug=True):
+    _emit("info", msg, debug)
+
+
+def success(msg, debug=True):
+    _emit("success", f"SUCCESS! {msg}", debug)
+
+
+def lazy_debug(x, add=1):
+    """True on a log-spaced subset of iterations — thins hot-loop logging."""
+    return x % int(math.log(x + 1) + add) == 0

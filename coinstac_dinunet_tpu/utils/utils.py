@@ -1,0 +1,46 @@
+"""Early-stopping / best-score tracking + wall-clock profiling helpers.
+
+Parity: reference ``utils/utils.py:7-31`` (performance_improved_,
+stop_training_, duration).
+"""
+import time
+
+from .. import config
+
+
+def performance_improved_(epoch, score, cache):
+    """True iff ``score`` beats the tracked best by more than score_delta.
+
+    Direction comes from ``cache['metric_direction']`` ('maximize'|'minimize').
+    Mutates ``cache['best_val_epoch']`` / ``cache['best_val_score']`` on
+    improvement.
+    """
+    delta = float(cache.get("score_delta", config.score_delta))
+    direction = cache.get("metric_direction", "maximize")
+    best = cache.get("best_val_score")
+    if best is None:
+        improved = True
+    elif direction == "maximize":
+        improved = float(score) > float(best) + delta
+    else:
+        improved = float(score) < float(best) - delta
+    if improved:
+        cache["best_val_epoch"] = epoch
+        cache["best_val_score"] = float(score)
+    return improved
+
+
+def stop_training_(epoch, cache):
+    """Patience-based early stop on epochs since the best validation score."""
+    patience = cache.get("patience")
+    if not patience:
+        return False
+    return (epoch - cache.get("best_val_epoch", 0)) >= int(patience)
+
+
+def duration(cache, key, begin=None):
+    """Append elapsed wall-clock seconds to ``cache[key]``; returns now()."""
+    now = time.time()
+    if begin is not None:
+        cache.setdefault(key, []).append(round(now - begin, 5))
+    return now

@@ -1,0 +1,29 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax initializes.
+
+This stands in for a TPU pod slice: the `site`/`device` mesh axes used by the
+parallel layer map onto 8 virtual CPU devices, so every sharding/collective
+path is exercised without TPU hardware (SURVEY.md §4 implication).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+# The container's sitecustomize force-registers the axon TPU plugin and pins
+# jax_platforms="axon,cpu" (overriding the env var).  Re-pin to pure CPU so
+# tests never touch the (pool-contended) TPU tunnel and the 8-device virtual
+# platform takes effect.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

@@ -1,0 +1,170 @@
+import json
+import os
+
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu.data import (
+    COINNDataHandle,
+    COINNDataLoader,
+    COINNDataset,
+    create_k_fold_splits,
+    create_ratio_split,
+    init_k_folds,
+)
+from coinstac_dinunet_tpu.config.keys import Mode
+
+
+class ToyDataset(COINNDataset):
+    """Each 'file' is a synthetic sample id; __getitem__ fabricates arrays."""
+
+    def load_index(self, dataset_name, file):
+        self.indices.append([dataset_name, file])
+
+    def __getitem__(self, ix):
+        _, file = self.indices[ix]
+        fid = int(str(file).split("_")[-1])
+        rng = np.random.default_rng(fid)
+        return {"inputs": rng.normal(size=(4,)).astype(np.float32),
+                "labels": np.int32(fid % 2)}
+
+
+def _files(n):
+    return [f"subj_{i}" for i in range(n)]
+
+
+def test_ratio_split_partitions_exactly():
+    split = create_ratio_split(_files(10), ratio=(0.6, 0.2, 0.2))
+    assert len(split["train"]) == 6
+    assert len(split["validation"]) == 2
+    assert len(split["test"]) == 2
+    allf = split["train"] + split["validation"] + split["test"]
+    assert sorted(allf) == sorted(_files(10))
+
+
+def test_k_fold_rotation_covers_every_sample_once():
+    splits = create_k_fold_splits(_files(10), k=5)
+    assert len(splits) == 5
+    tested = [f for s in splits for f in s["test"]]
+    assert sorted(tested) == sorted(_files(10))
+    for s in splits:
+        assert not (set(s["train"]) & set(s["test"]))
+        assert not (set(s["train"]) & set(s["validation"]))
+
+
+def test_init_k_folds_generates_and_registers(tmp_path):
+    cache = {"task_id": "t1", "num_folds": 3}
+    state = {"outputDirectory": str(tmp_path), "baseDirectory": str(tmp_path)}
+    splits = init_k_folds(_files(9), cache, state)
+    assert len(splits) == 3
+    split0 = json.load(open(os.path.join(cache["split_dir"], splits["0"])))
+    assert set(split0) == {"train", "validation", "test"}
+
+
+def test_init_k_folds_ratio_fallback(tmp_path):
+    cache = {"task_id": "t1", "split_ratio": [0.8, 0.2]}
+    state = {"outputDirectory": str(tmp_path), "baseDirectory": str(tmp_path)}
+    splits = init_k_folds(_files(10), cache, state)
+    assert len(splits) == 1
+
+
+def test_loader_static_shapes_and_tail_mask():
+    ds = ToyDataset()
+    ds.add(_files(10))
+    loader = COINNDataLoader(ds, batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 3
+    for b in batches:
+        assert b["inputs"].shape == (4, 4)  # static shape incl. tail
+    assert batches[-1]["_mask"].tolist() == [1.0, 1.0, 0.0, 0.0]
+
+
+def test_loader_lockstep_target_batches_wrap_pad():
+    ds = ToyDataset()
+    ds.add(_files(6))
+    loader = COINNDataLoader(ds, batch_size=4, target_batches=4)
+    batches = list(loader)
+    assert len(batches) == 4
+    total_mask = sum(b["_mask"].sum() for b in batches)
+    assert total_mask == 6  # only real samples count
+
+
+def test_loader_deterministic_shuffle():
+    ds = ToyDataset()
+    ds.add(_files(8))
+    a = [b["inputs"] for b in COINNDataLoader(ds, batch_size=4, shuffle=True, seed=7, epoch=1)]
+    b = [b["inputs"] for b in COINNDataLoader(ds, batch_size=4, shuffle=True, seed=7, epoch=1)]
+    c = [b["inputs"] for b in COINNDataLoader(ds, batch_size=4, shuffle=True, seed=7, epoch=2)]
+    np.testing.assert_array_equal(a[0], b[0])
+    assert not np.array_equal(a[0], c[0])
+
+
+def _handle(tmp_path, n=8, **cache_extra):
+    for f in _files(n):
+        (tmp_path / "data" / f).parent.mkdir(exist_ok=True)
+        (tmp_path / "data" / f).write_text("x")
+    cache = {"task_id": "t1", "num_folds": 4, "data_dir": "data",
+             "batch_size": 4, "seed": 3, **cache_extra}
+    state = {"outputDirectory": str(tmp_path / "out"), "baseDirectory": str(tmp_path)}
+    handle = COINNDataHandle(cache=cache, state=state, dataset_cls=ToyDataset)
+    handle.prepare_data()
+    cache["split_ix"] = 0
+    return handle, cache
+
+
+def test_datahandle_fold_datasets(tmp_path):
+    handle, cache = _handle(tmp_path)
+    train = handle.get_train_dataset()
+    val = handle.get_validation_dataset()
+    test = handle.get_test_dataset()
+    assert len(train) + len(val) + len(test) == 8
+    assert len(test) == 2  # k=4 → a quarter of the data
+    assert len(train) == 4
+
+
+def test_datahandle_next_iter_cursor_and_barrier(tmp_path):
+    handle, cache = _handle(tmp_path)
+    handle.get_train_dataset()
+    n_batches = 0
+    while True:
+        batch, out = handle.next_iter()
+        if batch is None:
+            assert out["mode"] == Mode.VALIDATION_WAITING.value
+            break
+        n_batches += 1
+        assert batch["inputs"].shape[0] == 4
+    assert n_batches == 1  # 4 train samples @ bs 4
+    assert cache["cursor"] == 0  # reset for next epoch
+
+
+def test_test_dataset_load_sparse(tmp_path):
+    handle, cache = _handle(tmp_path)
+    sparse = handle.get_test_dataset(load_sparse=True)
+    assert isinstance(sparse, list)
+    assert all(len(d) == 1 for d in sparse)
+
+
+def test_init_k_folds_clears_stale_splits(tmp_path):
+    from coinstac_dinunet_tpu.data import init_k_folds
+
+    state = {"outputDirectory": str(tmp_path), "baseDirectory": str(tmp_path)}
+    c1 = {"task_id": "t", "split_ratio": [0.8, 0.2]}
+    init_k_folds(_files(10), c1, state)
+    c2 = {"task_id": "t", "num_folds": 3}
+    splits = init_k_folds(_files(9), c2, state)
+    assert len(splits) == 3  # stale SPLIT.json from the ratio run is gone
+
+
+def test_batch_at_mask_tracks_dropped_samples(tmp_path):
+    class FlakyDS(ToyDataset):
+        def __getitem__(self, ix):
+            if ix == 0:
+                return None
+            return super().__getitem__(ix)
+
+    ds = FlakyDS()
+    ds.add(_files(4))
+    loader = COINNDataLoader(ds, batch_size=4)
+    b = loader.batch_at(0)
+    assert b["inputs"].shape[0] == 3
+    assert b["_mask"].shape == (3,)

@@ -1,0 +1,152 @@
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu.metrics import (
+    AUCROCMetrics,
+    COINNAverages,
+    ConfusionMatrix,
+    Prf1a,
+    dice_loss_binary,
+    new_metrics,
+)
+
+
+def test_averages_exact_weighted_merge():
+    a = COINNAverages(num_averages=2)
+    a.add([1.0, 2.0], n=3)
+    a.add([4.0, 6.0], n=1)
+    # weighted: (1*3+4)/4, (2*3+6)/4
+    assert a.get() == [1.75, 3.0]
+
+
+def test_averages_reduce_sites_exact():
+    s1, s2 = COINNAverages(), COINNAverages()
+    s1.add([2.0], n=10)
+    s2.add([4.0], n=30)
+    merged = COINNAverages.reduce_sites([s1.serialize(), s2.serialize()])
+    assert merged.get() == [3.5]  # (20+120)/40, not mean(2,4)=3
+
+
+def test_prf1a_against_manual_counts():
+    m = Prf1a()
+    pred = np.array([1, 1, 0, 0, 1])
+    true = np.array([1, 0, 0, 1, 1])
+    m.add(pred, true)
+    # tp=2, fp=1, fn=1, tn=1
+    assert m.precision == pytest.approx(2 / 3, abs=1e-4)
+    assert m.recall == pytest.approx(2 / 3, abs=1e-4)
+    assert m.accuracy == pytest.approx(3 / 5, abs=1e-4)
+    assert m.f1 == pytest.approx(2 / 3, abs=1e-4)
+
+
+def test_prf1a_mask_ignores_padding():
+    m = Prf1a()
+    pred = np.array([1, 1, 1, 1])
+    true = np.array([1, 0, 1, 1])
+    mask = np.array([1, 1, 0, 0])  # last two are padding
+    m.add(pred, true, mask=mask)
+    assert float(np.asarray(m.state["tp"])) == 1
+    assert float(np.asarray(m.state["fp"])) == 1
+
+
+def test_prf1a_reduce_sites_is_count_merge_not_score_mean():
+    s1, s2 = Prf1a(), Prf1a()
+    # site 1: 1 TP out of 1 sample → f1=1.0
+    s1.add(np.array([1]), np.array([1]))
+    # site 2: 0 TP, 9 FP → f1=0.0
+    s2.add(np.ones(9), np.zeros(9))
+    merged = Prf1a.reduce_sites([s1.serialize(), s2.serialize()])
+    # exact global: tp=1, fp=9 → precision=0.1 (score-mean would say 0.5)
+    assert merged.precision == pytest.approx(0.1, abs=1e-4)
+
+
+def test_confusion_matrix_matches_sklearn_style_counts():
+    cm = ConfusionMatrix(num_classes=3)
+    true = np.array([0, 1, 2, 2, 1, 0, 2])
+    pred = np.array([0, 2, 2, 2, 1, 1, 0])
+    cm.add(pred, true)
+    expected = np.zeros((3, 3))
+    for t, p in zip(true, pred):
+        expected[t, p] += 1
+    np.testing.assert_allclose(cm.matrix, expected)
+    assert cm.accuracy == pytest.approx(4 / 7, abs=1e-4)
+
+
+def test_confusion_matrix_reduce_sites():
+    a, b = ConfusionMatrix(3), ConfusionMatrix(3)
+    a.add(np.array([0, 1]), np.array([0, 1]))
+    b.add(np.array([2, 2]), np.array([2, 0]))
+    merged = ConfusionMatrix.reduce_sites([a.serialize(), b.serialize()])
+    assert merged.matrix.sum() == 4
+    assert merged.matrix[0, 0] == 1 and merged.matrix[2, 2] == 1
+
+
+def test_aucroc_exact_global():
+    m = AUCROCMetrics()
+    probs = np.array([0.1, 0.4, 0.35, 0.8])
+    labels = np.array([0, 0, 1, 1])
+    m.add(probs, labels)
+    # hand-computed AUC for this classic example = 0.75
+    assert m.auc == pytest.approx(0.75, abs=1e-4)
+    # reduce_sites concatenates raw pairs → identical global AUC
+    merged = AUCROCMetrics.reduce_sites([m.serialize(), AUCROCMetrics().serialize()])
+    assert merged.auc == pytest.approx(0.75, abs=1e-4)
+
+
+def test_metric_update_inside_jit():
+    import jax
+
+    @jax.jit
+    def step(state, pred, true):
+        return Prf1a.update_state(state, pred, true)
+
+    st = Prf1a.empty_state()
+    st = step(st, np.array([1, 0, 1]), np.array([1, 1, 1]))
+    m = Prf1a()
+    m.update(st)
+    assert float(np.asarray(m.state["tp"])) == 2
+    assert float(np.asarray(m.state["fn"])) == 1
+
+
+def test_dice_loss_perfect_prediction_is_zero():
+    import jax.numpy as jnp
+
+    x = jnp.ones((2, 4, 4))
+    assert float(dice_loss_binary(x, x)) == pytest.approx(0.0, abs=1e-4)
+    assert float(dice_loss_binary(x, jnp.zeros_like(x))) == pytest.approx(1.0, abs=1e-3)
+
+
+def test_new_metrics_factory():
+    assert isinstance(new_metrics(2), Prf1a)
+    assert isinstance(new_metrics(2, binary_as_auc=True), AUCROCMetrics)
+    assert isinstance(new_metrics(5), ConfusionMatrix)
+
+
+def test_confusion_matrix_get_order_matches_prf1a():
+    cm = ConfusionMatrix(3)
+    cm.add(np.array([0, 1, 2]), np.array([0, 1, 1]))
+    got = cm.get()
+    assert got == [cm.precision, cm.recall, cm.f1, cm.accuracy]
+
+
+def test_cross_entropy_loader_mask_on_segmentation_shapes():
+    import jax.numpy as jnp
+    from coinstac_dinunet_tpu.metrics import cross_entropy
+
+    logits = jnp.zeros((2, 4, 4, 3))
+    labels = jnp.zeros((2, 4, 4), dtype=jnp.int32)
+    loss = cross_entropy(logits, labels, mask=jnp.array([1.0, 0.0]))
+    assert float(loss) == pytest.approx(np.log(3), abs=1e-5)
+
+
+def test_host_accumulator_stays_float64():
+    import jax
+
+    m = Prf1a()
+
+    @jax.jit
+    def step(state):
+        return Prf1a.update_state(state, np.ones(8), np.ones(8))
+
+    m.update(step(Prf1a.empty_state()))
+    assert np.asarray(m.state["tp"]).dtype == np.float64

@@ -1,0 +1,119 @@
+import json
+import os
+
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu import config
+from coinstac_dinunet_tpu.utils import FrozenDict, clean_recursive, save_cache, save_scores
+from coinstac_dinunet_tpu.utils.tensorutils import (
+    extract_grads,
+    grads_like,
+    load_arrays,
+    pack_arrays,
+    safe_concat,
+    save_arrays,
+    unpack_arrays,
+)
+from coinstac_dinunet_tpu.utils.utils import performance_improved_, stop_training_
+
+
+def test_frozen_dict_blocks_overwrite():
+    d = FrozenDict()
+    d["a"] = 1
+    with pytest.raises(ValueError):
+        d["a"] = 2
+    d.promote("a", 3)
+    assert d["a"] == 3
+
+
+def test_boolean_string():
+    assert config.boolean_string("True") is True
+    assert config.boolean_string("false") is False
+    with pytest.raises(ValueError):
+        config.boolean_string("yes")
+
+
+def test_pack_unpack_roundtrip():
+    arrays = [
+        np.random.randn(3, 4).astype(np.float32),
+        np.arange(7, dtype=np.int64),
+        np.float16(2.5).reshape(()),
+    ]
+    out = unpack_arrays(pack_arrays(arrays))
+    assert len(out) == 3
+    for a, b in zip(arrays, out):
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == b.dtype
+
+
+def test_save_load_arrays(tmp_path):
+    p = str(tmp_path / "grads.npy")
+    arrays = [np.random.randn(5, 5).astype(np.float32), np.zeros(2)]
+    save_arrays(p, arrays)
+    out = load_arrays(p)
+    np.testing.assert_allclose(out[0], arrays[0])
+
+
+def test_extract_grads_roundtrip_pytree():
+    tree = {"dense": {"w": np.random.randn(4, 3), "b": np.zeros(3)}}
+    flat = extract_grads(tree, precision_bits=32)
+    assert all(a.dtype == np.float32 for a in flat)
+    back = grads_like(tree, flat)
+    np.testing.assert_allclose(np.asarray(back["dense"]["w"]), tree["dense"]["w"], rtol=1e-6)
+
+
+def test_safe_concat_center_crops_4d_and_5d():
+    import jax.numpy as jnp
+
+    # NCHW-style: crop spatial dims of `large` to match `small`
+    large = jnp.ones((2, 3, 10, 12))
+    small = jnp.ones((2, 5, 6, 8))
+    out = safe_concat(large, small, axis=1)
+    assert out.shape == (2, 8, 6, 8)
+    # 5-D (volumes) — the reference had an indexing bug here; verify correctness
+    large5 = jnp.ones((1, 2, 9, 11, 13))
+    small5 = jnp.ones((1, 4, 5, 7, 9))
+    out5 = safe_concat(large5, small5, axis=1)
+    assert out5.shape == (1, 6, 5, 7, 9)
+
+
+def test_performance_improved_and_early_stop():
+    cache = {"metric_direction": "maximize", "patience": 3}
+    assert performance_improved_(1, 0.5, cache)
+    assert cache["best_val_epoch"] == 1
+    assert not performance_improved_(2, 0.5, cache)  # no delta improvement
+    assert performance_improved_(3, 0.7, cache)
+    assert not stop_training_(5, cache)
+    assert stop_training_(6, cache)
+
+
+def test_clean_recursive_handles_arrays():
+    import jax.numpy as jnp
+
+    out = clean_recursive({"a": np.float32(1.5), "b": [jnp.ones(2)], "c": {"d": np.arange(2)}})
+    assert json.dumps(out)  # fully JSON-able
+    assert out["a"] == 1.5
+    assert out["c"]["d"] == [0, 1]
+
+
+def test_save_cache_and_scores(tmp_path):
+    cache = {
+        "log_header": "loss|precision,recall,f1,accuracy",
+        "validation_log": [[0.5, 0.9, 0.8, 0.85, 0.9]],
+        "log_dir": str(tmp_path),
+    }
+    save_cache(cache, {"outputDirectory": str(tmp_path)})
+    assert os.path.exists(tmp_path / "logs.json")
+    save_scores(cache, experiment_id="f0", file_keys=["validation_log"])
+    text = (tmp_path / "f0_validation_log.csv").read_text()
+    assert "precision" in text and "0.9" in text
+
+
+def test_safe_concat_negative_axis_nhwc():
+    import jax.numpy as jnp
+
+    large = jnp.ones((2, 10, 10, 3))
+    small = jnp.ones((2, 6, 6, 5))
+    out = safe_concat(large, small, axis=-1)
+    assert out.shape == (2, 6, 6, 8)
