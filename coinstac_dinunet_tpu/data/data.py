@@ -124,34 +124,41 @@ class COINNDataLoader:
     def __len__(self):
         return self.num_batches
 
+    @staticmethod
+    def _collate_static(samples, batch_mask):
+        """Collate keeping the batch dimension STATIC: positions whose sample
+        failed to load (None) are filled with a copy of a real sample and
+        masked out — shapes never change, so jit never retraces."""
+        keep = [s is not None for s in samples]
+        if not any(keep):
+            return None
+        template = samples[keep.index(True)]
+        filled, out_mask = [], np.array(batch_mask, dtype=np.float32)
+        for i, s in enumerate(samples):
+            if s is None:
+                filled.append(template)
+                out_mask[i] = 0.0
+            else:
+                filled.append(s)
+        batch = safe_collate(filled)
+        batch["_mask"] = out_mask
+        return batch
+
     def __iter__(self):
         order, mask = self._order
         for b in range(self.num_batches):
             sl = slice(b * self.batch_size, (b + 1) * self.batch_size)
-            batch_ix, batch_mask = order[sl], mask[sl]
-            samples = [self.dataset[int(i)] for i in batch_ix]
-            keep = np.array([s is not None for s in samples])
-            batch = safe_collate(samples)
-            if batch is None:
-                continue
-            if not keep.all():
-                batch_mask = batch_mask[keep]
-            batch["_mask"] = batch_mask.astype(np.float32)
-            yield batch
+            samples = [self.dataset[int(i)] for i in order[sl]]
+            batch = self._collate_static(samples, mask[sl])
+            if batch is not None:
+                yield batch
 
     def batch_at(self, cursor):
         """Random access for cursor-based streaming (``next_iter``)."""
         order, mask = self._order
         sl = slice(cursor * self.batch_size, (cursor + 1) * self.batch_size)
         samples = [self.dataset[int(i)] for i in order[sl]]
-        keep = np.array([s is not None for s in samples])
-        batch = safe_collate(samples)
-        if batch is not None:
-            batch_mask = mask[sl]
-            if not keep.all():
-                batch_mask = batch_mask[keep]
-            batch["_mask"] = batch_mask.astype(np.float32)
-        return batch
+        return self._collate_static(samples, mask[sl])
 
 
 class COINNDataHandle:

@@ -36,6 +36,7 @@ class COINNMetrics:
     """
 
     monitor = None  # attribute name used for early-stopping extraction
+    jit_safe = True  # False → state has data-dependent shapes; host-side only
 
     def __init__(self):
         self.state = self.empty_state()
@@ -336,6 +337,7 @@ class AUCROCMetrics(COINNMetrics):
     reference averages per-site AUCs — an approximation)."""
 
     monitor = "auc"
+    jit_safe = False  # accumulates variable-length prob/label lists
 
     @staticmethod
     def empty_state():

@@ -1,0 +1,3 @@
+from .basetrainer import CHECKPOINT_SOURCE, NNTrainer, TrainState, seeded_rng
+
+__all__ = ["NNTrainer", "TrainState", "seeded_rng", "CHECKPOINT_SOURCE"]

@@ -1,0 +1,442 @@
+"""NNTrainer — the single-node NN runtime, re-designed for JAX/XLA.
+
+Capability parity with the reference ``nn/basetrainer.py:20-326`` (multi-model/
+multi-optimizer dicts, seeded init, checkpoint save/load, ``train_local``,
+``evaluation``, ``reduce_iteration``, user hooks), with a TPU-first core:
+
+- Training state is a pytree (``TrainState``: params/opt_state/step/rng), not
+  mutable modules; the hot loop is ONE jit-compiled pure function per trainer
+  (``_train_step``), with ``lax.scan`` over ``local_iterations`` stacked
+  micro-batches for gradient accumulation (≙ ref ``:173-184`` step/zero_grad
+  cadence — but compiled, no per-batch Python).
+- Multi-network schemes keep the dict-of-models API (``nn['name']``), and the
+  checkpoint writes ALL models+optimizers (the reference loses all but the
+  last: ``nn/basetrainer.py:103-114``).
+- Evaluation consumes padded static-shape batches and weighs metrics by the
+  loader's ``_mask`` — the jit-friendly replacement for the reference's
+  padded sampler.
+
+User subclasses implement ``_init_nn_model`` (build flax modules) and
+``iteration(params, batch, rng)`` — a PURE function of its inputs returning at
+least ``{'loss': scalar}`` (plus optional ``pred``/``true``/``averages``).
+"""
+import os
+from typing import Any
+
+import numpy as np
+
+import flax
+import jax
+import jax.numpy as jnp
+import optax
+
+from .. import config
+from ..config.keys import Mode
+from ..metrics import COINNAverages, Prf1a
+from ..utils import logger
+from ..utils.utils import performance_improved_, stop_training_
+
+CHECKPOINT_SOURCE = "coinstac-dinunet-tpu"
+
+
+class TrainState(flax.struct.PyTreeNode):
+    """Everything the compiled train step reads and writes."""
+
+    params: Any
+    opt_state: Any
+    step: Any
+    rng: Any
+
+
+def seeded_rng(seed):
+    return jax.random.PRNGKey(int(seed))
+
+
+class NNTrainer:
+    """Single-node training runtime over a dict of flax models."""
+
+    def __init__(self, cache=None, input=None, state=None, data_handle=None, **kw):
+        self.cache = cache if cache is not None else {}
+        self.input = input if input is not None else {}
+        self.state = state if state is not None else {}
+        self.data_handle = data_handle
+        self.nn = {}  # name -> flax Module
+        self.optimizer = {}  # name -> optax GradientTransformation
+        self.train_state: TrainState = None
+        self._compiled = {}
+
+    # ------------------------------------------------------------------ hooks
+    def _init_nn_model(self):
+        """Populate ``self.nn`` with flax modules (user hook)."""
+        raise NotImplementedError
+
+    def example_inputs(self):
+        """Per-model example input(s) used to initialize parameters.
+
+        Default: zeros of ``cache['input_shape']`` (excluding batch dim) with
+        batch size 1 for every model.  Override for multi-input models.
+        """
+        shape = tuple(self.cache.get("input_shape", ()))
+        if not shape:
+            raise NotImplementedError(
+                "Provide cache['input_shape'] or override example_inputs()"
+            )
+        x = jnp.zeros((1, *shape), dtype=jnp.float32)
+        return {name: (x,) for name in self.nn}
+
+    def iteration(self, params, batch, rng=None):
+        """Pure forward+loss (user hook).  Must return ``{'loss': scalar}``;
+        optional keys: ``pred``/``true`` (for metrics), ``averages`` (values
+        for :class:`COINNAverages`), anything else is carried through."""
+        raise NotImplementedError
+
+    def _init_optimizer(self):
+        """Default: one Adam per model at ``cache['learning_rate']``."""
+        lr = float(self.cache.get("learning_rate", 1e-3))
+        for name in self.nn:
+            self.optimizer[name] = optax.adam(lr)
+
+    def new_metrics(self):
+        return Prf1a()
+
+    def new_averages(self):
+        return COINNAverages(num_averages=int(self.cache.get("num_averages", 1)))
+
+    # ------------------------------------------------------------ init / state
+    def init_nn(self, init_models=True, init_weights=True, init_optimizer=True):
+        # drop compiled functions: they close over optimizers/metric shells
+        # from the previous init (e.g. the old fold's learning rate)
+        self._compiled = {}
+        if init_models:
+            self._init_nn_model()
+        if init_weights:
+            self._init_nn_weights()
+        if init_optimizer:
+            self._init_optimizer()
+            self._init_train_state()
+        return self
+
+    def _init_nn_weights(self):
+        """Seeded parameter init — the same seed at every site makes replicas
+        identical by construction (the federated weight-sync invariant, ref
+        SURVEY §3.3).  ``pretrained_path`` warm-start wins over fresh init."""
+        pretrained = self.cache.get("pretrained_path")
+        seed = int(self.cache.get("seed", config.current_seed))
+        rng = seeded_rng(seed)
+        self._params = {}
+        examples = self.example_inputs()
+        for name, module in self.nn.items():
+            rng, sub = jax.random.split(rng)
+            args = examples[name]
+            if not isinstance(args, (tuple, list)):
+                args = (args,)
+            self._params[name] = module.init(sub, *args)
+        if pretrained:
+            self.load_checkpoint(full_path=pretrained, load_optimizer=False)
+
+    def _init_train_state(self):
+        params = getattr(self, "_params", None)
+        if params is None:
+            self._init_nn_weights()
+            params = self._params
+        opt_state = {n: self.optimizer[n].init(params[n]) for n in params}
+        seed = int(self.cache.get("seed", config.current_seed))
+        self.train_state = TrainState(
+            params=params,
+            opt_state=opt_state,
+            step=jnp.zeros((), jnp.int32),
+            rng=seeded_rng(seed + 1),
+        )
+
+    # ------------------------------------------------------------- checkpoints
+    def checkpoint_path(self, name=None):
+        log_dir = self.cache.get("log_dir", self.state.get("outputDirectory", "."))
+        os.makedirs(log_dir, exist_ok=True)
+        return os.path.join(log_dir, name or self.cache.get("latest_nn_state", "latest.ckpt"))
+
+    def save_checkpoint(self, name=None, full_path=None, save_optimizer=True):
+        """Serialize ALL models (+ optimizers) — every entry of the dict."""
+        payload = {
+            "source": CHECKPOINT_SOURCE,
+            "models": flax.serialization.to_state_dict(
+                jax.device_get(self.train_state.params)
+            ),
+            "step": int(self.train_state.step),
+        }
+        if save_optimizer:
+            # optax states are namedtuple chains; flatten to plain dicts
+            payload["optimizers"] = flax.serialization.to_state_dict(
+                jax.device_get(self.train_state.opt_state)
+            )
+        path = full_path or self.checkpoint_path(name)
+        with open(path, "wb") as f:
+            f.write(flax.serialization.msgpack_serialize(payload))
+        return path
+
+    def load_checkpoint(self, name=None, full_path=None, load_optimizer=True):
+        path = full_path or self.checkpoint_path(name)
+        with open(path, "rb") as f:
+            payload = flax.serialization.msgpack_restore(f.read())
+        if payload.get("source") == CHECKPOINT_SOURCE:
+            models = payload["models"]
+        else:
+            # foreign checkpoint: best-effort — treat the whole payload as a
+            # params dict (ref non-coinstac fallback ``basetrainer.py:76-99``)
+            models = payload
+        if self.train_state is None:
+            self._params = models
+            return self
+        params = {n: flax.serialization.from_state_dict(self.train_state.params[n], models[n])
+                  for n in self.train_state.params}
+        opt_state = self.train_state.opt_state
+        if load_optimizer and "optimizers" in payload:
+            opt_state = flax.serialization.from_state_dict(opt_state, payload["optimizers"])
+        step = self.train_state.step
+        if "step" in payload:
+            step = jnp.asarray(int(payload["step"]), jnp.int32)
+        self.train_state = self.train_state.replace(
+            params=params, opt_state=opt_state, step=step
+        )
+        return self
+
+    # -------------------------------------------------------- compiled kernels
+    def _metrics_shell(self):
+        return self.new_metrics(), self.new_averages()
+
+    @staticmethod
+    def _zeros_f32(tree):
+        """f32 device-side zero state (host empty_state() is f64 numpy)."""
+        return jax.tree_util.tree_map(
+            lambda x: jnp.zeros_like(jnp.asarray(x, jnp.float32)), tree
+        )
+
+    def _step_outputs(self, it, batch, metrics_shell, averages_shell):
+        """Metric/average state deltas for one micro-batch, inside jit."""
+        mask = batch.get("_mask")
+        m_state = None
+        if "pred" in it and "true" in it and getattr(metrics_shell, "jit_safe", True):
+            m_state = metrics_shell.update_state(
+                self._zeros_f32(metrics_shell.empty_state()), it["pred"], it["true"], mask
+            )
+        vals = it.get("averages", it["loss"])
+        n = jnp.sum(mask) if mask is not None else jnp.asarray(
+            next(iter(batch.values())).shape[0], jnp.float32
+        )
+        a_state = averages_shell.update_state(
+            self._zeros_f32(averages_shell.empty_state()), vals, n
+        )
+        return m_state, a_state
+
+    def _apply_updates(self, ts, grads):
+        new_params, new_opt = {}, {}
+        for name in ts.params:
+            updates, new_opt[name] = self.optimizer[name].update(
+                grads[name], ts.opt_state[name], ts.params[name]
+            )
+            new_params[name] = optax.apply_updates(ts.params[name], updates)
+        return ts.replace(
+            params=new_params, opt_state=new_opt, step=ts.step + 1
+        )
+
+    def compute_grads(self, ts, stacked_batches):
+        """Mean gradients over ``local_iterations`` stacked micro-batches via
+        ``lax.scan`` (compiled grad accumulation).  Returns (grads, aux).
+        This is the site-side half of a federated round (≙ learner.backward)."""
+        fn = self._compiled.get("grads")
+        if fn is None:
+            metrics_shell, averages_shell = self._metrics_shell()
+
+            def _grads(ts, stacked):
+                return self._grads_uncompiled(ts, stacked, metrics_shell, averages_shell)
+
+            fn = self._compiled["grads"] = jax.jit(_grads)
+        return fn(ts, stacked_batches)
+
+    def apply_grads(self, ts, grads, new_rng=None):
+        """One optimizer step from externally supplied (e.g. averaged)
+        gradients — the site-side apply half of a federated round."""
+        fn = self._compiled.get("apply")
+        if fn is None:
+            fn = self._compiled["apply"] = jax.jit(self._apply_updates)
+        ts = fn(ts, grads)
+        if new_rng is not None:
+            ts = ts.replace(rng=new_rng)
+        return ts
+
+    def train_step(self, ts, stacked_batches):
+        """compute_grads + apply_grads fused in one compiled call (the local
+        hot path — nothing leaves the device between grad and update)."""
+        fn = self._compiled.get("train")
+        if fn is None:
+            metrics_shell, averages_shell = self._metrics_shell()
+
+            def _full(ts, stacked):
+                grads, aux = self._grads_uncompiled(ts, stacked, metrics_shell, averages_shell)
+                ts = self._apply_updates(ts, grads)
+                ts = ts.replace(rng=aux["rng"])
+                return ts, aux
+
+            fn = self._compiled["train"] = jax.jit(_full)
+        return fn(ts, stacked_batches)
+
+    def _grads_uncompiled(self, ts, stacked, metrics_shell, averages_shell):
+        def loss_fn(params, batch, rng):
+            it = self.iteration(params, batch, rng)
+            return it["loss"], it
+
+        def body(carry, batch):
+            rng, gsum, msum, asum = carry
+            rng, sub = jax.random.split(rng)
+            (loss, it), g = jax.value_and_grad(loss_fn, has_aux=True)(ts.params, batch, sub)
+            m_state, a_state = self._step_outputs(it, batch, metrics_shell, averages_shell)
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+            if m_state is not None:
+                msum = jax.tree_util.tree_map(jnp.add, msum, m_state)
+            asum = jax.tree_util.tree_map(jnp.add, asum, a_state)
+            return (rng, gsum, msum, asum), loss
+
+        k = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+        gsum0 = jax.tree_util.tree_map(jnp.zeros_like, ts.params)
+        m0 = self._zeros_f32(metrics_shell.empty_state())
+        a0 = self._zeros_f32(averages_shell.empty_state())
+        (rng, gsum, msum, asum), losses = jax.lax.scan(body, (ts.rng, gsum0, m0, a0), stacked)
+        grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
+        return grads, {"rng": rng, "metrics": msum, "averages": asum, "loss": jnp.mean(losses)}
+
+    def eval_step(self, ts, batch):
+        fn = self._compiled.get("eval")
+        if fn is None:
+            metrics_shell, averages_shell = self._metrics_shell()
+
+            def _eval(ts, batch):
+                it = self.iteration(ts.params, batch, None)
+                m_state, a_state = self._step_outputs(it, batch, metrics_shell, averages_shell)
+                return m_state, a_state, it
+
+            fn = self._compiled["eval"] = jax.jit(_eval)
+        return fn(ts, batch)
+
+    # ----------------------------------------------------------- train / eval
+    @staticmethod
+    def _stack_batches(batches):
+        """[k dict batches] -> dict of (k, B, ...) arrays for lax.scan."""
+        keys = batches[0].keys()
+        return {k: jnp.stack([jnp.asarray(b[k]) for b in batches]) for k in keys}
+
+    def training_iteration_local(self, batches):
+        """One communication round locally: grad-accumulate over the batch
+        list, step the optimizer, return host-side it dict."""
+        stacked = self._stack_batches(batches)
+        self.train_state, aux = self.train_step(self.train_state, stacked)
+        return aux
+
+    def evaluation(self, mode=Mode.VALIDATION, dataset_list=None, save_pred=False,
+                   distributed=False):
+        """No-grad loop over one or more datasets with mask-weighted metrics."""
+        metrics, averages = self.new_metrics(), self.new_averages()
+        datasets = dataset_list if dataset_list is not None else [
+            self.data_handle.datasets.get(str(mode), None)
+        ]
+        for ds in datasets:
+            if ds is None or len(ds) == 0:
+                continue
+            loader = self.data_handle.get_loader(
+                handle_key=str(mode), dataset=ds, shuffle=False
+            )
+            ds_metrics, ds_averages = self.new_metrics(), self.new_averages()
+            predictions = []  # per-dataset (sparse test = one file per subject)
+            for batch in loader:
+                batch = {k: jnp.asarray(v) for k, v in batch.items()}
+                m_state, a_state, it = self.eval_step(self.train_state, batch)
+                if m_state is not None:
+                    ds_metrics.update(m_state)
+                elif not ds_metrics.jit_safe and "pred" in it and "true" in it:
+                    # variable-shape metrics (AUC) accumulate host-side
+                    ds_metrics.add(
+                        np.asarray(it["pred"]), np.asarray(it["true"]),
+                        mask=np.asarray(batch.get("_mask")) if "_mask" in batch else None,
+                    )
+                ds_averages.update(a_state)
+                if save_pred and "pred" in it:
+                    predictions.append(
+                        (np.asarray(it["pred"]), np.asarray(batch.get("_mask")))
+                    )
+            metrics.accumulate(ds_metrics)
+            averages.accumulate(ds_averages)
+            if save_pred:
+                self.save_predictions(ds, predictions)
+        return averages, metrics
+
+    def train_local(self, train_dataset=None, val_dataset=None):
+        """Full local training loop: epochs × batches with validation cadence,
+        best-checkpoint save, early stop, score logging (ref ``:192-243``)."""
+        cache = self.cache
+        epochs = int(cache.get("epochs", 10))
+        local_iterations = int(cache.get("local_iterations", 1))
+        cache.setdefault("train_log", [])
+        cache.setdefault("validation_log", [])
+        if train_dataset is None:
+            train_dataset = self.data_handle.get_train_dataset()
+        if val_dataset is None:
+            val_dataset = self.data_handle.get_validation_dataset()
+
+        for epoch in range(1, epochs + 1):
+            ep_averages, ep_metrics = self.new_averages(), self.new_metrics()
+            loader = self.data_handle.get_loader(
+                "train", dataset=train_dataset, shuffle=True,
+                seed=int(cache.get("seed", 0)), epoch=epoch, drop_last=False,
+            )
+            batch_buf = []
+            for i, batch in enumerate(loader):
+                batch_buf.append(batch)
+                if len(batch_buf) == local_iterations:
+                    aux = self.training_iteration_local(batch_buf)
+                    ep_averages.update(aux["averages"])
+                    if aux["metrics"] is not None:
+                        ep_metrics.update(aux["metrics"])
+                    batch_buf = []
+                    if logger.lazy_debug(i):
+                        logger.info(
+                            f"Ep {epoch}/{epochs} it {i}: loss {float(aux['loss']):.4f}",
+                            cache.get("verbose", True),
+                        )
+            if batch_buf:
+                aux = self.training_iteration_local(batch_buf)
+                ep_averages.update(aux["averages"])
+                if aux["metrics"] is not None:
+                    ep_metrics.update(aux["metrics"])
+            cache["train_log"].append(ep_averages.get() + ep_metrics.get())
+
+            if epoch % int(cache.get("validation_epochs", 1)) == 0 and len(val_dataset):
+                val_averages, val_metrics = self.evaluation(
+                    Mode.VALIDATION, [val_dataset]
+                )
+                cache["validation_log"].append(val_averages.get() + val_metrics.get())
+                self._on_validation_end(epoch, val_averages, val_metrics)
+                if self._stop_early(epoch):
+                    logger.info(f"Early stop at epoch {epoch}", cache.get("verbose", True))
+                    break
+        self._on_train_end()
+        return self
+
+    # ------------------------------------------------------------- user hooks
+    def _on_validation_end(self, epoch, averages, metrics):
+        monitor = self.cache.get("monitor_metric", "f1")
+        try:
+            score = metrics.extract(monitor)
+        except AttributeError:
+            score = averages.average
+        if performance_improved_(epoch, score, self.cache):
+            self.save_checkpoint(name=self.cache.get("best_nn_state", "best.ckpt"))
+
+    def _stop_early(self, epoch):
+        return stop_training_(epoch, self.cache)
+
+    def _on_train_end(self):
+        self.save_checkpoint(name=self.cache.get("latest_nn_state", "latest.ckpt"))
+
+    def save_predictions(self, dataset, predictions):
+        """User hook: persist per-dataset predictions (sparse test mode)."""
+
+    def on_iteration_end(self, it=None):
+        """User hook after each communication round."""

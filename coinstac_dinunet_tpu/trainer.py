@@ -1,0 +1,95 @@
+"""COINNTrainer — federated specialization of the NN runtime.
+
+Capability parity with the reference ``trainer.py:15-80``: federated
+best-model broadcast during pretrain (writes into ``transferDirectory``),
+distributed validation/test producing serialized wire payloads, and a metric
+factory keyed by task shape.
+"""
+import os
+import shutil
+
+from . import config
+from .config.keys import Key, Mode
+from .metrics import new_metrics as _metric_factory
+from .nn.basetrainer import NNTrainer
+from .utils.utils import performance_improved_
+
+
+class COINNTrainer(NNTrainer):
+    """Trainer used by site nodes in a federated run."""
+
+    def _save_if_better(self, epoch, score):
+        """During pretrain, an improved model is written into the transfer
+        directory so the aggregator can broadcast it to every site."""
+        if performance_improved_(epoch, score, self.cache):
+            out = os.path.join(
+                self.state.get("transferDirectory", "."),
+                self.cache.get("best_nn_state", config.weights_file),
+            )
+            self.save_checkpoint(full_path=out)
+            self.cache["weights_file"] = os.path.basename(out)
+            return True
+        return False
+
+    def _on_validation_end(self, epoch, averages, metrics):
+        if self.cache.get("pretrain"):
+            monitor = self.cache.get("monitor_metric", "f1")
+            try:
+                score = metrics.extract(monitor)
+            except AttributeError:
+                score = averages.average
+            self._save_if_better(epoch, score)
+        else:
+            super()._on_validation_end(epoch, averages, metrics)
+
+    # ------------------------------------------------ distributed eval / test
+    def validation_distributed(self):
+        """Run local validation and emit the serialized payload the
+        aggregator reduces across sites (exact count merge)."""
+        averages, metrics = self.evaluation(
+            Mode.VALIDATION, [self.data_handle.get_validation_dataset()]
+        )
+        return {
+            Key.VALIDATION_SERIALIZABLE.value: [
+                {"averages": averages.serialize(), "metrics": metrics.serialize()}
+            ]
+        }
+
+    def test_distributed(self):
+        """Reload the fold's best checkpoint, then test (ref ``trainer.py:52``)."""
+        best = self.cache.get("best_nn_state", "best.ckpt")
+        best_path = self.checkpoint_path(best)
+        if os.path.exists(best_path):
+            self.load_checkpoint(name=best)
+        ds = self.data_handle.get_test_dataset(load_sparse=bool(self.cache.get("load_sparse")))
+        averages, metrics = self.evaluation(
+            Mode.TEST,
+            ds if isinstance(ds, list) else [ds],
+            save_pred=bool(self.cache.get("save_predictions")),
+        )
+        return {
+            Key.TEST_SERIALIZABLE.value: [
+                {"averages": averages.serialize(), "metrics": metrics.serialize()}
+            ]
+        }
+
+    def load_broadcast_weights(self):
+        """Adopt the pretrained weights the aggregator broadcast."""
+        fname = self.input.get("weights_file", self.cache.get("weights_file"))
+        if not fname:
+            return False
+        path = os.path.join(self.state.get("baseDirectory", "."), fname)
+        if os.path.exists(path):
+            self.load_checkpoint(full_path=path, load_optimizer=False)
+            # keep a local copy as the fold's current best
+            shutil.copy(path, self.checkpoint_path(self.cache.get("best_nn_state", "best.ckpt")))
+            return True
+        return False
+
+    def new_metrics(self):
+        """Factory by task shape (ref ``trainer.py:71-80``): binary →
+        Prf1a (or AUC when ``monitor_metric == 'auc'``), multi-class →
+        ConfusionMatrix."""
+        num_classes = int(self.cache.get("num_classes", 2))
+        as_auc = self.cache.get("monitor_metric") == "auc"
+        return _metric_factory(num_classes, binary_as_auc=as_auc)

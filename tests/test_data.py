@@ -166,5 +166,7 @@ def test_batch_at_mask_tracks_dropped_samples(tmp_path):
     ds.add(_files(4))
     loader = COINNDataLoader(ds, batch_size=4)
     b = loader.batch_at(0)
-    assert b["inputs"].shape[0] == 3
-    assert b["_mask"].shape == (3,)
+    # static shapes: failed sample is backfilled with a real one, mask 0
+    assert b["inputs"].shape[0] == 4
+    assert b["_mask"].shape == (4,)
+    assert b["_mask"][0] == 0.0 and b["_mask"].sum() == 3

@@ -1,0 +1,203 @@
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from coinstac_dinunet_tpu.config.keys import Mode
+from coinstac_dinunet_tpu.data import COINNDataHandle, COINNDataset
+from coinstac_dinunet_tpu.metrics import cross_entropy
+from coinstac_dinunet_tpu.nn import NNTrainer
+from coinstac_dinunet_tpu.trainer import COINNTrainer
+
+
+class XorDataset(COINNDataset):
+    """Tiny learnable task: y = x0 xor x1 on noisy ±1 inputs."""
+
+    def __getitem__(self, ix):
+        _, f = self.indices[ix]
+        fid = int(str(f).split("_")[-1])
+        rng = np.random.default_rng(fid)
+        bits = rng.integers(0, 2, size=2)
+        x = (bits * 2 - 1).astype(np.float32) + rng.normal(0, 0.1, 2).astype(np.float32)
+        return {"inputs": x, "labels": np.int32(bits[0] ^ bits[1])}
+
+
+def _mlp():
+    import flax.linen as fnn
+
+    class MLP(fnn.Module):
+        @fnn.compact
+        def __call__(self, x):
+            x = fnn.relu(fnn.Dense(16)(x))
+            return fnn.Dense(2)(x)
+
+    return MLP()
+
+
+class XorTrainer(COINNTrainer):
+    def _init_nn_model(self):
+        self.nn["net"] = _mlp()
+
+    def iteration(self, params, batch, rng=None):
+        logits = self.nn["net"].apply(params["net"], batch["inputs"])
+        mask = batch.get("_mask")
+        loss = cross_entropy(logits, batch["labels"], mask=mask)
+        pred = jnp.argmax(logits, axis=-1)
+        return {"loss": loss, "pred": pred, "true": batch["labels"]}
+
+
+def _trainer(tmp_path, n=32, **cache_extra):
+    datadir = tmp_path / "data"
+    datadir.mkdir(parents=True, exist_ok=True)
+    for i in range(n):
+        (datadir / f"s_{i}").write_text("x")
+    cache = {
+        "task_id": "xor", "data_dir": "data", "split_ratio": [0.7, 0.15, 0.15],
+        "batch_size": 8, "seed": 5, "learning_rate": 5e-2, "epochs": 12,
+        "input_shape": (2,), "metric_direction": "maximize", "patience": 50,
+        "log_dir": str(tmp_path / "logs"), **cache_extra,
+    }
+    state = {"baseDirectory": str(tmp_path), "outputDirectory": str(tmp_path / "out"),
+             "transferDirectory": str(tmp_path / "xfer")}
+    os.makedirs(state["transferDirectory"], exist_ok=True)
+    handle = COINNDataHandle(cache=cache, state=state, dataset_cls=XorDataset)
+    handle.prepare_data()
+    cache["split_ix"] = 0
+    trainer = XorTrainer(cache=cache, state=state, data_handle=handle)
+    trainer.init_nn()
+    return trainer
+
+
+def test_seeded_init_is_deterministic(tmp_path):
+    t1 = _trainer(tmp_path / "a")
+    t2 = _trainer(tmp_path / "b")
+    import jax
+
+    for l1, l2 in zip(jax.tree_util.tree_leaves(t1.train_state.params),
+                      jax.tree_util.tree_leaves(t2.train_state.params)):
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2))
+
+
+def test_train_local_learns_xor(tmp_path):
+    trainer = _trainer(tmp_path)
+    trainer.train_local()
+    averages, metrics = trainer.evaluation(Mode.VALIDATION,
+                                           [trainer.data_handle.get_validation_dataset()])
+    assert metrics.accuracy >= 0.75, f"failed to learn: {metrics.get()}"
+    assert len(trainer.cache["train_log"]) >= 1
+    assert os.path.exists(trainer.checkpoint_path("best.ckpt"))
+
+
+def test_grad_accumulation_matches_big_batch(tmp_path):
+    """mean-of-grads over k micro-batches == grads of concatenated batch."""
+    trainer = _trainer(tmp_path)
+    ds = trainer.data_handle.get_train_dataset()
+    loader = trainer.data_handle.get_loader("train", dataset=ds, batch_size=4)
+    batches = list(loader)[:2]
+    ts = trainer.train_state
+
+    stacked = trainer._stack_batches(batches)
+    grads_accum, _ = trainer.compute_grads(ts, stacked)
+
+    big = {k: np.concatenate([np.asarray(b[k]) for b in batches]) for k in batches[0]}
+    stacked_one = trainer._stack_batches([big])
+    grads_big, _ = trainer.compute_grads(ts, stacked_one)
+
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(grads_accum),
+                    jax.tree_util.tree_leaves(grads_big)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_checkpoint_roundtrip_all_models(tmp_path):
+    class TwoNetTrainer(XorTrainer):
+        def _init_nn_model(self):
+            self.nn["net"] = _mlp()
+            self.nn["aux"] = _mlp()
+
+        def iteration(self, params, batch, rng=None):
+            logits = self.nn["net"].apply(params["net"], batch["inputs"])
+            logits = logits + self.nn["aux"].apply(params["aux"], batch["inputs"])
+            loss = cross_entropy(logits, batch["labels"], mask=batch.get("_mask"))
+            return {"loss": loss, "pred": jnp.argmax(logits, -1), "true": batch["labels"]}
+
+    datadir = tmp_path / "data"
+    datadir.mkdir()
+    for i in range(8):
+        (datadir / f"s_{i}").write_text("x")
+    cache = {"task_id": "t", "split_ratio": [1.0], "data_dir": "data", "batch_size": 4,
+             "seed": 1, "input_shape": (2,), "log_dir": str(tmp_path / "logs")}
+    state = {"baseDirectory": str(tmp_path), "outputDirectory": str(tmp_path / "out")}
+    handle = COINNDataHandle(cache=cache, state=state, dataset_cls=XorDataset)
+    handle.prepare_data()
+    cache["split_ix"] = 0
+    tr = TwoNetTrainer(cache=cache, state=state, data_handle=handle)
+    tr.init_nn()
+
+    path = tr.save_checkpoint(name="both.ckpt")
+    import jax
+
+    before = jax.device_get(tr.train_state.params)
+    # perturb, then restore — BOTH models must come back
+    tr.train_state = tr.train_state.replace(
+        params=jax.tree_util.tree_map(lambda x: x + 1.0, tr.train_state.params)
+    )
+    tr.load_checkpoint(name="both.ckpt")
+    after = jax.device_get(tr.train_state.params)
+    assert set(after.keys()) == {"net", "aux"}
+    for a, b in zip(jax.tree_util.tree_leaves(before), jax.tree_util.tree_leaves(after)):
+        np.testing.assert_allclose(a, b)
+
+
+def test_distributed_validation_payload(tmp_path):
+    trainer = _trainer(tmp_path)
+    out = trainer.validation_distributed()
+    payload = out["validation_serializable"][0]
+    assert "averages" in payload and "metrics" in payload
+    # payload must be JSON-able (wire contract)
+    import json
+
+    json.dumps(payload)
+
+
+def test_save_if_better_writes_to_transfer_dir(tmp_path):
+    trainer = _trainer(tmp_path)
+    trainer.cache["pretrain"] = True
+    averages, metrics = trainer.evaluation(
+        Mode.VALIDATION, [trainer.data_handle.get_validation_dataset()])
+    trainer._on_validation_end(1, averages, metrics)
+    xfer = trainer.state["transferDirectory"]
+    assert any(f.endswith((".ckpt", ".npy")) or "weights" in f for f in os.listdir(xfer))
+
+
+def test_loader_keeps_static_shapes_with_failed_samples(tmp_path):
+    """A dropped sample must not shrink the batch (jit static shapes)."""
+    from coinstac_dinunet_tpu.data import COINNDataLoader
+
+    class Flaky(XorDataset):
+        def __getitem__(self, ix):
+            if ix == 1:
+                return None
+            return super().__getitem__(ix)
+
+    ds = Flaky()
+    ds.add([f"s_{i}" for i in range(8)])
+    for b in COINNDataLoader(ds, batch_size=4):
+        assert b["inputs"].shape == (4, 2)
+    first = COINNDataLoader(ds, batch_size=4).batch_at(0)
+    assert first["inputs"].shape == (4, 2)
+    assert first["_mask"][1] == 0.0 and first["_mask"].sum() == 3
+
+
+def test_checkpoint_restores_step(tmp_path):
+    import jax.numpy as jnp
+
+    trainer = _trainer(tmp_path)
+    trainer.train_state = trainer.train_state.replace(step=jnp.asarray(500, jnp.int32))
+    trainer.save_checkpoint(name="stepped.ckpt")
+    trainer.train_state = trainer.train_state.replace(step=jnp.asarray(0, jnp.int32))
+    trainer.load_checkpoint(name="stepped.ckpt")
+    assert int(trainer.train_state.step) == 500
