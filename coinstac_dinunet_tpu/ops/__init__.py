@@ -1,0 +1,11 @@
+"""Compiled math kernels (the framework's "native layer").
+
+The reference delegates its hot numerical work to torch CUDA kernels
+(power iteration / orthogonalization: ``rankdad/spi.py:9-86``,
+``powersgd/__init__.py:15-38``).  Here the equivalents are XLA-compiled
+jax functions (with a Pallas TPU kernel path for the hottest op) — see
+SURVEY.md §2 ("Consequence for the TPU build").
+"""
+from .power_iteration import orthogonalize, power_iteration_BC  # noqa: F401
+
+__all__ = ["power_iteration_BC", "orthogonalize"]

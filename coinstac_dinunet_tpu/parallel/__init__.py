@@ -1,0 +1,21 @@
+"""Distributed algorithms (agg engines) + the mesh transport.
+
+Site-side :class:`COINNLearner` and aggregator-side :class:`COINNReducer`
+(dSGD), with gradient-compressed variants (PowerSGD, rankDAD) — capability
+parity with the reference ``distrib/`` package, plus :mod:`.mesh`, the
+TPU-native transport where simulated sites are ranks on a
+``jax.sharding.Mesh`` and a whole federated round is ONE compiled step.
+"""
+from .learner import COINNLearner  # noqa: F401
+from .reducer import COINNReducer  # noqa: F401
+from .powersgd import PowerSGDLearner, PowerSGDReducer  # noqa: F401
+from .rankdad import DADLearner, DADReducer  # noqa: F401
+
+__all__ = [
+    "COINNLearner",
+    "COINNReducer",
+    "PowerSGDLearner",
+    "PowerSGDReducer",
+    "DADLearner",
+    "DADReducer",
+]

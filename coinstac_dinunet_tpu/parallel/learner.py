@@ -1,0 +1,118 @@
+"""COINNLearner — site-side half of a federated round (dSGD baseline).
+
+Capability parity with the reference ``distrib/learner.py:9-59``:
+``backward`` runs ``local_iterations`` micro-batches of forward/backward,
+``to_reduce`` ships gradients to the aggregator, ``step`` applies the averaged
+gradients that came back.  TPU-first differences:
+
+- ``backward`` is ONE jit-compiled call (``trainer.compute_grads`` — grad
+  accumulation is a ``lax.scan``), not a Python loop of ``loss.backward()``.
+- Gradients are a pytree over **all** models in the scheme; the reference
+  ships only the first model's grads (``learner.py:24-29,51-53`` — a known
+  defect, SURVEY §2).
+- The wire format is the packed tensor payload of ``utils.tensorutils``
+  (manifest + contiguous buffers), not pickled object-dtype ``.npy``.
+"""
+import os
+
+from .. import config
+from ..config.keys import Key, Mode
+from ..utils import tensorutils
+
+
+class COINNLearner:
+    """Baseline distributed-SGD learner (one per site node)."""
+
+    def __init__(self, trainer=None, mp_pool=None, **kw):
+        self.trainer = trainer
+        self.mp_pool = mp_pool  # accepted for API parity; IO here is async-free
+        self.cache = trainer.cache
+        self.input = trainer.input
+        self.state = trainer.state
+        self.global_modes = self.input.get("global_modes", {})
+
+    # ------------------------------------------------------------------ wire
+    @property
+    def precision_bits(self):
+        return self.cache.get("precision_bits", config.default_precision_bits)
+
+    def _transfer_path(self, fname):
+        d = self.state.get("transferDirectory", ".")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, fname)
+
+    def _base_path(self, fname):
+        return os.path.join(self.state.get("baseDirectory", "."), fname)
+
+    # ------------------------------------------------------------- site steps
+    def step(self):
+        """Apply the averaged gradients broadcast by the aggregator, then one
+        optimizer step (≙ ref ``learner.py:20-30`` — but via a compiled
+        ``apply_grads`` and across ALL models)."""
+        out = {}
+        fname = self.input.get("avg_grads_file", config.avg_grads_file)
+        flat = tensorutils.load_arrays(self._base_path(fname))
+        ts = self.trainer.train_state
+        grads = tensorutils.grads_like(ts.params, flat)
+        self.trainer.train_state = self.trainer.apply_grads(ts, grads)
+        return out
+
+    def backward(self):
+        """Accumulate gradients over up to ``local_iterations`` batches.
+
+        Returns ``(grads, out, aux)``; ``grads is None`` means the epoch is
+        exhausted and ``out['mode']`` carries the barrier signal
+        (VALIDATION_WAITING)."""
+        out = {}
+        batches = []
+        k = int(self.cache.get("local_iterations", 1))
+        for _ in range(k):
+            batch, nxt = self.trainer.data_handle.next_iter()
+            out.update(nxt)
+            if batch is None:
+                break
+            batches.append(batch)
+        if not batches:
+            return None, out, None
+        stacked = self.trainer._stack_batches(batches)
+        ts = self.trainer.train_state
+        grads, aux = self.trainer.compute_grads(ts, stacked)
+        self.trainer.train_state = ts.replace(rng=aux["rng"])
+        return grads, out, aux
+
+    def to_reduce(self):
+        """Compute local grads and ship them (≙ ref ``learner.py:49-59``)."""
+        grads, out, aux = self.backward()
+        if grads is None:
+            return out
+        flat = tensorutils.extract_grads(grads, self.precision_bits)
+        tensorutils.save_arrays(self._transfer_path(config.grads_file), flat)
+        out["grads_file"] = config.grads_file
+        out["reduce"] = True
+        self._track_train_scores(aux)
+        return out
+
+    # --------------------------------------------------------------- tracking
+    def _track_train_scores(self, aux):
+        """Fold the compiled step's metric/average states into the epoch-level
+        accumulators living in the cache (serialized at the epoch barrier)."""
+        if aux is None:
+            return
+        averages = self.cache.get("_ep_averages")
+        metrics = self.cache.get("_ep_metrics")
+        if averages is None:
+            averages = self.cache["_ep_averages"] = self.trainer.new_averages()
+            metrics = self.cache["_ep_metrics"] = self.trainer.new_metrics()
+        averages.update(aux["averages"])
+        if aux.get("metrics") is not None and metrics.jit_safe:
+            metrics.update(aux["metrics"])
+
+    def train_serializable(self):
+        """Pop the epoch accumulators as a wire payload (epoch barrier)."""
+        averages = self.cache.pop("_ep_averages", None) or self.trainer.new_averages()
+        metrics = self.cache.pop("_ep_metrics", None) or self.trainer.new_metrics()
+        return {
+            Key.TRAIN_SERIALIZABLE.value: [
+                {"averages": averages.serialize(), "metrics": metrics.serialize()}
+            ]
+        }

@@ -1,0 +1,252 @@
+"""Mesh transport — federated sites as ranks on a ``jax.sharding.Mesh``.
+
+This is the TPU-native inversion of the reference's file+JSON gradient plane
+(SURVEY.md §2 "Distributed communication backend"): when simulated sites live
+on one pod (slice), a whole dSGD round — N sites' forward/backward, gradient
+averaging, and the synchronized optimizer step — is ONE jit-compiled
+``shard_map`` step whose cross-site mean lowers to an XLA ``psum`` over ICI.
+The compression engines collapse too: PowerSGD's two wire rounds become two
+in-step collectives (mean-P, mean-Q); rankDAD's sample-axis concat becomes an
+``all_gather`` of the per-site factors.
+
+Mesh axes:
+- ``site``  — one rank per federated site (≙ one ``COINNLocal`` process).
+- ``device`` — intra-site data parallelism over the site's chips
+  (≙ the reference's ``torch.nn.DataParallel``, ``nn/basetrainer.py:66-69``).
+
+Control (epoch barriers, validation cadence, early stop, fold rotation) stays
+host-side in :class:`MeshFederation`'s caller — see ``nodes/``; only the hot
+gradient plane is compiled here.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import orthogonalize
+
+
+def build_site_mesh(n_sites, devices=None, devices_per_site=None):
+    """Mesh of shape (site, device) over the available devices.
+
+    Each site gets ``devices_per_site`` chips (default: as many as fit
+    evenly); a 32-site config on a v4-32 maps sites across slices via DCN
+    transparently — the axes are logical.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if devices_per_site is None:
+        devices_per_site = max(len(devices) // n_sites, 1)
+    need = n_sites * devices_per_site
+    if need > len(devices):
+        raise ValueError(
+            f"Mesh needs {need} devices ({n_sites} sites × {devices_per_site}); "
+            f"only {len(devices)} available."
+        )
+    arr = np.array(devices[:need]).reshape(n_sites, devices_per_site)
+    return Mesh(arr, ("site", "device"))
+
+
+class MeshFederation:
+    """Drives federated rounds where the gradient plane is XLA collectives.
+
+    Wraps a :class:`~..nn.basetrainer.NNTrainer`; the trainer's pure pieces
+    (``_grads_uncompiled``, ``_apply_updates``) are composed into one
+    ``shard_map``-ped step so nothing leaves the devices between a site's
+    backward pass and the globally averaged update.
+
+    Replication invariant: params/opt_state/rng stay bitwise identical across
+    sites (identical init + identical averaged update — the reference's
+    weight-sync-by-construction, SURVEY §3.3); only the per-site
+    error-feedback state (PowerSGD) is site-sharded.
+    """
+
+    def __init__(self, trainer, n_sites, agg_engine="dSGD", devices=None,
+                 devices_per_site=None):
+        self.trainer = trainer
+        self.n_sites = int(n_sites)
+        self.agg_engine = str(agg_engine)
+        self.mesh = build_site_mesh(self.n_sites, devices, devices_per_site)
+        self.comm_state = {}  # site-sharded engine state (PowerSGD EF memory)
+        self._hi_ix = None  # static: flat-leaf indices compressed by PowerSGD
+        self._step = None
+        self._eval = None
+
+    # -------------------------------------------------------------- batching
+    def stack_site_batches(self, per_site_batches):
+        """[site → list of k micro-batches] → pytree with leading (site, k)
+        axes, placed with the step's input sharding (site-sharded, batch dim
+        split over the device axis)."""
+        stacked = [self.trainer._stack_batches(b) for b in per_site_batches]
+        glob = {k: jnp.stack([s[k] for s in stacked]) for k in stacked[0]}
+        shardings = {
+            k: NamedSharding(self.mesh, P("site", None, "device")) for k in glob
+        }
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), glob, shardings
+        )
+
+    # ------------------------------------------------------- powerSGD state
+    def init_powersgd_state(self, rank=1, seed=0):
+        """Per-site error-feedback + warm-start Q for every ≥2-D leaf.
+
+        Stored with a leading ``site`` axis; Qs start identical at every site
+        (seeded — ref ``powersgd/__init__.py:101-107``) and stay identical
+        because both wire rounds end in a mean."""
+        leaves = jax.tree_util.tree_leaves(self.trainer.train_state.params)
+        self._hi_ix = tuple(i for i, l in enumerate(leaves) if l.ndim >= 2)
+        errors, qs = [], []
+        for i in self._hi_ix:
+            leaf = leaves[i]
+            m = (leaf.shape[0], int(np.prod(leaf.shape[1:])))
+            errors.append(jnp.zeros((self.n_sites, *m), jnp.float32))
+            key = jax.random.PRNGKey(int(seed) * 1000 + i)
+            q = jax.random.normal(key, (m[1], rank), jnp.float32)
+            qs.append(jnp.tile(q[None], (self.n_sites, 1, 1)))
+        self.comm_state = {"errors": errors, "qs": qs}
+        return self.comm_state
+
+    # ---------------------------------------------------------- compiled step
+    def _build_step(self):
+        trainer = self.trainer
+        metrics_shell, averages_shell = trainer._metrics_shell()
+        engine = self.agg_engine
+        hi_ix = self._hi_ix
+
+        def _powersgd_exchange(grads, comm):
+            """Both PowerSGD wire rounds as in-step collectives."""
+            leaves, treedef = jax.tree_util.tree_flatten(grads)
+            new_err, new_q, out = [], [], list(leaves)
+            for j, i in enumerate(hi_ix):
+                leaf = leaves[i]
+                m2 = leaf.reshape(leaf.shape[0], -1).astype(jnp.float32)
+                m2 = jax.lax.pmean(m2, "device")  # intra-site DP first
+                # comm leaves keep their (sharded, now size-1) site axis
+                M = m2 + comm["errors"][j][0]
+                p = jax.lax.pmean(M @ comm["qs"][j][0], "site")  # wire round 1
+                phat = orthogonalize(p)
+                qn = jax.lax.pmean(M.T @ phat, "site")  # wire round 2
+                recon = phat @ qn.T
+                new_err.append((M - recon)[None])
+                new_q.append(qn[None])
+                out[i] = recon.reshape(leaf.shape).astype(leaf.dtype)
+            lo = set(hi_ix)
+            for i in range(len(out)):
+                if i not in lo:
+                    out[i] = jax.lax.pmean(leaves[i], ("site", "device"))
+            grads = jax.tree_util.tree_unflatten(treedef, out)
+            return grads, {"errors": new_err, "qs": new_q}
+
+        def site_step(ts, stacked, comm):
+            orig_rng = ts.rng
+            # per-site decorrelated randomness for the forward pass…
+            ts = ts.replace(rng=jax.random.fold_in(orig_rng, jax.lax.axis_index("site")))
+            grads, aux = trainer._grads_uncompiled(
+                ts, stacked, metrics_shell, averages_shell
+            )
+            if engine == "powerSGD":
+                grads, comm = _powersgd_exchange(grads, comm)
+            else:
+                grads = jax.lax.pmean(grads, ("site", "device"))
+            ts = trainer._apply_updates(ts, grads)
+            # …but the carried rng advances identically everywhere, keeping
+            # the train state bitwise replicated across sites
+            ts = ts.replace(rng=jax.random.split(orig_rng)[0])
+            aux = dict(aux)
+            if aux.get("metrics") is not None:
+                aux["metrics"] = jax.lax.psum(aux["metrics"], ("site", "device"))
+            aux["averages"] = jax.lax.psum(aux["averages"], ("site", "device"))
+            aux["loss"] = jax.lax.pmean(aux["loss"], ("site", "device"))
+            aux["rng"] = ts.rng
+            return ts, aux, comm
+
+        comm_spec = jax.tree_util.tree_map(lambda _: P("site"), self.comm_state)
+        batch_spec = P("site", None, "device")
+        mesh = self.mesh
+
+        @jax.jit
+        def step(ts, stacked, comm):
+            return jax.shard_map(
+                site_step,
+                mesh=mesh,
+                in_specs=(P(), batch_spec, comm_spec),
+                out_specs=(P(), P(), comm_spec),
+                check_vma=False,
+            )(ts, stacked, comm)
+
+        return step
+
+    def train_step(self, site_batches):
+        """One federated round: per-site grad accumulation, cross-site
+        aggregation, synchronized update — a single compiled call."""
+        if self._step is None:
+            if self.agg_engine == "powerSGD" and not self.comm_state:
+                self.init_powersgd_state(
+                    rank=int(self.trainer.cache.get("matrix_approximation_rank", 1)),
+                    seed=int(self.trainer.cache.get("seed", 0)),
+                )
+            self._step = self._build_step()
+        stacked = (
+            self.stack_site_batches(site_batches)
+            if isinstance(site_batches, (list, tuple))
+            else site_batches
+        )
+        ts, aux, self.comm_state = self._step(
+            self.trainer.train_state, stacked, self.comm_state
+        )
+        self.trainer.train_state = ts
+        return aux
+
+    # ------------------------------------------------------------- evaluation
+    def _build_eval(self):
+        trainer = self.trainer
+        metrics_shell, averages_shell = trainer._metrics_shell()
+        mesh = self.mesh
+
+        def site_eval(ts, batch):
+            it = trainer.iteration(ts.params, batch, None)
+            m_state, a_state = trainer._step_outputs(
+                it, batch, metrics_shell, averages_shell
+            )
+            if m_state is not None:
+                m_state = jax.lax.psum(m_state, ("site", "device"))
+            a_state = jax.lax.psum(a_state, ("site", "device"))
+            return m_state, a_state
+
+        @jax.jit
+        def ev(ts, batch):
+            return jax.shard_map(
+                site_eval,
+                mesh=mesh,
+                in_specs=(P(), P("site", "device")),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )(ts, batch)
+
+        return ev
+
+    def eval_step(self, site_batches):
+        """Globally-reduced evaluation over one batch per site."""
+        if self._eval is None:
+            self._eval = self._build_eval()
+        if isinstance(site_batches, (list, tuple)):
+            glob = {
+                k: jnp.stack([jnp.asarray(b[k]) for b in site_batches])
+                for k in site_batches[0]
+            }
+        else:
+            glob = site_batches
+        shardings = {
+            k: NamedSharding(self.mesh, P("site", "device")) for k in glob
+        }
+        glob = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), glob, shardings
+        )
+        return self._eval(self.trainer.train_state, glob)
+
+
+def lockstep_batches(n_sites, site_sizes, batch_size):
+    """Equal-length epochs for every site (≙ the padded sampler invariant,
+    ref ``data/data.py:203-242``): global batches per epoch = ceil(max/B)."""
+    return max(math.ceil(s / batch_size) for s in site_sizes)

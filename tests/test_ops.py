@@ -1,0 +1,73 @@
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from coinstac_dinunet_tpu.ops import orthogonalize, power_iteration_BC
+
+
+def test_orthogonalize_columns_orthonormal():
+    m = jnp.asarray(np.random.default_rng(0).normal(size=(32, 5)))
+    q = orthogonalize(m)
+    np.testing.assert_allclose(np.asarray(q.T @ q), np.eye(5), atol=1e-6)
+
+
+def test_orthogonalize_rank1_is_normalize():
+    v = jnp.asarray(np.random.default_rng(1).normal(size=(16, 1)))
+    q = orthogonalize(v)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(q)), 1.0, rtol=1e-6)
+
+
+def test_power_iteration_exact_when_n_below_rank():
+    rng = np.random.default_rng(2)
+    B = jnp.asarray(rng.normal(size=(6, 20)), jnp.float32)
+    C = jnp.asarray(rng.normal(size=(6, 30)), jnp.float32)
+    Br, Cr = power_iteration_BC(B, C, jax.random.PRNGKey(0), rank=10)
+    assert Br.shape == (10, 20) and Cr.shape == (10, 30)
+    np.testing.assert_allclose(
+        np.asarray(Br.T @ Cr), np.asarray(B.T @ C), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_power_iteration_recovers_low_rank_product():
+    """If Bᵀ C has true rank r, rank-r factors reproduce it (near-)exactly."""
+    rng = np.random.default_rng(3)
+    r_true = 4
+    # build B, C sharing an r_true-dimensional sample subspace
+    U = np.linalg.qr(rng.normal(size=(64, r_true)))[0]
+    B = jnp.asarray(U @ rng.normal(size=(r_true, 24)), jnp.float32)
+    C = jnp.asarray(U @ rng.normal(size=(r_true, 40)), jnp.float32)
+    Br, Cr = power_iteration_BC(B, C, jax.random.PRNGKey(1), rank=r_true,
+                                iterations=10)
+    G, G_hat = np.asarray(B.T @ C), np.asarray(Br.T @ Cr)
+    rel = np.linalg.norm(G - G_hat) / np.linalg.norm(G)
+    assert rel < 1e-3, f"relative error {rel}"
+
+
+def test_power_iteration_truncation_close_to_svd_optimum():
+    """Rank-r approximation error should be within a factor of the optimal
+    SVD truncation error (subspace iteration converges to top subspace)."""
+    rng = np.random.default_rng(4)
+    B = jnp.asarray(rng.normal(size=(128, 32)), jnp.float32)
+    C = jnp.asarray(rng.normal(size=(128, 48)), jnp.float32)
+    rank = 8
+    Br, Cr = power_iteration_BC(B, C, jax.random.PRNGKey(2), rank=rank,
+                                iterations=15)
+    G = np.asarray(B.T @ C)
+    err = np.linalg.norm(G - np.asarray(Br.T @ Cr))
+    s = np.linalg.svd(G, compute_uv=False)
+    opt = np.sqrt((s[rank:] ** 2).sum())
+    assert err <= 2.5 * opt + 1e-6, f"err {err} vs optimal {opt}"
+
+
+def test_power_iteration_jits_inside_outer_jit():
+    B = jnp.ones((16, 8), jnp.float32)
+    C = jnp.ones((16, 4), jnp.float32)
+
+    @jax.jit
+    def f(b, c, k):
+        return power_iteration_BC(b, c, k, rank=2, iterations=3)
+
+    Br, Cr = f(B, C, jax.random.PRNGKey(0))
+    assert Br.shape == (2, 8) and Cr.shape == (2, 4)
