@@ -8,14 +8,20 @@ stopping, and training-curve logging.  Two transports share one set of
 compiled kernels:
 
 - **mesh transport** — simulated sites are ranks on a ``jax.sharding.Mesh``;
-  the gradient plane lowers to XLA collectives over ICI/DCN.
+  the gradient plane lowers to XLA collectives over ICI/DCN
+  (:class:`~.parallel.mesh.MeshFederation`).
 - **engine transport** — the reference-compatible file+JSON protocol driven by
-  an external engine (or the bundled in-process simulator).
+  an external engine or the bundled in-process simulator
+  (:class:`~.engine.InProcessEngine`).
+
+Top-level exports mirror the reference package surface
+(``coinstac_dinunet/__init__.py:11-14``) plus the TPU-native additions.
 """
 __version__ = "0.1.0"
 
 from .config import keys  # noqa: F401
 from .data import COINNDataHandle, COINNDataset  # noqa: F401
+from .engine import InProcessEngine, SiteRunner  # noqa: F401
 from .metrics import (  # noqa: F401
     AUCROCMetrics,
     COINNAverages,
@@ -23,10 +29,24 @@ from .metrics import (  # noqa: F401
     ConfusionMatrix,
     Prf1a,
 )
+from .nn import NNTrainer  # noqa: F401
+from .nodes import COINNLocal, COINNRemote  # noqa: F401
+from .parallel import COINNLearner, COINNReducer  # noqa: F401
+from .parallel.mesh import MeshFederation  # noqa: F401
+from .trainer import COINNTrainer  # noqa: F401
 
 __all__ = [
     "COINNDataset",
     "COINNDataHandle",
+    "COINNLearner",
+    "COINNReducer",
+    "COINNLocal",
+    "COINNRemote",
+    "COINNTrainer",
+    "NNTrainer",
+    "MeshFederation",
+    "InProcessEngine",
+    "SiteRunner",
     "COINNMetrics",
     "COINNAverages",
     "Prf1a",

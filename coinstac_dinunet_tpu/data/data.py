@@ -259,6 +259,8 @@ class COINNDataHandle:
             cursor += 1
         if batch is None:
             self.cache["cursor"] = 0
+            # epoch rollover: next epoch reshuffles with a fresh (seed, epoch)
+            self.cache["epoch"] = int(self.cache.get("epoch", 0)) + 1
             out["mode"] = Mode.VALIDATION_WAITING.value
             return None, out
         self.cache["cursor"] = cursor

@@ -1,0 +1,181 @@
+"""In-process federation engine (simulator) + single-site runner.
+
+The reference has **no network code**: an external COINSTAC engine (Node.js)
+invokes each node with ``cache``/``input``/``state`` dicts and relays each
+node's ``output`` JSON plus dropped transfer files (SURVEY.md §0).
+:class:`InProcessEngine` reproduces that contract in one Python process — it
+is the multi-node test backbone (SURVEY §4 "golden-file protocol tests" gap)
+and the engine-transport benchmark driver.  :class:`SiteRunner` is the
+single-site no-engine debug harness (≙ ref ``site_runner.py:8-45``).
+
+Directory layout per site ``i`` under ``workdir``::
+
+    site_<i>/            baseDirectory   (site's private data + inbox)
+    site_<i>/out         outputDirectory
+    remote_base/site_<i> site's transferDirectory == aggregator's inbox
+    remote_xfer          aggregator's transferDirectory (broadcast outbox)
+"""
+import os
+import shutil
+
+from .config.keys import Mode, Phase
+from .data import COINNDataHandle
+from .nodes import COINNLocal, COINNRemote
+from .trainer import COINNTrainer
+from .utils import logger
+
+
+class InProcessEngine:
+    """Runs N site nodes + one aggregator, relaying outputs and files."""
+
+    def __init__(self, workdir, n_sites, trainer_cls=COINNTrainer,
+                 dataset_cls=None, datahandle_cls=COINNDataHandle,
+                 remote_trainer_cls=None, learner_cls=None, reducer_cls=None,
+                 site_args=None, **args):
+        self.workdir = str(workdir)
+        self.n_sites = int(n_sites)
+        self.trainer_cls = trainer_cls
+        self.remote_trainer_cls = remote_trainer_cls or trainer_cls
+        self.dataset_cls = dataset_cls
+        self.datahandle_cls = datahandle_cls
+        self.learner_cls = learner_cls
+        self.reducer_cls = reducer_cls
+        self.args = args
+        self.site_args = site_args or {}
+
+        self.site_ids = [f"site_{i}" for i in range(self.n_sites)]
+        self.site_caches = {s: {} for s in self.site_ids}
+        self.remote_cache = {}
+        self.site_states = {}
+        for s in self.site_ids:
+            base = os.path.join(self.workdir, s)
+            xfer = os.path.join(self.workdir, "remote_base", s)
+            outd = os.path.join(base, "out")
+            for d in (base, xfer, outd):
+                os.makedirs(d, exist_ok=True)
+            self.site_states[s] = {
+                "baseDirectory": base,
+                "outputDirectory": outd,
+                "transferDirectory": xfer,
+                "clientId": s,
+            }
+        self.remote_state = {
+            "baseDirectory": os.path.join(self.workdir, "remote_base"),
+            "transferDirectory": os.path.join(self.workdir, "remote_xfer"),
+            "outputDirectory": os.path.join(self.workdir, "remote_out"),
+        }
+        for d in self.remote_state.values():
+            os.makedirs(d, exist_ok=True)
+
+        self.site_inputs = {s: {} for s in self.site_ids}
+        self.rounds = 0
+        self.success = False
+        self.last_remote_out = {}
+
+    def site_data_dir(self, site_id, data_dir="data"):
+        d = os.path.join(self.site_states[site_id]["baseDirectory"], data_dir)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # ------------------------------------------------------------- one round
+    def step_round(self):
+        """One full engine round: every site computes, files relay to the
+        aggregator, the aggregator computes, its output + files relay back."""
+        site_outs = {}
+        for s in self.site_ids:
+            node = COINNLocal(
+                cache=self.site_caches[s],
+                input=self.site_inputs[s],
+                state=self.site_states[s],
+                **{**self.args, **self.site_args.get(s, {})},
+            )
+            result = node(
+                trainer_cls=self.trainer_cls,
+                dataset_cls=self.dataset_cls,
+                datahandle_cls=self.datahandle_cls,
+                learner_cls=self.learner_cls,
+            )
+            site_outs[s] = result["output"]
+
+        remote = COINNRemote(
+            cache=self.remote_cache, input=site_outs, state=self.remote_state
+        )
+        result = remote(
+            trainer_cls=self.remote_trainer_cls, reducer_cls=self.reducer_cls
+        )
+        remote_out = result["output"]
+        self.success = bool(result.get("success"))
+        self.last_remote_out = remote_out
+
+        # relay aggregator transfer files into every site's inbox
+        xfer = self.remote_state["transferDirectory"]
+        for f in os.listdir(xfer):
+            for s in self.site_ids:
+                shutil.copy(
+                    os.path.join(xfer, f),
+                    os.path.join(self.site_states[s]["baseDirectory"], f),
+                )
+        self.site_inputs = {s: dict(remote_out) for s in self.site_ids}
+        self.rounds += 1
+        return site_outs, remote_out
+
+    def run(self, max_rounds=100000, verbose=False):
+        """Drive rounds until the aggregator reports SUCCESS."""
+        while not self.success and self.rounds < max_rounds:
+            _, remote_out = self.step_round()
+            if verbose and logger.lazy_debug(self.rounds):
+                logger.info(
+                    f"round {self.rounds}: phase={remote_out.get('phase')} "
+                    f"epoch={self.remote_cache.get('epoch')}",
+                    True,
+                )
+        return self
+
+
+class SiteRunner:
+    """Single-site, no-engine debug harness (≙ ref ``SiteRunner``): drives a
+    site through INIT_RUNS then NEXT_RUN with ``pretrain=True`` so the full
+    local training loop runs without any aggregator."""
+
+    def __init__(self, workdir, task_id="task", site_id="local0", **args):
+        self.workdir = str(workdir)
+        base = os.path.join(self.workdir, "input", site_id, "simulatorRun")
+        outd = os.path.join(self.workdir, "output", site_id)
+        xfer = os.path.join(self.workdir, "transfer", site_id)
+        for d in (base, outd, xfer):
+            os.makedirs(d, exist_ok=True)
+        self.state = {
+            "baseDirectory": base,
+            "outputDirectory": outd,
+            "transferDirectory": xfer,
+            "clientId": site_id,
+        }
+        args.setdefault("task_id", task_id)
+        args.setdefault("pretrain_args", {"epochs": args.get("epochs", 10)})
+        self.args = args
+        self.cache = {}
+
+    @property
+    def data_dir(self):
+        d = os.path.join(self.state["baseDirectory"], self.args.get("data_dir", "data"))
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def run(self, trainer_cls, dataset_cls=None, datahandle_cls=COINNDataHandle):
+        node = COINNLocal(cache=self.cache, input={}, state=self.state, **self.args)
+        node(trainer_cls=trainer_cls, dataset_cls=dataset_cls,
+             datahandle_cls=datahandle_cls)
+
+        seed = self.cache.get("seed", 0)
+        nxt = {
+            "phase": Phase.NEXT_RUN.value,
+            "global_runs": {
+                self.state["clientId"]: {
+                    "split_ix": "0", "seed": seed, "pretrain": True,
+                }
+            },
+        }
+        node = COINNLocal(cache=self.cache, input=nxt, state=self.state, **self.args)
+        out = node(trainer_cls=trainer_cls, dataset_cls=dataset_cls,
+                   datahandle_cls=datahandle_cls)
+        return out["output"]

@@ -1,0 +1,40 @@
+"""Node orchestration: the federated phase state machines.
+
+:class:`COINNLocal` (site) and :class:`COINNRemote` (aggregator) — capability
+parity with the reference ``distrib/nodes/`` — drive training through the
+INIT_RUNS → NEXT_RUN → PRE_COMPUTATION → COMPUTATION → NEXT_RUN_WAITING →
+SUCCESS lifecycle, exchanging JSON control messages and wire files.  The same
+vocabulary drives the in-process simulator (:mod:`..engine`) and an external
+COINSTAC-style engine.
+"""
+
+
+def check(logic, k, v, inputs):
+    """``logic`` (all/any) of sites' ``inputs[site][k] == v``
+    (≙ ref ``remote.py:51-55``)."""
+    return logic(
+        str(site_vars.get(k)) == str(v) for site_vars in inputs.values()
+    ) if inputs else False
+
+
+def gather(keys, dicts, mode="append"):
+    """Collect ``keys`` across a list of dicts (≙ ref ``_gather``,
+    ``remote.py:29-48``): 'append' keeps one entry per dict, 'extend'
+    flattens list values."""
+    out = {k: [] for k in keys}
+    for d in dicts:
+        for k in keys:
+            v = d.get(k)
+            if v is None:
+                continue
+            if mode == "extend" and isinstance(v, list):
+                out[k].extend(v)
+            else:
+                out[k].append(v)
+    return out
+
+
+from .local import COINNLocal  # noqa: F401,E402
+from .remote import COINNRemote  # noqa: F401,E402
+
+__all__ = ["COINNLocal", "COINNRemote", "check", "gather"]

@@ -1,0 +1,289 @@
+"""COINNLocal — the site-side phase state machine + argument pipeline.
+
+Capability parity with the reference ``distrib/nodes/local.py:25-295``:
+constructor holds the hyperparameter defaults; first invocation resolves the
+three-tier override (engine/compspec ``input`` > ``<task_id>_args`` >
+``<agg_engine>_args`` > constructor defaults) and freezes a ``shared_args``
+snapshot for the aggregator; then every invocation advances the phase machine
+(INIT_RUNS → NEXT_RUN [+pretrain] → PRE_COMPUTATION → COMPUTATION →
+NEXT_RUN_WAITING → SUCCESS).
+
+TPU-first notes: the learner's backward is a compiled scan (no per-batch
+Python), fold re-init clears engine state + compiled caches, and the
+aggregator broadcasts ``target_batches`` so every site's padded loader runs
+equal-length lockstep epochs (replacing the reference's wrap-around sampler).
+"""
+import os
+import shutil
+import time
+import traceback
+
+from .. import config, utils
+from ..config.keys import AggEngine, Key, Mode, Phase
+from ..data import COINNDataHandle
+from ..parallel import COINNLearner, DADLearner, PowerSGDLearner
+from ..utils import logger
+
+# engine/epoch state cleared on every fold transition
+_EPHEMERAL_KEYS = (
+    "_powersgd_state", "_rankdad_state", "_ep_averages", "_ep_metrics",
+    "_train_state", "cursor", "epoch",
+)
+
+
+class COINNLocal:
+    """One federated site (≙ ref ``COINNLocal``)."""
+
+    _ARG_DEFAULTS = dict(
+        task_id="task",
+        mode=Mode.TRAIN.value,
+        batch_size=16,
+        local_iterations=1,
+        epochs=31,
+        validation_epochs=1,
+        learning_rate=1e-3,
+        load_limit=None,
+        load_sparse=False,
+        pretrained_path=None,
+        pretrain_args=None,
+        patience=None,
+        num_folds=None,
+        split_ratio=None,
+        split_files=None,
+        monitor_metric="f1",
+        metric_direction="maximize",
+        log_header="loss|precision,recall,f1,accuracy",
+        agg_engine=AggEngine.DSGD.value,
+        precision_bits=config.default_precision_bits,
+        num_classes=2,
+        num_averages=1,
+        seed=None,
+        verbose=False,
+        # engine-specific knobs (present so they freeze into shared_args)
+        matrix_approximation_rank=1,
+        start_powerSGD_iter=10,
+        dad_reduction_rank=10,
+        dad_num_pow_iters=5,
+        dataloader_args=None,
+    )
+
+    def __init__(self, cache=None, input=None, state=None, **kw):
+        self.out = {}
+        self.cache = cache if cache is not None else {}
+        self.input = utils.FrozenDict(input or {})
+        self.state = utils.FrozenDict(state or {})
+        self._args = dict(self._ARG_DEFAULTS)
+        for k, v in kw.items():
+            self._args[k] = v  # constructor overrides become new defaults
+        if not self.cache.get(Key.ARGS_CACHED):
+            self._resolve_args()
+            self.cache[Key.ARGS_CACHED.value] = True
+
+    # ----------------------------------------------------------- arg pipeline
+    def _resolve_args(self):
+        """Three-tier override, highest priority last
+        (≙ ref ``local.py:92-118``)."""
+        args = dict(self._args)
+        task_id = self.input.get("task_id", args.get("task_id"))
+        args.update(self.input.get(f"{args.get('agg_engine')}_args", {}) or {})
+        args.update(self.input.get(f"{task_id}_args", {}) or {})
+        for k in self._args:
+            if k in self.input:
+                args[k] = self.input[k]
+        data_conf = self.input.get(
+            f"{task_id}_data_conf", self.input.get("data_conf", {})
+        )
+        self.cache.update(args)
+        self.cache["data_conf"] = dict(data_conf or {})
+        if self.cache.get("seed") is None:
+            self.cache["seed"] = config.current_seed
+        self.cache.setdefault("cursor", 0)
+        self.cache.setdefault("epoch", 0)
+
+    # ------------------------------------------------------------ phase logic
+    def _init_runs(self, trainer):
+        """Create splits, probe data sizes, share frozen args
+        (≙ ref ``local.py:120-131``)."""
+        import json
+
+        out = {}
+        trainer.data_handle.prepare_data()
+        self.cache["num_folds"] = len(self.cache["splits"])
+        out["data_size"] = {}
+        for k, sp in self.cache["splits"].items():
+            with open(os.path.join(self.cache["split_dir"], sp)) as f:
+                split = json.load(f)
+            out["data_size"][k] = {key: len(split.get(key, [])) for key in split}
+        frozen = {k: self.cache.get(k) for k in self._args}
+        frozen["num_folds"] = self.cache["num_folds"]
+        self.cache["frozen_args"] = frozen
+        out["shared_args"] = utils.clean_recursive(frozen)
+        return out
+
+    def _next_run(self, trainer):
+        """Per-fold re-initialization (≙ ref ``local.py:133-150``)."""
+        out = {}
+        for k in _EPHEMERAL_KEYS:
+            self.cache.pop(k, None)
+        self.cache.update(cursor=0, epoch=0)
+        self.cache[Key.TRAIN_SERIALIZABLE.value] = []
+        self.cache["split_file"] = self.cache["splits"][str(self.cache["split_ix"])]
+        self.cache["log_dir"] = os.path.join(
+            self.state.get("outputDirectory", "."),
+            str(self.cache["task_id"]),
+            f"fold_{self.cache['split_ix']}",
+        )
+        os.makedirs(self.cache["log_dir"], exist_ok=True)
+        tag = f"{self.cache['task_id']}-{self.cache['split_ix']}"
+        self.cache["best_nn_state"] = f"best.{tag}.ckpt"
+        self.cache["latest_nn_state"] = f"latest.{tag}.ckpt"
+        trainer.init_nn()
+        out["phase"] = Phase.COMPUTATION.value
+        return out
+
+    def _pretrain_local(self, trainer):
+        """Designated site trains locally and ships its best weights
+        (≙ ref ``local.py:152-170``)."""
+        out = {"phase": Phase.COMPUTATION.value}
+        pretrain_args = self.cache.get("pretrain_args") or {}
+        epochs = int(pretrain_args.get("epochs", 0))
+        any_pretrains = epochs > 0 and any(
+            r.get("pretrain") for r in self.input.get("global_runs", {}).values()
+        )
+        if epochs > 0 and self.cache.get("pretrain"):
+            saved = {
+                k: self.cache.get(k) for k in ("epochs", "pretrain")
+            }
+            self.cache.update(pretrain_args)
+            self.cache["pretrain"] = True
+            trainer.train_local(
+                trainer.data_handle.get_train_dataset(),
+                trainer.data_handle.get_validation_dataset(),
+            )
+            self.cache.update({k: v for k, v in saved.items() if v is not None})
+            # advertise the shipped best weights so the aggregator broadcasts
+            if self.cache.get("weights_file"):
+                out["weights_file"] = self.cache["weights_file"]
+            out["phase"] = Phase.PRE_COMPUTATION.value
+        if any_pretrains:
+            out["phase"] = Phase.PRE_COMPUTATION.value
+        return out
+
+    def _get_learner_cls(self, learner_cls=None):
+        engine = str(self.cache.get("agg_engine"))
+        builtin = {
+            AggEngine.DSGD.value: COINNLearner,
+            AggEngine.RANK_DAD.value: DADLearner,
+            AggEngine.POWER_SGD.value: PowerSGDLearner,
+        }
+        return builtin.get(engine, learner_cls or COINNLearner)
+
+    # -------------------------------------------------------------- main loop
+    def compute(self, mp_pool=None, trainer_cls=None, dataset_cls=None,
+                datahandle_cls=COINNDataHandle, learner_cls=None, **kw):
+        trainer = trainer_cls(
+            cache=self.cache, input=self.input, state=self.state,
+            data_handle=datahandle_cls(
+                cache=self.cache, input=self.input, state=self.state,
+                dataset_cls=dataset_cls,
+                dataloader_args=self.cache.get("dataloader_args"),
+            ),
+        )
+
+        self.out["phase"] = self.input.get("phase", Phase.INIT_RUNS.value)
+        if self.out["phase"] == Phase.INIT_RUNS.value:
+            self.out.update(**self._init_runs(trainer))
+
+        elif self.out["phase"] == Phase.NEXT_RUN.value:
+            self.cache.update(
+                **self.input["global_runs"][self.state.get("clientId", "site")]
+            )
+            self.out.update(**self._next_run(trainer))
+            if self.cache.get("mode") == Mode.TRAIN.value:
+                self.out.update(**self._pretrain_local(trainer))
+
+        elif self.out["phase"] == Phase.PRE_COMPUTATION.value:
+            if self.input.get("pretrained_weights"):
+                trainer.init_nn()
+                trainer.load_checkpoint(
+                    full_path=os.path.join(
+                        self.state.get("baseDirectory", "."),
+                        self.input["pretrained_weights"],
+                    ),
+                    load_optimizer=False,
+                )
+                self.cache["_train_state"] = trainer.train_state
+            self.out["phase"] = Phase.COMPUTATION.value
+
+        if self.out["phase"] == Phase.COMPUTATION.value and trainer.train_state is None:
+            # later invocations within a fold: models are stateless flax defs;
+            # the live train-state pytree persists in the cache (≙ the ref
+            # sharing nn/optimizer via cache, ``trainer.py:18-20``)
+            if "_train_state" in self.cache:
+                trainer.init_nn(init_weights=False, init_optimizer=False)
+                trainer._init_optimizer()
+                trainer.train_state = self.cache["_train_state"]
+            else:
+                trainer.init_nn()
+
+        learner = self._get_learner_cls(learner_cls)(trainer=trainer, mp_pool=mp_pool)
+        client_id = self.state.get("clientId", "site")
+        global_modes = self.input.get("global_modes", {})
+        self.out["mode"] = global_modes.get(client_id, self.cache.get("mode"))
+
+        if self.out["phase"] == Phase.COMPUTATION.value:
+            if self.input.get("save_current_as_best"):
+                trainer.save_checkpoint(name=self.cache["best_nn_state"])
+
+            if self.input.get("update"):
+                self.out.update(**learner.step())
+
+            if any(m == Mode.TRAIN.value for m in global_modes.values()) or (
+                not global_modes and self.out["mode"] == Mode.TRAIN.value
+            ):
+                self.out.update(**learner.to_reduce())
+
+            if global_modes and all(
+                m == Mode.VALIDATION.value for m in global_modes.values()
+            ):
+                self.out.update(**trainer.validation_distributed())
+                self.out.update(**learner.train_serializable())
+                self.out["mode"] = Mode.TRAIN_WAITING.value
+
+            if global_modes and all(
+                m == Mode.TEST.value for m in global_modes.values()
+            ):
+                self.out.update(**trainer.test_distributed())
+                self.out["mode"] = self.cache["frozen_args"]["mode"]
+                self.out["phase"] = Phase.NEXT_RUN_WAITING.value
+                trainer.save_checkpoint(name=self.cache["latest_nn_state"])
+                utils.save_cache(self.cache, {"outputDirectory": self.cache["log_dir"]})
+
+        elif self.out["phase"] == Phase.SUCCESS.value:
+            zip_name = self.input.get("results_zip")
+            if zip_name:
+                src = os.path.join(
+                    self.state.get("baseDirectory", "."), f"{zip_name}.zip"
+                )
+                dst = os.path.join(
+                    self.state.get("outputDirectory", "."), f"{zip_name}.zip"
+                )
+                for i in range(3):  # relay may lag; poll briefly (ref :267-274)
+                    time.sleep(i)
+                    if os.path.exists(src):
+                        os.makedirs(os.path.dirname(dst), exist_ok=True)
+                        shutil.copy(src, dst)
+                        break
+
+        # persist the live train state across engine invocations (in cache)
+        if trainer.train_state is not None:
+            self.cache["_train_state"] = trainer.train_state
+        return self.out
+
+    def __call__(self, *a, **kw):
+        try:
+            self.compute(*a, **kw)
+            return {"output": self.out}
+        except Exception:
+            traceback.print_exc()
+            raise RuntimeError(f"Local node failed with partial out: {self.out}")

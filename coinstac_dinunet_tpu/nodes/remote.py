@@ -1,0 +1,293 @@
+"""COINNRemote — the aggregator phase state machine.
+
+Capability parity with the reference ``distrib/nodes/remote.py:58-310``:
+adopts ``shared_args`` from the first site, builds the fold queue, selects the
+pretrain site (max train data), reduces gradients when every site reports
+``reduce``, runs the epoch/validation barrier over the ``*_WAITING`` modes,
+accumulates cross-site scores with **exact count-merge** (the reference
+averages derived scores — SURVEY §2 defects), signals best-checkpoint saves,
+early-stops, rotates folds, and finally reduces global test scores, writes
+CSVs/plots, and ships a results zip.
+
+TPU-first addition: each fold's ``global_runs`` carries ``target_batches``
+(global max batches/epoch) so every site's padded loader runs lockstep
+equal-length epochs — replacing the reference's wrap-around padded sampler
+with static-shape padding + masking.
+"""
+import datetime
+import math
+import os
+import shutil
+import traceback
+
+from .. import config, utils
+from ..config.keys import AggEngine, Key, Mode, Phase
+from ..data import EmptyDataHandle
+from ..parallel import COINNReducer, DADReducer, PowerSGDReducer
+from ..utils.logger import lazy_debug
+from ..utils.utils import performance_improved_, stop_training_
+from ..vision import plotter
+from . import check, gather
+
+
+class COINNRemote:
+    """The aggregator node (≙ ref ``COINNRemote``)."""
+
+    def __init__(self, cache=None, input=None, state=None, verbose=False, **kw):
+        self.out = {}
+        self.cache = cache if cache is not None else {}
+        self.cache.update(**kw)
+        self.input = utils.FrozenDict(input or {})
+        self.state = utils.FrozenDict(state or {})
+        self.cache.setdefault("verbose", verbose)
+        if not self.cache.get(Key.ARGS_CACHED) and self.input:
+            site = next(iter(self.input.values()))
+            if "shared_args" in site:
+                self.cache.update(**site["shared_args"])
+                self.cache[Key.ARGS_CACHED.value] = True
+
+    # ------------------------------------------------------------- run set-up
+    def _init_runs(self):
+        if self.cache.get("seed") is None:
+            self.cache["seed"] = config.current_seed
+        self.cache[Key.GLOBAL_TEST_SERIALIZABLE.value] = []
+        self.cache["data_size"] = {
+            site: site_vars.get("data_size")
+            for site, site_vars in self.input.items()
+        }
+        self.cache["folds"] = [
+            {"split_ix": str(fold), "seed": self.cache["seed"]}
+            for fold in range(int(self.cache["num_folds"]))
+        ][::-1]
+
+    def _next_run(self, trainer):
+        """Pop a fold; build per-site run assignments (≙ ref ``:88-117``)."""
+        self.cache["fold"] = self.cache["folds"].pop()
+        split_ix = self.cache["fold"]["split_ix"]
+        self.cache["log_dir"] = os.path.join(
+            self.state.get("outputDirectory", "."),
+            str(self.cache["task_id"]),
+            f"fold_{split_ix}",
+        )
+        os.makedirs(self.cache["log_dir"], exist_ok=True)
+        self.cache.update(epoch=0, best_val_epoch=0, best_val_score=None)
+        self.cache[Key.TRAIN_LOG.value] = []
+        self.cache[Key.VALIDATION_LOG.value] = []
+        self.cache[Key.TEST_METRICS.value] = []
+
+        train_sizes = {
+            site: (self.cache["data_size"][site] or {})
+            .get(split_ix, {})
+            .get("train", 0)
+            for site in self.input
+        }
+        max_data_site = max(train_sizes, key=train_sizes.get)
+        # lockstep epochs: every site pads to the global max batches/epoch
+        batch_size = int(self.cache.get("batch_size", 16))
+        target_batches = max(
+            (math.ceil(n / batch_size) for n in train_sizes.values() if n),
+            default=1,
+        )
+        out = {}
+        for site in self.input:
+            fold = {**self.cache["fold"]}
+            fold["pretrain"] = site == max_data_site
+            fold["target_batches"] = target_batches
+            out[site] = fold
+        return out
+
+    # --------------------------------------------------------- score handling
+    def _metric_shells(self, trainer):
+        return trainer.new_averages(), trainer.new_metrics()
+
+    def _reduce_serialized(self, trainer, payloads):
+        """Exact cross-site reduction of serialized {averages, metrics}."""
+        pairs = gather(["averages", "metrics"], payloads, "append")
+        averages = trainer.new_averages().reduce_sites(pairs["averages"])
+        metrics = trainer.new_metrics().reduce_sites(pairs["metrics"])
+        return averages, metrics
+
+    def _accumulate_epoch_info(self, trainer):
+        train = gather(
+            [Key.TRAIN_SERIALIZABLE.value], self.input.values(), "extend"
+        )[Key.TRAIN_SERIALIZABLE.value]
+        val = gather(
+            [Key.VALIDATION_SERIALIZABLE.value], self.input.values(), "extend"
+        )[Key.VALIDATION_SERIALIZABLE.value]
+        t_avg, t_met = self._reduce_serialized(trainer, train)
+        v_avg, v_met = self._reduce_serialized(trainer, val)
+        return {
+            "train_averages": t_avg, "train_metrics": t_met,
+            "val_averages": v_avg, "val_metrics": v_met,
+        }
+
+    def _on_epoch_end(self, trainer):
+        info = self._accumulate_epoch_info(trainer)
+        self.cache[Key.TRAIN_LOG.value].append(
+            [*info["train_averages"].get(), *info["train_metrics"].get()]
+        )
+        self._save_if_better(**info)
+        self.cache[Key.VALIDATION_LOG.value].append(
+            [*info["val_averages"].get(), *info["val_metrics"].get()]
+        )
+        if lazy_debug(self.cache["epoch"]):
+            plotter.plot_progress(
+                self.cache, self.cache["log_dir"],
+                plot_keys=[Key.TRAIN_LOG.value, Key.VALIDATION_LOG.value],
+            )
+        return info
+
+    def _save_if_better(self, **info):
+        score = info["val_metrics"].extract(self.cache.get("monitor_metric", "f1"))
+        self.out["save_current_as_best"] = performance_improved_(
+            self.cache["epoch"], score, self.cache
+        )
+
+    def _next_epoch(self, **info):
+        done = self.cache["epoch"] >= int(self.cache.get("epochs", 1))
+        if done or stop_training_(self.cache["epoch"], self.cache):
+            return Mode.TEST.value
+        return Mode.TRAIN.value
+
+    def _on_run_end(self, trainer):
+        """Fold finished: reduce + persist its test scores (≙ ref ``:147-172``)."""
+        test = gather(
+            [Key.TEST_SERIALIZABLE.value], self.input.values(), "extend"
+        )[Key.TEST_SERIALIZABLE.value]
+        averages, metrics = self._reduce_serialized(trainer, test)
+        self.cache[Key.TEST_METRICS.value].append(
+            [*averages.get(), *metrics.get()]
+        )
+        self.cache[Key.GLOBAL_TEST_SERIALIZABLE.value].append(
+            {"averages": averages.serialize(), "metrics": metrics.serialize()}
+        )
+        plotter.plot_progress(
+            self.cache, self.cache["log_dir"],
+            plot_keys=[Key.TRAIN_LOG.value, Key.VALIDATION_LOG.value],
+        )
+        utils.save_scores(
+            self.cache, log_dir=self.cache["log_dir"],
+            file_keys=[Key.TEST_METRICS.value],
+        )
+        utils.save_cache(self.cache, {"outputDirectory": self.cache["log_dir"]})
+
+    def _send_global_scores(self, trainer):
+        """All folds done: reduce fold scores, write CSV, zip the output
+        (≙ ref ``:174-197``)."""
+        out = {}
+        averages, metrics = self._reduce_serialized(
+            trainer, self.cache[Key.GLOBAL_TEST_SERIALIZABLE.value]
+        )
+        self.cache["global_test_metrics"] = [[*averages.get(), *metrics.get()]]
+        task_dir = os.path.join(
+            self.state.get("outputDirectory", "."), str(self.cache["task_id"])
+        )
+        utils.save_scores(
+            self.cache, log_dir=task_dir, file_keys=["global_test_metrics"]
+        )
+        stamp = "_".join(str(datetime.datetime.now()).split(" "))
+        out["results_zip"] = (
+            f"{self.cache['task_id']}_{self.cache.get('agg_engine')}_{stamp}"
+        )
+        shutil.make_archive(
+            os.path.join(self.state.get("transferDirectory", "."), out["results_zip"]),
+            "zip",
+            task_dir,
+        )
+        return out
+
+    def _set_mode(self, mode=None):
+        return {
+            site: (mode if mode else site_vars.get("mode", "N/A"))
+            for site, site_vars in self.input.items()
+        }
+
+    def _pre_compute(self):
+        """Broadcast the pretrain site's weights (≙ ref ``:205-215``)."""
+        out = {}
+        for site, site_vars in self.input.items():
+            if site_vars.get("weights_file"):
+                src = os.path.join(
+                    self.state.get("baseDirectory", "."), site,
+                    site_vars["weights_file"],
+                )
+                if os.path.exists(src):
+                    out["pretrained_weights"] = f"pretrained_{config.weights_file}"
+                    shutil.copy(
+                        src,
+                        os.path.join(
+                            self.state.get("transferDirectory", "."),
+                            out["pretrained_weights"],
+                        ),
+                    )
+                break
+        return out
+
+    def _get_reducer_cls(self, reducer_cls=None):
+        engine = str(self.cache.get("agg_engine"))
+        builtin = {
+            AggEngine.DSGD.value: COINNReducer,
+            AggEngine.RANK_DAD.value: DADReducer,
+            AggEngine.POWER_SGD.value: PowerSGDReducer,
+        }
+        return builtin.get(engine, reducer_cls or COINNReducer)
+
+    # -------------------------------------------------------------- main loop
+    def compute(self, mp_pool=None, trainer_cls=None, reducer_cls=None, **kw):
+        trainer = trainer_cls(
+            cache=self.cache, input=self.input, state=self.state,
+            data_handle=EmptyDataHandle(
+                cache=self.cache, input=self.input, state=self.state
+            ),
+        )
+        self.out["phase"] = self.input.get("phase", Phase.INIT_RUNS.value)
+
+        if check(all, "phase", Phase.INIT_RUNS.value, self.input):
+            self._init_runs()
+            self.out["global_runs"] = self._next_run(trainer)
+            self.out["phase"] = Phase.NEXT_RUN.value
+
+        if check(all, "phase", Phase.PRE_COMPUTATION.value, self.input):
+            self.out.update(**self._pre_compute())
+            self.out["phase"] = Phase.PRE_COMPUTATION.value
+
+        self.out["global_modes"] = self._set_mode()
+        if check(all, "phase", Phase.COMPUTATION.value, self.input):
+            reducer = self._get_reducer_cls(reducer_cls)(
+                trainer=trainer, mp_pool=mp_pool
+            )
+            self.out["phase"] = Phase.COMPUTATION.value
+            if check(all, "reduce", True, self.input):
+                self.out.update(**reducer.reduce())
+
+            if check(all, "mode", Mode.VALIDATION_WAITING.value, self.input):
+                self.cache["epoch"] += 1
+                if self.cache["epoch"] % int(self.cache.get("validation_epochs", 1)) == 0:
+                    self.out["global_modes"] = self._set_mode(Mode.VALIDATION.value)
+                else:
+                    self.out["global_modes"] = self._set_mode(Mode.TRAIN.value)
+
+            if check(all, "mode", Mode.TRAIN_WAITING.value, self.input):
+                info = self._on_epoch_end(trainer)
+                self.out["global_modes"] = self._set_mode(self._next_epoch(**info))
+
+        if check(all, "phase", Phase.NEXT_RUN_WAITING.value, self.input):
+            self._on_run_end(trainer)
+            if self.cache["folds"]:
+                self.out["global_runs"] = self._next_run(trainer)
+                self.out["phase"] = Phase.NEXT_RUN.value
+            else:
+                self.out.update(**self._send_global_scores(trainer))
+                self.out["phase"] = Phase.SUCCESS.value
+        return self.out
+
+    def __call__(self, *a, **kw):
+        try:
+            self.compute(*a, **kw)
+            return {
+                "output": self.out,
+                "success": check(all, "phase", Phase.SUCCESS.value, self.input),
+            }
+        except Exception:
+            traceback.print_exc()
+            raise RuntimeError(f"Remote node failed with partial out: {self.out}")

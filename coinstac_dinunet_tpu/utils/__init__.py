@@ -68,11 +68,16 @@ def clean_recursive(obj):
 
 
 def save_cache(cache, state, name="logs"):
-    """Dump the node cache as JSON into the node's output directory."""
+    """Dump the node cache as JSON into the node's output directory.
+
+    Keys starting with ``_`` are runtime-internal (live train-state pytrees,
+    engine compression memory) and are excluded from the dump.
+    """
     out_dir = state.get("outputDirectory", ".")
     os.makedirs(out_dir, exist_ok=True)
+    payload = {k: v for k, v in dict(cache).items() if not str(k).startswith("_")}
     with open(os.path.join(out_dir, f"{name}.json"), "w") as f:
-        json.dump(clean_recursive(dict(cache)), f, indent=2)
+        json.dump(clean_recursive(payload), f, indent=2)
 
 
 def save_scores(cache, experiment_id="", file_keys=None, log_dir=None):

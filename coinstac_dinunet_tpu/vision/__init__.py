@@ -1,0 +1,8 @@
+"""Vision extras: training-curve plotting + image/patch utilities.
+
+Parity: reference ``coinstac_dinunet/vision/`` (``plotter.py``,
+``imageutils.py``).
+"""
+from .plotter import plot_progress  # noqa: F401
+
+__all__ = ["plot_progress"]

@@ -1,0 +1,66 @@
+"""Training-curve plotting (≙ reference ``vision/plotter.py:20-65``).
+
+``log_header`` convention: ``|``-separated sub-plot groups, each group a
+``,``-separated list of column names — e.g. ``"loss|precision,recall,f1"``
+plots loss alone and the three scores together.  One PNG per log key.
+"""
+import os
+
+import numpy as np
+
+
+def _rolling_mean(x, w):
+    if len(x) < 2 * w:
+        return None
+    kernel = np.ones(w) / w
+    return np.convolve(x, kernel, mode="valid")
+
+
+def plot_progress(cache, log_dir=None, plot_keys=("train_log",), epoch=None):
+    """Render raw + rolling-mean curves for every key's accumulated rows."""
+    import matplotlib
+
+    matplotlib.use("Agg", force=True)
+    import matplotlib.pyplot as plt
+
+    log_dir = log_dir or cache.get("log_dir", ".")
+    os.makedirs(log_dir, exist_ok=True)
+    header = cache.get("log_header", "loss")
+    groups = [
+        [c.strip() for c in grp.split(",") if c.strip()]
+        for grp in header.split("|")
+    ]
+    for key in plot_keys:
+        rows = cache.get(key, [])
+        if len(rows) < 2:
+            continue
+        data = np.asarray([list(np.ravel(r)) for r in rows], dtype=float)
+        ncols = data.shape[1]
+        # assign columns to groups left-to-right; spill into the last group
+        fig, axes = plt.subplots(
+            1, len(groups), figsize=(6 * len(groups), 4), squeeze=False
+        )
+        col = 0
+        for gi, grp in enumerate(groups):
+            ax = axes[0][gi]
+            take = grp if gi < len(groups) - 1 else grp + [
+                f"col{c}" for c in range(col + len(grp), ncols)
+            ]
+            for name in take:
+                if col >= ncols:
+                    break
+                series = data[:, col]
+                ax.plot(series, alpha=0.4, label=name)
+                rm = _rolling_mean(series, max(len(series) // 10, 2))
+                if rm is not None:
+                    ax.plot(
+                        np.arange(len(series) - len(rm), len(series)), rm,
+                        linewidth=2,
+                    )
+                col += 1
+            ax.set_xlabel("epoch" if "log" in key else "step")
+            ax.legend(loc="best", fontsize=8)
+            ax.grid(alpha=0.3)
+        fig.tight_layout()
+        fig.savefig(os.path.join(log_dir, f"{key}.png"), dpi=100)
+        plt.close(fig)
