@@ -1,0 +1,139 @@
+"""Benchmark: VBM 3-D CNN federated training throughput (BASELINE.md).
+
+Measures samples/sec/chip for the flagship config — VBM 3-D CNN with dSGD
+federated aggregation.  On a multi-device platform the whole federated round
+runs as one compiled mesh step (sites = mesh ranks, gradient mean = psum over
+ICI); on one chip it is the single-site compiled train step.
+
+``vs_baseline``: the reference publishes no numbers (SURVEY §6), so the
+recorded ratio is against a torch-CPU implementation of the same model and
+step measured on this host — the reference's own compute path when no GPU is
+present (its north-star scenario).  Prints ONE JSON line.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _bench_ours(shape, batch, width, steps=20, warmup=3):
+    import jax
+
+    from coinstac_dinunet_tpu.models import VBMTrainer
+    from coinstac_dinunet_tpu.parallel.mesh import MeshFederation
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    cache = {
+        "input_shape": shape, "model_width": width, "num_classes": 2,
+        "batch_size": batch, "seed": 0, "learning_rate": 1e-3,
+        "compute_dtype": "bfloat16",
+    }
+    trainer = VBMTrainer(cache=cache, state={}, data_handle=None)
+    trainer.init_nn()
+
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        return {
+            "inputs": rng.normal(size=(batch, *shape)).astype(np.float32),
+            "labels": rng.integers(0, 2, size=batch).astype(np.int32),
+            "_mask": np.ones(batch, np.float32),
+        }
+
+    if n_dev >= 2:
+        n_sites = min(8, n_dev)
+        fed = MeshFederation(trainer, n_sites=n_sites)
+        per_site = [[make_batch()] for _ in range(n_sites)]
+        stacked = fed.stack_site_batches(per_site)
+        for _ in range(warmup):
+            aux = fed.train_step(stacked)
+        jax.block_until_ready(aux["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            aux = fed.train_step(stacked)
+        jax.block_until_ready(aux["loss"])
+        dt = time.perf_counter() - t0
+        chips = n_sites * fed.mesh.devices.shape[1]
+        total = steps * batch * n_sites
+    else:
+        stacked = trainer._stack_batches([make_batch()])
+        ts = trainer.train_state
+        for _ in range(warmup):
+            ts, aux = trainer.train_step(ts, stacked)
+        jax.block_until_ready(aux["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            ts, aux = trainer.train_step(ts, stacked)
+        jax.block_until_ready(aux["loss"])
+        dt = time.perf_counter() - t0
+        chips = 1
+        total = steps * batch
+    return total / dt / chips, n_dev
+
+
+def _bench_torch_cpu(shape, batch, width, steps=3):
+    """The same model/step in torch on CPU — the reference framework's
+    compute path on a GPU-less host."""
+    try:
+        import torch
+        import torch.nn as tnn
+    except Exception:
+        return None
+
+    torch.set_num_threads(os.cpu_count() or 1)
+
+    def block(cin, cout, stride=1):
+        return tnn.Sequential(
+            tnn.Conv3d(cin, cout, 3, stride=stride, padding=1, bias=False),
+            tnn.GroupNorm(min(8, cout), cout),
+            tnn.ReLU(),
+        )
+
+    w = width
+    model = tnn.Sequential(
+        block(1, w, 2), block(w, w), block(w, 2 * w, 2), block(2 * w, 2 * w),
+        block(2 * w, 4 * w, 2), block(4 * w, 4 * w), block(4 * w, 8 * w, 2),
+        tnn.AdaptiveAvgPool3d(1), tnn.Flatten(), tnn.Linear(8 * w, 2),
+    )
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    loss_fn = tnn.CrossEntropyLoss()
+    x = torch.randn(batch, 1, *shape)
+    y = torch.randint(0, 2, (batch,))
+    # one warmup step
+    opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        opt.zero_grad()
+        loss_fn(model(x), y).backward()
+        opt.step()
+    dt = time.perf_counter() - t0
+    return steps * batch / dt
+
+
+def main():
+    fast = bool(os.environ.get("COINN_BENCH_FAST"))
+    shape = (24, 24, 24) if fast else (64, 64, 64)
+    batch = 4 if fast else 16
+    width = 8 if fast else 16
+    steps = 5 if fast else 20
+
+    ours, n_dev = _bench_ours(shape, batch, width, steps=steps)
+    base = _bench_torch_cpu(shape, batch, width, steps=2 if fast else 3)
+    vs = round(ours / base, 3) if base else None
+    print(json.dumps({
+        "metric": "vbm3d_cnn_samples_per_sec_per_chip",
+        "value": round(ours, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": vs,
+        "baseline": "torch-cpu same model+step on this host",
+        "baseline_samples_per_sec": round(base, 2) if base else None,
+        "devices": n_dev,
+        "input_shape": list(shape),
+        "batch_size": batch,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Model families for the benchmark workloads (BASELINE.md configs).
+
+The reference ships no model zoo — users subclass ``COINNTrainer`` and bring
+torch modules (its two example repos wire FreeSurfer-MLP and VBM-3D-CNN
+classifiers, ``README.md:30-33``).  This package provides TPU-first flax
+equivalents for every benchmark config, each with a trainer subclass and a
+synthetic dataset so the full federated stack can run and be measured without
+private neuroimaging data:
+
+- :mod:`.mlp` — FreeSurfer-volumes MLP classifier (configs 1-2).
+- :mod:`.cnn3d` — VBM 3-D CNN classifier, the flagship (config 3).
+- :mod:`.resnet` — ResNet-18 image classifier (config 4).
+- :mod:`.multinet` — two-network scheme (config 5).
+
+Design: channels-last layouts (NDHWC), GroupNorm rather than BatchNorm (pure
+``apply`` — no mutable batch statistics to drift across federated sites),
+optional bfloat16 compute with float32 params.
+"""
+from .cnn3d import SyntheticVBMDataset, VBM3DNet, VBMTrainer  # noqa: F401
+from .mlp import FSVDataset, FSVNet, FSVTrainer  # noqa: F401
+from .multinet import MultiNetTrainer  # noqa: F401
+from .resnet import ResNet18, ResNetTrainer, SyntheticImageDataset  # noqa: F401
+
+__all__ = [
+    "FSVNet", "FSVTrainer", "FSVDataset",
+    "VBM3DNet", "VBMTrainer", "SyntheticVBMDataset",
+    "ResNet18", "ResNetTrainer", "SyntheticImageDataset",
+    "MultiNetTrainer",
+]

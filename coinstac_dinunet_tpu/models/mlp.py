@@ -1,0 +1,82 @@
+"""FreeSurfer-volumes MLP classifier (benchmark configs 1-2).
+
+The reference's canonical first workload: an MLP over FreeSurfer regional
+volume features (external example repo; see SURVEY §6 / BASELINE.md).
+"""
+import numpy as np
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..data import COINNDataset
+from ..metrics import cross_entropy
+from ..trainer import COINNTrainer
+
+
+class FSVNet(nn.Module):
+    """MLP over FreeSurfer volume features."""
+
+    num_classes: int = 2
+    hidden: tuple = (256, 128, 64)
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False, rng=None):
+        x = jnp.asarray(x, self.dtype)
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            if train and self.dropout > 0 and rng is not None:
+                x = nn.Dropout(self.dropout, deterministic=False)(x, rng=rng)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class FSVDataset(COINNDataset):
+    """Loads one-row-per-subject feature files.
+
+    Default file format: a ``.npy``/``.csv`` per subject holding the feature
+    vector, with the label encoded by the ``labels`` mapping in the data conf
+    (or a synthetic deterministic sample when ``synthetic=True`` in cache —
+    used by benches/tests)."""
+
+    def __getitem__(self, ix):
+        _, file = self.indices[ix]
+        num_features = int(self.cache.get("input_size", 66))
+        if self.cache.get("synthetic"):
+            fid = abs(hash(str(file))) % (2 ** 31)
+            rng = np.random.default_rng(fid)
+            y = fid % int(self.cache.get("num_classes", 2))
+            x = rng.normal(loc=0.1 * y, size=num_features).astype(np.float32)
+            return {"inputs": x, "labels": np.int32(y)}
+        path = f"{self.path()}/{file}"
+        x = (np.load(path) if str(file).endswith(".npy")
+             else np.loadtxt(path, delimiter=",")).astype(np.float32)
+        y = np.int32(self.data_conf.get("labels", {}).get(str(file), 0))
+        return {"inputs": x.reshape(-1)[:num_features], "labels": y}
+
+
+class FSVTrainer(COINNTrainer):
+    def _init_nn_model(self):
+        self.nn["fsv_net"] = FSVNet(
+            num_classes=int(self.cache.get("num_classes", 2)),
+            hidden=tuple(self.cache.get("hidden_sizes", (256, 128, 64))),
+            dropout=float(self.cache.get("dropout", 0.1)),
+            dtype=jnp.dtype(self.cache.get("compute_dtype", "float32")),
+        )
+
+    def example_inputs(self):
+        x = jnp.zeros((1, int(self.cache.get("input_size", 66))), jnp.float32)
+        return {"fsv_net": (x,)}
+
+    def iteration(self, params, batch, rng=None):
+        logits = self.nn["fsv_net"].apply(
+            params["fsv_net"], batch["inputs"], train=rng is not None, rng=rng
+        )
+        mask = batch.get("_mask")
+        loss = cross_entropy(logits, batch["labels"], mask=mask)
+        return {
+            "loss": loss,
+            "pred": jnp.argmax(logits, -1),
+            "true": batch["labels"],
+        }

@@ -1,0 +1,42 @@
+"""Multi-network scheme (benchmark config 5): two models trained jointly.
+
+Exercises the dict-of-models API end-to-end: ``nn`` holds two networks, the
+iteration combines their outputs, gradients for BOTH flow through every agg
+engine, and checkpoints capture both (the reference silently drops all but
+the last model — ``nn/basetrainer.py:103-114``, SURVEY §2 defects).
+"""
+import jax.numpy as jnp
+
+from ..metrics import cross_entropy
+from ..trainer import COINNTrainer
+from .cnn3d import VBM3DNet
+
+
+class MultiNetTrainer(COINNTrainer):
+    """Two VBM CNNs (e.g. two modalities / an ensemble pair) whose logits
+    fuse by averaging; one loss trains both."""
+
+    def _init_nn_model(self):
+        num_classes = int(self.cache.get("num_classes", 2))
+        dtype = jnp.dtype(self.cache.get("compute_dtype", "bfloat16"))
+        width = int(self.cache.get("model_width", 16))
+        self.nn["net_a"] = VBM3DNet(num_classes=num_classes, width=width, dtype=dtype)
+        self.nn["net_b"] = VBM3DNet(num_classes=num_classes, width=width, dtype=dtype)
+
+    def example_inputs(self):
+        shape = tuple(self.cache.get("input_shape", (32, 32, 32)))
+        x = jnp.zeros((1, *shape), jnp.float32)
+        return {"net_a": (x,), "net_b": (x,)}
+
+    def iteration(self, params, batch, rng=None):
+        x = batch["inputs"]
+        logits_a = self.nn["net_a"].apply(params["net_a"], x)
+        logits_b = self.nn["net_b"].apply(params["net_b"], x)
+        logits = 0.5 * (logits_a + logits_b)
+        mask = batch.get("_mask")
+        loss = cross_entropy(logits, batch["labels"], mask=mask)
+        return {
+            "loss": loss,
+            "pred": jnp.argmax(logits, -1),
+            "true": batch["labels"],
+        }

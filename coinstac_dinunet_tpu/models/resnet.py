@@ -1,0 +1,103 @@
+"""ResNet-18 image classifier (benchmark config 4).
+
+TPU-first flax implementation: NHWC, GroupNorm (pure apply — no federated
+batch-stat drift), bfloat16 compute, 3×3 MXU-friendly convs.
+"""
+import numpy as np
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..data import COINNDataset
+from ..metrics import cross_entropy
+from ..trainer import COINNTrainer
+
+
+class _ResBlock(nn.Module):
+    features: int
+    stride: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.features, (3, 3), strides=(self.stride,) * 2,
+                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        y = nn.GroupNorm(num_groups=min(8, self.features), dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.GroupNorm(num_groups=min(8, self.features), dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1), strides=(self.stride,) * 2,
+                               use_bias=False, dtype=self.dtype)(x)
+            residual = nn.GroupNorm(
+                num_groups=min(8, self.features), dtype=self.dtype
+            )(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet18(nn.Module):
+    num_classes: int = 2
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False, rng=None):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = jnp.asarray(x, self.dtype)
+        w = self.width
+        x = nn.Conv(w, (7, 7), strides=(2, 2), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=8, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, (feat, blocks) in enumerate(
+            [(w, 2), (2 * w, 2), (4 * w, 2), (8 * w, 2)]
+        ):
+            for b in range(blocks):
+                stride = 2 if (i > 0 and b == 0) else 1
+                x = _ResBlock(feat, stride=stride, dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            jnp.asarray(x, jnp.float32)
+        )
+
+
+class SyntheticImageDataset(COINNDataset):
+    """Deterministic synthetic images keyed by file id (benches/tests)."""
+
+    def __getitem__(self, ix):
+        _, file = self.indices[ix]
+        shape = tuple(self.cache.get("input_shape", (64, 64, 3)))
+        fid = abs(hash(str(file))) % (2 ** 31)
+        rng = np.random.default_rng(fid)
+        y = fid % int(self.cache.get("num_classes", 2))
+        x = rng.normal(loc=0.05 * y, size=shape).astype(np.float32)
+        return {"inputs": x, "labels": np.int32(y)}
+
+
+class ResNetTrainer(COINNTrainer):
+    def _init_nn_model(self):
+        self.nn["resnet"] = ResNet18(
+            num_classes=int(self.cache.get("num_classes", 2)),
+            width=int(self.cache.get("model_width", 64)),
+            dtype=jnp.dtype(self.cache.get("compute_dtype", "bfloat16")),
+        )
+
+    def example_inputs(self):
+        shape = tuple(self.cache.get("input_shape", (64, 64, 3)))
+        return {"resnet": (jnp.zeros((1, *shape), jnp.float32),)}
+
+    def iteration(self, params, batch, rng=None):
+        logits = self.nn["resnet"].apply(
+            params["resnet"], batch["inputs"], train=rng is not None, rng=rng
+        )
+        mask = batch.get("_mask")
+        loss = cross_entropy(logits, batch["labels"], mask=mask)
+        return {
+            "loss": loss,
+            "pred": jnp.argmax(logits, -1),
+            "true": batch["labels"],
+        }

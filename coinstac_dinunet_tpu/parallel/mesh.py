@@ -139,6 +139,8 @@ class MeshFederation:
             return grads, {"errors": new_err, "qs": new_q}
 
         def site_step(ts, stacked, comm):
+            # drop the sharded (now size-1) site axis from the batch view
+            stacked = jax.tree_util.tree_map(lambda x: x[0], stacked)
             orig_rng = ts.rng
             # per-site decorrelated randomness for the forward pass…
             ts = ts.replace(rng=jax.random.fold_in(orig_rng, jax.lax.axis_index("site")))
@@ -205,6 +207,7 @@ class MeshFederation:
         mesh = self.mesh
 
         def site_eval(ts, batch):
+            batch = jax.tree_util.tree_map(lambda x: x[0], batch)
             it = trainer.iteration(ts.params, batch, None)
             m_state, a_state = trainer._step_outputs(
                 it, batch, metrics_shell, averages_shell
