@@ -1,0 +1,181 @@
+"""Tests for vision/imageutils — patch tiling round-trips, CC ops, viz maps.
+
+Models the reference's de-facto behavior (``vision/imageutils.py``) including
+the N-D generalization and the coverage-count merge fix (SURVEY.md §2).
+"""
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu.vision import imageutils as iu
+
+
+# ---------------------------------------------------------------- containers
+def test_image_mask_and_copy(tmp_path):
+    img = iu.Image()
+    img.array = np.full((8, 8), 7, np.uint8)
+    img.mask = np.zeros((8, 8), np.uint8)
+    img.mask[2:6, 2:6] = 255
+    img.apply_mask()
+    assert img.array[0, 0] == 0 and img.array[3, 3] == 7
+    import copy
+
+    dup = copy.copy(img)
+    dup.array[3, 3] = 0
+    assert img.array[3, 3] == 7  # deep enough copy of the array
+
+
+def test_image_load_roundtrip(tmp_path):
+    from PIL import Image as PILImage
+
+    arr = (np.arange(64).reshape(8, 8) * 3).astype(np.uint8)
+    PILImage.fromarray(arr).save(tmp_path / "x.png")
+    img = iu.Image()
+    img.load(str(tmp_path), "x.png")
+    np.testing.assert_array_equal(img.array, arr)
+    img.load(str(tmp_path), "missing.png")  # logged, not raised
+
+
+def test_clahe_both_paths():
+    rng = np.random.default_rng(0)
+    arr = (rng.normal(100, 10, (32, 32))).clip(0, 255).astype(np.uint8)
+    out_cv = iu._clahe(arr.copy(), 2.0, (4, 4))
+    out_np = iu._clahe_numpy(arr.copy(), 2.0, (4, 4))
+    for out in (out_cv, out_np):
+        assert out.shape == arr.shape and out.dtype == np.uint8
+        # equalization should widen the value spread of a tight distribution
+        assert out.std() >= arr.std() * 0.9
+
+
+def test_image_apply_clahe_rgb():
+    img = iu.Image()
+    img.array = np.random.default_rng(1).integers(0, 255, (16, 16, 3)).astype(np.uint8)
+    img.apply_clahe()
+    assert img.array.shape == (16, 16, 3)
+
+
+# ------------------------------------------------------------------- scoring
+def test_rgb_scores_and_praf1():
+    pred = np.array([[255, 255], [0, 0]], np.uint8)
+    truth = np.array([[255, 0], [255, 0]], np.uint8)
+    rgb = iu.get_rgb_scores(pred, truth)
+    assert tuple(rgb[0, 0]) == (255, 255, 255)  # TP
+    assert tuple(rgb[0, 1]) == (0, 255, 0)  # FP
+    assert tuple(rgb[1, 0]) == (255, 0, 0)  # FN
+    assert tuple(rgb[1, 1]) == (0, 0, 0)  # TN
+    s = iu.get_praf1(pred, truth)
+    assert s == {"Precision": 0.5, "Recall": 0.5, "Accuracy": 0.5, "F1": 0.5}
+
+
+def test_rescale_and_whiten():
+    arr = np.array([[0, 5], [10, 10]], np.float64)
+    r = iu.rescale(arr)
+    assert r.min() == 0 and r.max() == 1
+    w = iu.whiten_image2d(np.random.default_rng(0).normal(0, 1, (16, 16)))
+    assert w.dtype == np.uint8 and w.max() == 255
+
+
+# ------------------------------------------------------------------ chunking
+def test_chunk_indexes_cover_image_2d():
+    shape, chunk, off = (10, 7), (4, 4), (3, 3)
+    covered = np.zeros(shape, int)
+    for r0, r1, c0, c1 in iu.get_chunk_indexes(shape, chunk, off):
+        assert 0 <= r0 < r1 <= shape[0] and r1 - r0 == chunk[0]
+        assert 0 <= c0 < c1 <= shape[1] and c1 - c0 == chunk[1]
+        covered[r0:r1, c0:c1] += 1
+    assert (covered > 0).all()
+
+
+def test_chunk_indexes_3d():
+    shape, chunk = (9, 9, 9), (4, 4, 4)
+    boxes = list(iu.get_chunk_indexes(shape, chunk, chunk))
+    covered = np.zeros(shape, int)
+    for b in boxes:
+        sl = tuple(slice(b[2 * d], b[2 * d + 1]) for d in range(3))
+        covered[sl] += 1
+    assert (covered > 0).all()
+
+
+def test_chunk_indices_by_index_clamped():
+    ix = iu.get_chunk_indices_by_index((10, 10), (4, 4), [(0, 0), (5, 5), (9, 9)])
+    for p, q, r, s in ix:
+        assert 0 <= p and q <= 10 and q - p == 4 and s - r == 4
+    assert ix[0] == [0, 4, 0, 4]
+    assert ix[2] == [6, 10, 6, 10]
+
+
+def test_merge_patches_roundtrip_2d():
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (12, 10)).astype(np.uint8)
+    chunk, off = (5, 4), (3, 3)
+    patches = [
+        img[r0:r1, c0:c1]
+        for r0, r1, c0, c1 in iu.get_chunk_indexes(img.shape, chunk, off)
+    ]
+    out = iu.merge_patches(np.array(patches), img.shape, chunk, off)
+    np.testing.assert_array_equal(out, img)
+
+
+def test_merge_patches_roundtrip_3d():
+    rng = np.random.default_rng(1)
+    img = rng.integers(0, 255, (8, 8, 6)).astype(np.uint8)
+    chunk = (4, 4, 3)
+    patches = [
+        img[tuple(slice(b[2 * d], b[2 * d + 1]) for d in range(3))]
+        for b in iu.get_chunk_indexes(img.shape, chunk, chunk)
+    ]
+    out = iu.merge_patches(np.array(patches), img.shape, chunk, chunk)
+    np.testing.assert_array_equal(out, img)
+
+
+def test_merge_counts_true_coverage():
+    # zero-valued pixels still count in the overlap denominator (ref defect)
+    img = np.zeros((6, 6), np.uint8)
+    img[0, 0] = 100
+    chunk, off = (4, 4), (2, 2)
+    patches = [
+        img[r0:r1, c0:c1]
+        for r0, r1, c0, c1 in iu.get_chunk_indexes(img.shape, chunk, off)
+    ]
+    out = iu.merge_patches(np.array(patches), img.shape, chunk, off)
+    np.testing.assert_array_equal(out, img)
+
+
+def test_expand_and_mirror_patch():
+    lo0, hi0, lo1, hi1, pads = iu.expand_and_mirror_patch(
+        (10, 10), (0, 4, 6, 10), (4, 4)
+    )
+    assert (lo0, hi0, lo1, hi1) == (0, 6, 4, 10)
+    assert pads == [(2, 0), (0, 2)]
+    patch = np.pad(
+        np.arange(100).reshape(10, 10)[lo0:hi0, lo1:hi1], pads, mode="reflect"
+    )
+    assert patch.shape == (8, 8)  # original 4x4 grown by 4 in each axis
+
+
+# --------------------------------------------------------- connected components
+def test_largest_cc():
+    arr = np.zeros((10, 10), np.uint8)
+    arr[0:2, 0:2] = 1  # 4 px
+    arr[5:9, 5:9] = 1  # 16 px
+    out = iu.largest_cc(arr)
+    assert out[6, 6] and not out[0, 0]
+    assert iu.largest_cc(np.zeros((4, 4), np.uint8)) is None
+
+
+def test_remove_connected_comp():
+    arr = np.zeros((20, 20), np.uint8)
+    arr[1:3, 1:3] = 1  # tiny blob: diag ~1.4 < 5 → removed
+    arr[5:15, 5:15] = 1  # big blob: diag ~12.7 ≥ 5 → kept
+    out = iu.remove_connected_comp(arr, connected_comp_diam_limit=5)
+    assert out[10, 10] == 1 and out[1, 1] == 0
+
+
+def test_map_img_to_img2d_and_neighbors():
+    base = np.full((4, 4), 50, np.uint8)
+    overlay = np.zeros((4, 4), np.uint8)
+    overlay[1, 1] = 255
+    rgb = iu.map_img_to_img2d(base, overlay)
+    assert tuple(rgb[1, 1]) == (255, 0, 0)
+    assert tuple(rgb[0, 0]) == (50, 50, 50)
+    assert len(iu.get_pix_neigh(1, 1)) == 4
+    assert len(iu.get_pix_neigh(1, 1, eight=True)) == 8
