@@ -20,10 +20,16 @@ from .cnn3d import SyntheticVBMDataset, VBM3DNet, VBMTrainer  # noqa: F401
 from .mlp import FSVDataset, FSVNet, FSVTrainer  # noqa: F401
 from .multinet import MultiNetTrainer  # noqa: F401
 from .resnet import ResNet18, ResNetTrainer, SyntheticImageDataset  # noqa: F401
+from .transformer import (  # noqa: F401
+    SeqClassifier,
+    SeqTrainer,
+    SyntheticSeqDataset,
+)
 
 __all__ = [
     "FSVNet", "FSVTrainer", "FSVDataset",
     "VBM3DNet", "VBMTrainer", "SyntheticVBMDataset",
     "ResNet18", "ResNetTrainer", "SyntheticImageDataset",
     "MultiNetTrainer",
+    "SeqClassifier", "SeqTrainer", "SyntheticSeqDataset",
 ]

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from ..data import COINNDataset
 from ..metrics import cross_entropy
 from ..trainer import COINNTrainer
+from ..utils import stable_file_id
 
 
 class _ConvBlock(nn.Module):
@@ -74,7 +75,7 @@ class SyntheticVBMDataset(COINNDataset):
     def __getitem__(self, ix):
         _, file = self.indices[ix]
         shape = tuple(self.cache.get("input_shape", (32, 32, 32)))
-        fid = abs(hash(str(file))) % (2 ** 31)
+        fid = stable_file_id(file)
         rng = np.random.default_rng(fid)
         y = fid % int(self.cache.get("num_classes", 2))
         x = rng.normal(loc=0.05 * y, scale=1.0, size=shape).astype(np.float32)

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from ..data import COINNDataset
 from ..metrics import cross_entropy
 from ..trainer import COINNTrainer
+from ..utils import stable_file_id
 
 
 class FSVNet(nn.Module):
@@ -44,7 +45,7 @@ class FSVDataset(COINNDataset):
         _, file = self.indices[ix]
         num_features = int(self.cache.get("input_size", 66))
         if self.cache.get("synthetic"):
-            fid = abs(hash(str(file))) % (2 ** 31)
+            fid = stable_file_id(file)
             rng = np.random.default_rng(fid)
             y = fid % int(self.cache.get("num_classes", 2))
             x = rng.normal(loc=0.1 * y, size=num_features).astype(np.float32)

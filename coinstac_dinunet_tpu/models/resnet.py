@@ -11,6 +11,7 @@ import jax.numpy as jnp
 from ..data import COINNDataset
 from ..metrics import cross_entropy
 from ..trainer import COINNTrainer
+from ..utils import stable_file_id
 
 
 class _ResBlock(nn.Module):
@@ -71,7 +72,7 @@ class SyntheticImageDataset(COINNDataset):
     def __getitem__(self, ix):
         _, file = self.indices[ix]
         shape = tuple(self.cache.get("input_shape", (64, 64, 3)))
-        fid = abs(hash(str(file))) % (2 ** 31)
+        fid = stable_file_id(file)
         rng = np.random.default_rng(fid)
         y = fid % int(self.cache.get("num_classes", 2))
         x = rng.normal(loc=0.05 * y, size=shape).astype(np.float32)

@@ -1,0 +1,154 @@
+"""Sequence transformer classifier — the long-context model family.
+
+No counterpart exists in the reference (its models are CNN/MLP classifiers;
+SURVEY.md §5 records long-context as absent) — this family exists so the TPU
+framework's sequence/context parallelism is exercised by a real workload:
+fMRI-timeseries-style sequence classification, with attention running through
+the fused :func:`~..ops.flash_attention.flash_attention` kernel and, under
+the mesh transport, :func:`~..parallel.ring_attention.ring_attention` over
+the ``sp`` axis (see ``parallel/sequence.py``).
+
+Layout choices are TPU-first: head_dim and d_model multiples of 128 when
+sized up, bf16 compute with f32 params, GroupNorm-free (LayerNorm is fine in
+pure functional form), learned positional embedding.
+"""
+import numpy as np
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..data import COINNDataset
+from ..metrics import cross_entropy
+from ..ops import flash_attention
+from ..trainer import COINNTrainer
+from ..utils import stable_file_id
+
+
+class MultiHeadSelfAttention(nn.Module):
+    """Self-attention over (B, T, D) through the fused flash kernel."""
+
+    num_heads: int
+    causal: bool = False
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = None  # None → platform default (pallas on TPU)
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        assert d % self.num_heads == 0, "num_heads must divide d_model"
+        hd = d // self.num_heads
+        qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype)(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda a: a.reshape(b, t, self.num_heads, hd).transpose(0, 2, 1, 3)
+        out = flash_attention(
+            split(q), split(k), split(v), causal=self.causal, impl=self.attn_impl
+        )
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
+        return nn.Dense(d, use_bias=False, dtype=self.dtype)(out)
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    causal: bool = False
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = None
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + MultiHeadSelfAttention(
+            self.num_heads, self.causal, self.dtype, self.attn_impl
+        )(h)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(d, dtype=self.dtype)(h)
+
+
+class SeqClassifier(nn.Module):
+    """Encoder over continuous feature sequences → mean-pool → classes."""
+
+    num_classes: int = 2
+    d_model: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 4096
+    causal: bool = False
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = None
+
+    @nn.compact
+    def __call__(self, x):
+        # x: (B, T, F) continuous features (e.g. ROI timeseries)
+        x = jnp.asarray(x, self.dtype)
+        b, t, _ = x.shape
+        x = nn.Dense(self.d_model, dtype=self.dtype)(x)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (self.max_len, self.d_model)
+        )
+        x = x + pos[:t][None].astype(self.dtype)
+        for _ in range(self.num_layers):
+            x = TransformerBlock(
+                self.num_heads, causal=self.causal, dtype=self.dtype,
+                attn_impl=self.attn_impl,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        pooled = jnp.mean(x, axis=1)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(pooled)
+
+
+class SyntheticSeqDataset(COINNDataset):
+    """Deterministic synthetic sequence-classification samples.
+
+    Class signal is a low-frequency sinusoid mixed into white noise — linearly
+    separable only through temporal context, so attention quality actually
+    moves the metric.
+    """
+
+    def __getitem__(self, ix):
+        _, file = self.indices[ix]
+        t = int(self.cache.get("seq_len", 128))
+        f = int(self.cache.get("num_features", 16))
+        n_cls = int(self.cache.get("num_classes", 2))
+        fid = stable_file_id(file)
+        rng = np.random.default_rng(fid)
+        y = fid % n_cls
+        ts = np.arange(t)[:, None] / t
+        signal = np.sin(2 * np.pi * (y + 1) * ts)
+        x = (rng.normal(size=(t, f)) * 0.5 + signal).astype(np.float32)
+        return {"inputs": x, "labels": np.int32(y)}
+
+
+class SeqTrainer(COINNTrainer):
+    """Trainer wiring for the sequence family (same contract as FSVTrainer)."""
+
+    def _init_nn_model(self):
+        self.nn["seq_net"] = SeqClassifier(
+            num_classes=int(self.cache.get("num_classes", 2)),
+            d_model=int(self.cache.get("d_model", 128)),
+            num_heads=int(self.cache.get("num_heads", 4)),
+            num_layers=int(self.cache.get("num_layers", 2)),
+            max_len=int(self.cache.get("max_len", 4096)),
+            causal=bool(self.cache.get("causal", False)),
+            dtype=jnp.dtype(self.cache.get("compute_dtype", "float32")),
+            attn_impl=self.cache.get("attn_impl"),
+        )
+
+    def example_inputs(self):
+        x = jnp.zeros(
+            (1, int(self.cache.get("seq_len", 128)),
+             int(self.cache.get("num_features", 16))),
+            jnp.float32,
+        )
+        return {"seq_net": (x,)}
+
+    def iteration(self, params, batch, rng=None):
+        logits = self.nn["seq_net"].apply(params["seq_net"], batch["inputs"])
+        loss = cross_entropy(logits, batch["labels"], mask=batch.get("_mask"))
+        return {
+            "loss": loss,
+            "pred": jnp.argmax(logits, -1),
+            "true": batch["labels"],
+        }

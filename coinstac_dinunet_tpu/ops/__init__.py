@@ -6,6 +6,7 @@ The reference delegates its hot numerical work to torch CUDA kernels
 jax functions (with a Pallas TPU kernel path for the hottest op) — see
 SURVEY.md §2 ("Consequence for the TPU build").
 """
+from .flash_attention import flash_attention  # noqa: F401
 from .power_iteration import orthogonalize, power_iteration_BC  # noqa: F401
 
-__all__ = ["power_iteration_BC", "orthogonalize"]
+__all__ = ["power_iteration_BC", "orthogonalize", "flash_attention"]

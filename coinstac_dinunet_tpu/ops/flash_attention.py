@@ -1,0 +1,274 @@
+"""Fused blockwise (flash) attention for TPU — Pallas kernel + XLA fallback.
+
+The reference framework has no attention anywhere (its models are user-supplied
+CNN/MLP classifiers, SURVEY.md §5 "Long-context: absent"); this op is part of
+the TPU build's first-class long-context support.  It is the single-device
+building block that :func:`~coinstac_dinunet_tpu.parallel.ring_attention.
+ring_attention` chains around a mesh axis for sequence parallelism.
+
+Design:
+
+- **Online softmax, never materializing the (Tq, Tk) score matrix** in HBM:
+  the Pallas kernel keeps a (block_q, head_dim) accumulator plus running
+  (max, sum) rows in VMEM and streams key/value blocks through the MXU.
+- **Position-offset masking** instead of a mask tensor: causal and key-length
+  masks are computed in-kernel from ``(q_offset, k_offset, kv_len)`` scalars
+  (SMEM), which is what lets the same kernel serve ring attention, where the
+  key block's global position changes every ring step.
+- **f32 softmax state regardless of input dtype** (bf16 in, f32 accumulate on
+  the MXU via ``preferred_element_type``).
+- **Fully-masked rows** use a large-negative sentinel rather than ``-inf`` so
+  the kernel stays NaN-free; such rows report ``lse ≈ -1e30`` and their output
+  is annihilated by the log-sum-exp merge in the ring step.
+- Backward is a blockwise XLA recompute from the saved ``(out, lse)``
+  residuals (the standard flash backward identities, including the lse
+  cotangent the ring merge produces) — no score matrix crosses passes.
+
+``impl='xla'`` computes the same (out, lse) contract with plain fused XLA ops
+— the CPU/test path and the ground truth for the kernel's unit tests.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # soft import: CPU-only deployments fall back to impl='xla'
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    _HAVE_PALLAS = True
+except Exception:  # noqa: BLE001
+    _HAVE_PALLAS = False
+
+_NEG = -1.0e30  # finite "-inf": keeps exp/max NaN-free for fully-masked rows
+_LANE = 128
+
+
+def _valid_mask(shape, aux, row_axis, col_axis, iq, j, block_q, block_k, causal):
+    """Key-validity (and optionally causal) mask from global positions.
+
+    ``aux = [q_offset, k_offset, kv_len]`` f32 scalars; row/col global ids are
+    the offsets plus block-local coordinates.
+    """
+    q_off = aux[0].astype(jnp.int32)
+    k_off = aux[1].astype(jnp.int32)
+    kv_len = aux[2].astype(jnp.int32)
+    rows = q_off + iq * block_q + lax.broadcasted_iota(jnp.int32, shape, row_axis)
+    cols_local = j * block_k + lax.broadcasted_iota(jnp.int32, shape, col_axis)
+    valid = cols_local < kv_len
+    if causal:
+        valid = jnp.logical_and(valid, rows >= k_off + cols_local)
+    return valid
+
+
+def _flash_kernel(aux_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                  *, scale, causal, block_q, block_k):
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+    nk = k_ref.shape[1] // block_k
+    d = q_ref.shape[-1]
+    aux = aux_ref[0]
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, block_k)
+        valid = _valid_mask(s.shape, aux, 0, 1, iq, j, block_q, block_k, causal)
+        s = jnp.where(valid, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l
+
+    acc, m, l = lax.fori_loop(
+        0, nk, body,
+        (
+            jnp.zeros((block_q, d), jnp.float32),
+            jnp.full((block_q,), _NEG, jnp.float32),
+            jnp.zeros((block_q,), jnp.float32),
+        ),
+    )
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = acc / l_safe[:, None]
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _pad_to(x, axis, multiple):
+    pad = (-x.shape[axis]) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_pallas(q, k, v, aux, scale, causal, block_q, block_k, interpret):
+    """(BH, Tq, d), (BH, Tk, d) → (out (BH, Tq, d) f32, lse (BH, Tq) f32)."""
+    bh, tq, d = q.shape
+    qp = _pad_to(q, 1, block_q)
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    tqp, tkp = qp.shape[1], kp.shape[1]
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, tqp // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 3), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tkp, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tkp, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tqp, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tqp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(aux.reshape(1, 3), qp, kp, vp)
+    return out[:, :tq], lse[:, :tq]
+
+
+def _flash_xla(q, k, v, aux, scale, causal):
+    """Same (out, lse) contract with plain XLA ops (CPU/reference path)."""
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32)
+    )
+    valid = _valid_mask(s.shape, aux, 1, 2, 0, 0, 0, 1, causal)
+    s = jnp.where(valid, s, _NEG)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
+    out = jnp.einsum("bqk,bkd->bqd", p / l[..., None], v.astype(jnp.float32))
+    return out, m + jnp.log(l)
+
+
+def _flash_core(q, k, v, aux, scale, causal, impl, block_q, block_k):
+    if impl == "xla":
+        return _flash_xla(q, k, v, aux, scale, causal)
+    if not _HAVE_PALLAS:
+        raise RuntimeError("Pallas unavailable; use impl='xla'")
+    return _flash_pallas(
+        q, k, v, aux, scale, causal, block_q, block_k,
+        interpret=(impl == "pallas_interpret"),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_pair(q, k, v, aux, scale, causal, impl, block_q, block_k):
+    return _flash_core(q, k, v, aux, scale, causal, impl, block_q, block_k)
+
+
+def _flash_fwd(q, k, v, aux, scale, causal, impl, block_q, block_k):
+    out, lse = _flash_core(q, k, v, aux, scale, causal, impl, block_q, block_k)
+    return (out, lse), (q, k, v, aux, out, lse)
+
+
+def _flash_bwd(scale, causal, impl, block_q, block_k, res, g):
+    """Blockwise backward: scan over key blocks, never materializing the
+    (Tq, Tk) score matrix — peak extra memory is O(Tq · block_k) per batch
+    row.  Standard flash identities from the saved (out, lse) residuals,
+    including the lse cotangent the ring merge produces."""
+    q, k, v, aux, out, lse = res
+    g_out = g[0].astype(jnp.float32)
+    g_lse = g[1].astype(jnp.float32)  # ring merge differentiates through lse
+    qf = q.astype(jnp.float32) * scale
+    tk = k.shape[1]
+    kp = _pad_to(k.astype(jnp.float32), 1, block_k)
+    vp = _pad_to(v.astype(jnp.float32), 1, block_k)
+    nk = kp.shape[1] // block_k
+    k_blocks = kp.reshape(kp.shape[0], nk, block_k, kp.shape[2])
+    v_blocks = vp.reshape(*k_blocks.shape)
+    # fully-masked rows carry the _NEG sentinel lse; zero it so exp stays
+    # finite (their p is hard-zeroed by the validity mask anyway)
+    lse_safe = jnp.where(lse <= _NEG / 2, 0.0, lse)[..., None]
+    delta = jnp.sum(g_out * out, axis=-1, keepdims=True)  # flash D_i identity
+
+    def body(dq, xs):
+        k_j, v_j, j = xs
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_j)
+        valid = _valid_mask(s.shape, aux, 1, 2, 0, j, 0, block_k, causal)
+        p = jnp.where(valid, jnp.exp(jnp.where(valid, s, _NEG) - lse_safe), 0.0)
+        dp = jnp.einsum("bqd,bkd->bqk", g_out, v_j)
+        ds = p * (dp - delta + g_lse[..., None])
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, k_j) * scale
+        dk_j = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        dv_j = jnp.einsum("bqk,bqd->bkd", p, g_out)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dk_b, dv_b) = lax.scan(
+        body, dq0,
+        (k_blocks.swapaxes(0, 1), v_blocks.swapaxes(0, 1), jnp.arange(nk)),
+    )
+    join = lambda b: b.swapaxes(0, 1).reshape(kp.shape)[:, :tk]
+    return (
+        dq.astype(q.dtype), join(dk_b).astype(k.dtype),
+        join(dv_b).astype(v.dtype), jnp.zeros_like(aux),
+    )
+
+
+_flash_pair.defvjp(_flash_fwd, _flash_bwd)
+
+
+def default_impl():
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def flash_attention(q, k, v, q_offset=0, k_offset=0, kv_len=None, causal=False,
+                    scale=None, impl=None, block_q=_LANE, block_k=_LANE,
+                    return_lse=False):
+    """Fused scaled-dot-product attention.
+
+    Args:
+      q: ``(batch, heads, Tq, head_dim)`` queries (f32 or bf16).
+      k, v: ``(batch, heads, Tk, head_dim)`` keys/values.
+      q_offset, k_offset: global position of row/col 0 — the causal mask is
+        computed on ``q_offset + i >= k_offset + j``.  May be traced values
+        (ring attention passes the rotating source rank's offset).
+      kv_len: number of valid keys (default ``Tk``); keys past it are masked.
+      causal: apply the causal mask.
+      scale: score scale (default ``1/sqrt(head_dim)``).
+      impl: ``'pallas'`` (TPU kernel), ``'pallas_interpret'`` (kernel under
+        the interpreter — CPU tests), ``'xla'`` (plain ops), or None for the
+        platform default.
+      return_lse: also return the per-row log-sum-exp ``(batch, heads, Tq)``
+        (f32) — required by the ring-attention merge.
+
+    Returns:
+      ``out (batch, heads, Tq, head_dim)`` in ``q.dtype``; optionally
+      ``(out, lse)``.
+    """
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if impl is None:
+        impl = default_impl()
+    aux = jnp.asarray(
+        [q_offset, k_offset, tk if kv_len is None else kv_len], jnp.float32
+    )
+    out, lse = _flash_pair(
+        q.reshape(b * h, tq, d), k.reshape(b * h, tk, d),
+        v.reshape(b * h, tk, d), aux, float(scale), bool(causal),
+        impl, int(block_q), int(block_k),
+    )
+    out = out.reshape(b, h, tq, d).astype(q.dtype)
+    if return_lse:
+        return out, lse.reshape(b, h, tq)
+    return out

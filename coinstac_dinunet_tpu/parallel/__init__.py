@@ -10,6 +10,7 @@ from .learner import COINNLearner  # noqa: F401
 from .reducer import COINNReducer  # noqa: F401
 from .powersgd import PowerSGDLearner, PowerSGDReducer  # noqa: F401
 from .rankdad import DADLearner, DADReducer  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401
 
 __all__ = [
     "COINNLearner",
@@ -18,4 +19,5 @@ __all__ = [
     "PowerSGDReducer",
     "DADLearner",
     "DADReducer",
+    "ring_attention",
 ]

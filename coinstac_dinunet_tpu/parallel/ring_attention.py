@@ -1,0 +1,91 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+First-class long-context support for the TPU framework (the reference has no
+sequence dimension anywhere — SURVEY.md §5 "Long-context: absent" — so this
+subsystem is new capability, designed TPU-first rather than ported).
+
+Each rank of the ``axis_name`` mesh axis holds a contiguous shard of the
+sequence: ``q, k, v`` are the local ``(batch, heads, T_local, head_dim)``
+blocks of a global ``T = n_ranks * T_local`` sequence.  The algorithm is the
+blockwise-parallel ring of Liu et al. (Ring Attention): every step each rank
+
+1. attends its resident queries to the key/value block currently in hand
+   (a fused :func:`~..ops.flash_attention.flash_attention` call that returns
+   the block's normalized output and log-sum-exp), and
+2. rotates the key/value block one hop around the ring with
+   ``lax.ppermute`` — a neighbor-to-neighbor transfer that rides ICI, which
+   XLA overlaps with the next step's attention compute.
+
+Partial outputs combine with the standard two-softmax merge: with per-row
+``lse`` values the merged output is the lse-weighted average and the merged
+lse the ``logaddexp``.  Blocks that are entirely causally masked report the
+``-1e30`` sentinel lse and thus merge with weight 0 — no special-casing, no
+NaNs, and no data-dependent control flow (the step count is the static ring
+size, so the whole loop jits into one ``lax.scan``).
+
+After ``n`` steps every k/v block has visited every rank and is back home;
+peak memory per rank stays O(T_local) regardless of global T.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.flash_attention import _NEG, flash_attention
+
+
+def _merge(acc, lse, o_new, lse_new):
+    """Combine two normalized softmax partials by their log-sum-exps."""
+    m = jnp.maximum(lse, lse_new)
+    w1 = jnp.exp(lse - m)
+    w2 = jnp.exp(lse_new - m)
+    tot = jnp.maximum(w1 + w2, 1e-30)
+    out = (acc * w1[..., None] + o_new * w2[..., None]) / tot[..., None]
+    return out, m + jnp.log(tot)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None, impl=None,
+                   block_q=128, block_k=128):
+    """Exact global attention over a sequence sharded on ``axis_name``.
+
+    Must run inside ``shard_map`` (or any context where ``axis_name`` is
+    bound).  Args/returns are the local shards:
+
+    Args:
+      q, k, v: ``(batch, heads, T_local, head_dim)`` — this rank's sequence
+        block (rank r holds global positions ``[r*T_local, (r+1)*T_local)``).
+      axis_name: mesh axis to ring over (the ``sp`` axis).
+      causal, scale, impl, block_q, block_k: forwarded to
+        :func:`flash_attention`.
+
+    Returns:
+      ``(batch, heads, T_local, head_dim)`` local output block, ``q.dtype``.
+    """
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    t_local = q.shape[2]
+    b, h = q.shape[0], q.shape[1]
+
+    def step(carry, s):
+        acc, lse, kk, vv = carry
+        src = (r - s) % n  # rank the in-hand kv block originated from
+        o_new, lse_new = flash_attention(
+            q, kk, vv,
+            q_offset=r * t_local, k_offset=src * t_local,
+            causal=causal, scale=scale, impl=impl,
+            block_q=block_q, block_k=block_k, return_lse=True,
+        )
+        acc, lse = _merge(acc, lse, o_new.astype(jnp.float32), lse_new)
+        kk, vv = jax.tree_util.tree_map(
+            lambda x: lax.ppermute(
+                x, axis_name, perm=[(i, (i + 1) % n) for i in range(n)]
+            ),
+            (kk, vv),
+        )
+        return (acc, lse, kk, vv), None
+
+    # derive the init buffers from q so they carry its device-varying type
+    # (shard_map's vma check rejects a replicated scan carry init)
+    acc0 = jnp.zeros_like(q, jnp.float32)
+    lse0 = jnp.full((b, h, t_local), _NEG, jnp.float32) + 0.0 * q[..., 0].astype(jnp.float32)
+    (acc, _, _, _), _ = lax.scan(step, (acc0, lse0, k, v), jnp.arange(n))
+    return acc.astype(q.dtype)

@@ -1,0 +1,185 @@
+"""Tensor + sequence + data parallel transformer step (dp × tp × sp mesh).
+
+The scale-out path for the long-context family: a Megatron-style sharded
+transformer where
+
+- **dp** shards the batch (federated-site data parallelism maps here too),
+- **tp** shards attention heads and the MLP hidden dimension,
+- **sp** shards the sequence, with exact global attention via
+  :func:`~.ring_attention.ring_attention`.
+
+Idiomatic split of responsibilities (the scaling-book recipe): parameters and
+inputs get ``NamedSharding`` annotations and GSPMD derives every tensor- and
+data-parallel collective (all-reduces for the row/column-sharded matmuls, the
+gradient reductions) from them — nothing is hand-scheduled.  Only the ring is
+manual: GSPMD cannot infer a ring schedule, so the attention inner loop runs
+in a nested ``shard_map`` over the ``sp`` axis where ``ppermute`` hops are
+explicit.  No reference counterpart (SURVEY.md §5: sequence parallelism
+absent there); mesh/axis conventions follow ``parallel/mesh.py``.
+"""
+import math
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .ring_attention import ring_attention
+
+__all__ = ["TSPConfig", "build_tsp_mesh", "init_tsp_params", "shard_tsp_params",
+           "tsp_forward", "make_tsp_train_step"]
+
+
+class TSPConfig:
+    """Static transformer hyperparameters for the sharded step."""
+
+    def __init__(self, num_features=16, num_classes=2, d_model=128, num_heads=8,
+                 num_layers=2, mlp_ratio=4, max_len=4096, causal=False,
+                 dtype=jnp.float32, attn_impl=None):
+        self.num_features = num_features
+        self.num_classes = num_classes
+        self.d_model = d_model
+        self.num_heads = num_heads
+        self.num_layers = num_layers
+        self.d_ff = mlp_ratio * d_model
+        self.max_len = max_len
+        self.causal = causal
+        self.dtype = dtype
+        self.attn_impl = attn_impl
+        self.head_dim = d_model // num_heads
+        assert d_model % num_heads == 0
+
+
+def build_tsp_mesh(dp=1, tp=1, sp=1, devices=None):
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * tp * sp
+    if need > len(devices):
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(dp, tp, sp)
+    return Mesh(arr, ("dp", "tp", "sp"))
+
+
+def init_tsp_params(key, cfg):
+    """Plain pytree of arrays; sharding is applied by :func:`shard_tsp_params`."""
+    d, h, hd, ff = cfg.d_model, cfg.num_heads, cfg.head_dim, cfg.d_ff
+    k = iter(jax.random.split(key, 4 + 8 * cfg.num_layers))
+    init = lambda kk, shape, scale: (
+        jax.random.normal(kk, shape, jnp.float32) * scale
+    )
+    params = {
+        "in_proj": init(next(k), (cfg.num_features, d), 1 / math.sqrt(cfg.num_features)),
+        "pos": init(next(k), (cfg.max_len, d), 0.02),
+        "head": init(next(k), (d, cfg.num_classes), 1 / math.sqrt(d)),
+        "lnf": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+        "layers": [],
+    }
+    for _ in range(cfg.num_layers):
+        params["layers"].append({
+            "ln1": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+            "wqkv": init(next(k), (3, d, h, hd), 1 / math.sqrt(d)),
+            "wo": init(next(k), (h, hd, d), 1 / math.sqrt(d)),
+            "ln2": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
+            "w1": init(next(k), (d, ff), 1 / math.sqrt(d)),
+            "b1": jnp.zeros((ff,)),
+            "w2": init(next(k), (ff, d), 1 / math.sqrt(ff)),
+            "b2": jnp.zeros((d,)),
+        })
+    return params
+
+
+def _param_specs(params):
+    """PartitionSpec tree: heads / d_ff sharded over tp, rest replicated."""
+    def spec_for(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        return {
+            "wqkv": P(None, None, "tp", None),  # (3, d, h/tp, hd)
+            "wo": P("tp", None, None),          # (h/tp, hd, d)
+            "w1": P(None, "tp"),                # (d, ff/tp)
+            "b1": P("tp"),
+            "w2": P("tp", None),                # (ff/tp, d)
+        }.get(name, P())
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_tsp_params(params, mesh):
+    specs = _param_specs(params)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def _layernorm(x, p):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + 1e-6) * p["scale"] + p["bias"]
+
+
+def tsp_forward(params, x, cfg, mesh):
+    """Logits for (B, T, F) inputs; B sharded over dp, T over sp, heads/ff
+    over tp — all via sharding constraints except the explicit ring."""
+    dtype = cfg.dtype
+    x = jnp.asarray(x, dtype)
+    b, t, _ = x.shape
+    constrain = lambda a, s: lax.with_sharding_constraint(a, NamedSharding(mesh, s))
+
+    h = x @ params["in_proj"].astype(dtype) + params["pos"][:t].astype(dtype)
+    h = constrain(h, P("dp", "sp", None))
+
+    qkv_spec = P("dp", "tp", "sp", None)
+    ring = jax.shard_map(
+        partial(
+            ring_attention, axis_name="sp", causal=cfg.causal,
+            impl=cfg.attn_impl,
+        ),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec,
+    )
+
+    for lp in params["layers"]:
+        z = _layernorm(h, lp["ln1"]).astype(dtype)
+        qkv = jnp.einsum("btd,cdhe->cbhte", z, lp["wqkv"].astype(dtype))
+        qkv = constrain(qkv, P(None, "dp", "tp", "sp", None))
+        attn = ring(qkv[0], qkv[1], qkv[2])
+        o = jnp.einsum("bhte,hed->btd", attn, lp["wo"].astype(dtype))
+        h = h + constrain(o, P("dp", "sp", None))
+
+        z = _layernorm(h, lp["ln2"]).astype(dtype)
+        m = jax.nn.gelu(z @ lp["w1"].astype(dtype) + lp["b1"].astype(dtype))
+        m = constrain(m, P("dp", "sp", "tp"))
+        h = h + constrain(m @ lp["w2"].astype(dtype) + lp["b2"].astype(dtype),
+                          P("dp", "sp", None))
+
+    h = _layernorm(h.astype(jnp.float32), params["lnf"])
+    pooled = jnp.mean(h, axis=1)  # (B, d) — mean over the full sequence
+    return pooled @ params["head"]
+
+
+def make_tsp_train_step(cfg, mesh, lr=1e-3):
+    """Jit-compiled SGD step over the dp×tp×sp mesh.
+
+    Gradient collectives (dp/sp reductions, tp-sharded layouts) all come from
+    GSPMD transposing the forward shardings — returns ``(params, loss)``.
+    """
+
+    def loss_fn(params, x, y):
+        logits = tsp_forward(params, x, cfg, mesh)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+    @jax.jit
+    def step(params, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    return step
+
+
+def shard_tsp_batch(x, y, mesh):
+    x = jax.device_put(x, NamedSharding(mesh, P("dp", "sp", None)))
+    y = jax.device_put(y, NamedSharding(mesh, P("dp")))
+    return x, y

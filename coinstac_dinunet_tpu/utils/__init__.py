@@ -6,10 +6,18 @@ extended to understand JAX arrays when sanitizing payloads to JSON.
 """
 import json
 import os
+import zlib
 
 import numpy as np
 
 from .logger import lazy_debug  # noqa: F401 (re-export)
+
+
+def stable_file_id(file):
+    """Process-stable 31-bit id for a filename (crc32, not Python ``hash`` —
+    which is salted per process and would desynchronize federated sites'
+    synthetic data)."""
+    return zlib.crc32(str(file).encode()) % (2 ** 31)
 
 
 class FrozenDict(dict):

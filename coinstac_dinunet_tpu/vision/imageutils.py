@@ -120,6 +120,7 @@ class Image:
 
     def __copy__(self):
         out = Image(dtype=_copy.deepcopy(self.dtype))
+        out.dir = self.dir
         out.file = self.file
         out.array = _copy.copy(self.array)
         out.mask = _copy.copy(self.mask)
@@ -259,7 +260,7 @@ def get_chunk_indexes(img_shape, chunk_shape, offset=None):
         starts = []
         for i in range(0, size, step):
             if i + chunk >= size:
-                starts.append(size - chunk)
+                starts.append(max(size - chunk, 0))
                 break
             starts.append(i)
         axes.append(starts)
@@ -268,7 +269,8 @@ def get_chunk_indexes(img_shape, chunk_shape, offset=None):
         out = []
         for ax, i in enumerate(idx):
             start = axes[ax][i]
-            out += [int(start), int(start + chunk_shape[ax])]
+            # clamp: an image smaller than the chunk yields one full-image patch
+            out += [int(start), int(min(start + chunk_shape[ax], img_shape[ax]))]
         yield out
 
 
@@ -283,20 +285,22 @@ def get_chunk_indices_by_index(img_shape, chunk_shape, indices=None):
             if lo < 0:
                 lo, hi = 0, chunk
             if hi > size:
-                lo, hi = size - chunk, size
-            corners += [int(lo), int(hi)]
+                lo, hi = max(size - chunk, 0), size
+            corners += [int(lo), int(min(hi, size))]
         out.append(corners)
     return out
 
 
-def merge_patches(patches, image_size, patch_size, offset=None):
+def merge_patches(patches, image_size, patch_size, offset=None, out_dtype=None):
     """Reassemble patches produced by :func:`get_chunk_indexes`; overlaps
     averaged by true coverage count.
 
     Unlike the reference (``imageutils.py:229-250``) this accumulates into a
     single sum/count buffer pair with slice assignment (no per-patch
-    full-image pad) and counts every covered pixel — the reference's
-    ``padded > 0`` test drops zero-valued patch pixels from the denominator.
+    full-image pad), counts every covered pixel — the reference's
+    ``padded > 0`` test drops zero-valued patch pixels from the denominator —
+    and preserves the patch dtype (probability maps stay float;
+    ``out_dtype`` overrides, the reference always clamped to uint8).
     """
     acc = np.zeros(tuple(image_size), np.float64)
     cnt = np.zeros(tuple(image_size), np.int64)
@@ -304,9 +308,12 @@ def merge_patches(patches, image_size, patch_size, offset=None):
         sl = tuple(
             slice(corners[2 * d], corners[2 * d + 1]) for d in range(len(image_size))
         )
-        acc[sl] += np.asarray(patches[i]).reshape(tuple(patch_size))
+        shape = tuple(s.stop - s.start for s in sl)
+        acc[sl] += np.asarray(patches[i]).reshape(shape)
         cnt[sl] += 1
-    return (acc / np.maximum(cnt, 1)).astype(np.uint8)
+    if out_dtype is None:
+        out_dtype = np.asarray(patches[0]).dtype
+    return (acc / np.maximum(cnt, 1)).astype(out_dtype)
 
 
 def expand_and_mirror_patch(full_img_shape, orig_patch_indices, expand_by):

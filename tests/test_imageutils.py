@@ -140,6 +140,36 @@ def test_merge_counts_true_coverage():
     np.testing.assert_array_equal(out, img)
 
 
+def test_chunk_indexes_image_smaller_than_chunk():
+    # one clamped full-image patch per axis — no negative corners
+    boxes = list(iu.get_chunk_indexes((3, 8), (4, 4), (4, 4)))
+    assert all(b[0] >= 0 and b[2] >= 0 for b in boxes)
+    assert boxes[0][:2] == [0, 3]
+
+
+def test_merge_patches_preserves_float_dtype():
+    img = np.random.default_rng(0).random((8, 8)).astype(np.float32)
+    chunk = (4, 4)
+    patches = [
+        img[r0:r1, c0:c1]
+        for r0, r1, c0, c1 in iu.get_chunk_indexes(img.shape, chunk, chunk)
+    ]
+    out = iu.merge_patches(np.array(patches), img.shape, chunk, chunk)
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, img, atol=1e-6)
+
+
+def test_image_copy_keeps_dir(tmp_path):
+    from PIL import Image as PILImage
+    import copy
+
+    PILImage.fromarray(np.zeros((4, 4), np.uint8)).save(tmp_path / "a.png")
+    img = iu.Image()
+    img.load(str(tmp_path), "a.png")
+    dup = copy.copy(img)
+    assert dup.path == img.path
+
+
 def test_expand_and_mirror_patch():
     lo0, hi0, lo1, hi1, pads = iu.expand_and_mirror_patch(
         (10, 10), (0, 4, 6, 10), (4, 4)
