@@ -26,14 +26,26 @@ metrics_eps = 1e-5  # epsilon guarding divide-by-zero in metric ratios
 metrics_num_precision = 5  # decimal places for reported scores
 score_delta = 0.0  # minimum improvement to count as "better"
 
-# default floating point width of tensors on the wire; 32 or 16
+# default width of tensors on the wire: 64/32/16 = float dtypes, 8 = the
+# stochastic-rounding int8 codec (ops/quantize.py — beyond the reference's
+# float16 floor, ``distrib/learner.py:17``)
 default_precision_bits = 32
 
 
 def wire_dtype(precision_bits=None):
-    """numpy dtype used to serialize gradients/activations for transport."""
+    """numpy dtype used to serialize gradients/activations for transport.
+
+    At 8 bits the *storage* is the int8+scales codec; arrays still enter and
+    leave the wire as float32.
+    """
     bits = int(precision_bits or default_precision_bits)
-    return {16: np.float16, 32: np.float32, 64: np.float64}[bits]
+    return {8: np.float32, 16: np.float16, 32: np.float32, 64: np.float64}[bits]
+
+
+def wire_codec(precision_bits=None):
+    """Payload codec name for :func:`utils.tensorutils.pack_arrays`."""
+    bits = int(precision_bits or default_precision_bits)
+    return "int8" if bits == 8 else None
 
 
 # ---- accelerator detection -------------------------------------------------

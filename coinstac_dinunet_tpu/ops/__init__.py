@@ -8,5 +8,9 @@ SURVEY.md §2 ("Consequence for the TPU build").
 """
 from .flash_attention import flash_attention  # noqa: F401
 from .power_iteration import orthogonalize, power_iteration_BC  # noqa: F401
+from .quantize import dequantize_int8, quantize_int8  # noqa: F401
 
-__all__ = ["power_iteration_BC", "orthogonalize", "flash_attention"]
+__all__ = [
+    "power_iteration_BC", "orthogonalize", "flash_attention",
+    "quantize_int8", "dequantize_int8",
+]

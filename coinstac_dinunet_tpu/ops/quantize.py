@@ -1,0 +1,115 @@
+"""Group-wise int8 quantization with stochastic rounding — wire compression.
+
+The reference's only wire-size lever is casting gradients to float16
+(``precision_bits``, ``distrib/learner.py:17``, ``config/__init__.py``).  This
+op adds an int8 codec — 4× smaller than f32, 2× smaller than the reference's
+best — built TPU-first:
+
+- **Group-wise scales**: the flattened tensor is viewed as groups of 128
+  lanes; each group stores one f32 scale (absmax/127).  Groups match the VPU
+  lane width, so the kernel is one vectorized pass.
+- **Stochastic rounding** (``pltpu.stochastic_round`` on TPU; numpy fallback
+  elsewhere): quantization noise is zero-mean, so averaging many sites'
+  quantized gradients stays unbiased — deterministic rounding would bias the
+  federated mean.
+- Used by the engine transport as a transparent payload codec
+  (``utils/tensorutils.py`` ``save_arrays(codec='int8')``): the receiver gets
+  float arrays back and the learners/reducers never know.
+
+No reference counterpart to cite beyond the precision knob; design follows
+the public stochastic-rounding quantization pattern (pallas guide §19).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+try:  # soft import — CPU-only deployments use the numpy path
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except Exception:  # noqa: BLE001
+    _HAVE_PALLAS = False
+
+GROUP = 128  # elements per scale group = VPU lane width
+
+
+def _quant_kernel(seed_ref, x_ref, v_ref, s_ref):
+    pltpu.prng_seed(seed_ref[0])
+    x = x_ref[:]  # (rows, GROUP) f32
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = jnp.maximum(absmax / 127.0, 1e-30)
+    scaled = x / scale
+    # stochastic round by hand (floor + Bernoulli(frac)) — same semantics as
+    # pltpu.stochastic_round but portable to the CPU interpreter for tests
+    bits = pltpu.bitcast(pltpu.prng_random_bits(scaled.shape), jnp.uint32)
+    u = bits.astype(jnp.float32) * (1.0 / 4294967296.0)
+    lo = jnp.floor(scaled)
+    vals = lo + (u < (scaled - lo)).astype(jnp.float32)
+    v_ref[:] = jnp.clip(vals, -127, 127).astype(jnp.int8)
+    s_ref[:] = scale
+
+
+def _quantize_pallas(groups, seed, interpret):
+    rows = groups.shape[0]
+    return pl.pallas_call(
+        _quant_kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, GROUP), jnp.int8),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        # the TPU-flavored interpreter implements pltpu prng on CPU
+        interpret=pltpu.InterpretParams() if interpret else False,
+    )(jnp.asarray([seed], jnp.int32), groups)
+
+
+def _quantize_numpy(groups, seed):
+    rng = np.random.default_rng(seed)
+    absmax = np.max(np.abs(groups), axis=1, keepdims=True)
+    scale = np.maximum(absmax / 127.0, 1e-30).astype(np.float32)
+    scaled = groups / scale
+    lo = np.floor(scaled)
+    frac = scaled - lo
+    vals = lo + (rng.random(scaled.shape) < frac)
+    return np.clip(vals, -127, 127).astype(np.int8), scale
+
+
+def quantize_int8(x, seed=0, impl=None):
+    """Quantize any-shape float array → ``(values int8, scales f32)``.
+
+    ``values`` has the flattened-padded shape ``(ceil(n/128), 128)``; dequant
+    restores the original shape.  ``impl``: ``'pallas'``/``'pallas_interpret'``
+    (TPU kernel), ``'numpy'``, or None for platform default.
+    """
+    if impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "numpy"
+    shape = tuple(np.shape(x))
+    flat = np.asarray(x, np.float32).reshape(-1) if impl == "numpy" else \
+        jnp.asarray(x, jnp.float32).reshape(-1)
+    n = flat.shape[0]
+    pad = (-n) % GROUP
+    if impl == "numpy":
+        groups = np.pad(flat, (0, pad)).reshape(-1, GROUP)
+        vals, scales = _quantize_numpy(groups, seed)
+    else:
+        groups = jnp.pad(flat, (0, pad)).reshape(-1, GROUP)
+        vals, scales = _quantize_pallas(groups, seed, impl == "pallas_interpret")
+    return vals, scales, shape
+
+
+def dequantize_int8(values, scales, shape):
+    """Inverse of :func:`quantize_int8` → float32 array of ``shape``."""
+    values = np.asarray(values, np.float32)
+    scales = np.asarray(scales, np.float32)
+    flat = (values * scales).reshape(-1)
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    return flat[:n].reshape(shape)

@@ -17,7 +17,7 @@ import os
 
 from .. import config
 from ..config.keys import Key, Mode
-from ..utils import tensorutils
+from ..utils import stable_file_id, tensorutils
 
 
 class COINNLearner:
@@ -40,6 +40,26 @@ class COINNLearner:
         d = self.state.get("transferDirectory", ".")
         os.makedirs(d, exist_ok=True)
         return os.path.join(d, fname)
+
+    def _save_wire(self, fname, arrays):
+        """Serialize outbound arrays with the configured wire precision.
+
+        At ``precision_bits=8`` this applies the stochastic int8 codec with a
+        seed salted by the site id AND advanced every call — rounding noise
+        must be independent across sites and rounds, or the aggregator's mean
+        gains no variance reduction from averaging."""
+        seed = (
+            stable_file_id(self.state.get("clientId", ""))
+            + int(self.cache.get("_wire_seed", 0))
+        ) % (2 ** 31)
+        tensorutils.save_arrays(
+            self._transfer_path(fname), arrays,
+            codec=config.wire_codec(self.precision_bits), seed=seed,
+        )
+        self.cache["_wire_seed"] = (
+            int(self.cache.get("_wire_seed", 0)) + len(arrays)
+        )
+        return fname
 
     def _base_path(self, fname):
         return os.path.join(self.state.get("baseDirectory", "."), fname)
@@ -86,7 +106,7 @@ class COINNLearner:
         if grads is None:
             return out
         flat = tensorutils.extract_grads(grads, self.precision_bits)
-        tensorutils.save_arrays(self._transfer_path(config.grads_file), flat)
+        self._save_wire(config.grads_file, flat)
         out["grads_file"] = config.grads_file
         out["reduce"] = True
         self._track_train_scores(aux)

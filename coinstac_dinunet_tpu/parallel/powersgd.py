@@ -131,10 +131,7 @@ class PowerSGDLearner(COINNLearner):
         st.Ms = [M + e for M, e in zip(Ms, st.errors)]
         Ps = _compute_P(st.Ms, st.Qs)
         wire = config.wire_dtype(self.precision_bits)
-        tensorutils.save_arrays(
-            self._transfer_path(config.powersgd_P_file),
-            [np.asarray(P, wire) for P in Ps],
-        )
+        self._save_wire(config.powersgd_P_file, [np.asarray(P, wire) for P in Ps])
         out["powerSGD_P_file"] = config.powersgd_P_file
         out["powerSGD_phase"] = PHASE_P_SYNC
         out["reduce"] = True
@@ -150,11 +147,8 @@ class PowerSGDLearner(COINNLearner):
         Qs, Phats = _compute_Q(st.Ms, [jnp.asarray(P, jnp.float32) for P in avg_P])
         st.Phats = Phats
         wire = config.wire_dtype(self.precision_bits)
-        tensorutils.save_arrays(
-            self._transfer_path(config.powersgd_Q_file),
-            [np.asarray(Q, wire) for Q in Qs],
-        )
-        tensorutils.save_arrays(self._transfer_path(rank1_file), st.rank1)
+        self._save_wire(config.powersgd_Q_file, [np.asarray(Q, wire) for Q in Qs])
+        self._save_wire(rank1_file, st.rank1)
         out["powerSGD_Q_file"] = config.powersgd_Q_file
         out["rank1_file"] = rank1_file
         out["powerSGD_phase"] = PHASE_Q_SYNC

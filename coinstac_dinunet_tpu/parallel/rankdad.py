@@ -224,11 +224,8 @@ class DADLearner(COINNLearner):
         for lk in st.layer_keys:
             payload.append(np.asarray(Brs[lk], wire))
             payload.append(np.asarray(Crs[lk], wire))
-        tensorutils.save_arrays(self._transfer_path(config.dad_data_file), payload)
-        tensorutils.save_arrays(
-            self._transfer_path(dad_rest_file),
-            [np.asarray(g, wire) for g in rest],
-        )
+        self._save_wire(config.dad_data_file, payload)
+        self._save_wire(dad_rest_file, [np.asarray(g, wire) for g in rest])
         out["dad_data_file"] = config.dad_data_file
         out["dad_rest_file"] = dad_rest_file
         out["reduce"] = True

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import config
-from ..utils import tensorutils
+from ..utils import stable_file_id, tensorutils
 
 
 @jax.jit
@@ -57,9 +57,22 @@ class COINNReducer:
             return list(ex.map(tensorutils.load_arrays, paths))
 
     def _save_out(self, fname, arrays):
+        """Outbound (aggregator → sites) payloads honor the wire precision
+        too; the aggregator's rounding seed is salted apart from every site's
+        and advanced per call."""
         d = self.state.get("transferDirectory", ".")
         os.makedirs(d, exist_ok=True)
-        tensorutils.save_arrays(os.path.join(d, fname), arrays)
+        seed = (
+            stable_file_id("remote-aggregator")
+            + int(self.cache.get("_wire_seed", 0))
+        ) % (2 ** 31)
+        tensorutils.save_arrays(
+            os.path.join(d, fname), arrays,
+            codec=config.wire_codec(self.precision_bits), seed=seed,
+        )
+        self.cache["_wire_seed"] = (
+            int(self.cache.get("_wire_seed", 0)) + len(arrays)
+        )
         return fname
 
     # ---------------------------------------------------------------- reduce

@@ -23,15 +23,33 @@ from .. import config
 _MAGIC = b"COINNTW1"  # COINN Tensor Wire v1
 
 
-def pack_arrays(arrays):
-    """Pack a list of ndarrays into one bytes payload (manifest + raw data)."""
+def pack_arrays(arrays, codec=None, seed=0):
+    """Pack a list of ndarrays into one bytes payload (manifest + raw data).
+
+    ``codec='int8'`` stores each float array as stochastic-rounded group-wise
+    int8 values + f32 scales (``ops/quantize.py``) — 4× smaller than f32 on
+    the wire, decoded transparently by :func:`unpack_arrays`.  Non-float
+    arrays pass through raw.
+    """
     arrays = [np.ascontiguousarray(a) for a in arrays]
-    manifest = json.dumps(
-        [{"shape": list(a.shape), "dtype": a.dtype.str} for a in arrays]
-    ).encode("utf-8")
-    parts = [_MAGIC, struct.pack("<Q", len(manifest)), manifest]
-    parts += [a.tobytes() for a in arrays]
-    return b"".join(parts)
+    entries, blobs = [], []
+    for i, a in enumerate(arrays):
+        if codec == "int8" and np.issubdtype(a.dtype, np.floating):
+            from ..ops.quantize import quantize_int8
+
+            vals, scales, shape = quantize_int8(a, seed=seed + i)
+            vals = np.ascontiguousarray(vals)
+            scales = np.ascontiguousarray(scales, np.float32)
+            entries.append({
+                "shape": list(shape), "dtype": a.dtype.str, "codec": "int8",
+                "groups": int(vals.shape[0]),
+            })
+            blobs += [vals.tobytes(), scales.tobytes()]
+        else:
+            entries.append({"shape": list(a.shape), "dtype": a.dtype.str})
+            blobs.append(a.tobytes())
+    manifest = json.dumps(entries).encode("utf-8")
+    return b"".join([_MAGIC, struct.pack("<Q", len(manifest)), manifest] + blobs)
 
 
 def unpack_arrays(payload):
@@ -46,6 +64,19 @@ def unpack_arrays(payload):
     out = []
     for item in manifest:
         dt = np.dtype(item["dtype"])
+        if item.get("codec") == "int8":
+            from ..ops.quantize import GROUP, dequantize_int8
+
+            g = int(item["groups"])
+            vals = np.frombuffer(payload, np.int8, count=g * GROUP, offset=off)
+            off += g * GROUP
+            scales = np.frombuffer(payload, np.float32, count=g, offset=off)
+            off += g * 4
+            arr = dequantize_int8(
+                vals.reshape(g, GROUP), scales.reshape(g, 1), tuple(item["shape"])
+            ).astype(dt)
+            out.append(arr)
+            continue
         n = int(np.prod(item["shape"], dtype=np.int64)) if item["shape"] else 1
         nbytes = n * dt.itemsize
         arr = np.frombuffer(payload, dtype=dt, count=n, offset=off)
@@ -54,13 +85,13 @@ def unpack_arrays(payload):
     return out
 
 
-def save_arrays(path, arrays):
+def save_arrays(path, arrays, codec=None, seed=0):
     """Write a list of arrays (or a single array) to ``path``."""
     if isinstance(arrays, np.ndarray):
         arrays = [arrays]
     arrays = [np.asarray(a) for a in arrays]
     with open(path, "wb") as f:
-        f.write(pack_arrays(arrays))
+        f.write(pack_arrays(arrays, codec=codec, seed=seed))
 
 
 def load_arrays(path):

@@ -53,6 +53,12 @@ def test_full_federated_run_reaches_success(tmp_path):
     assert len(eng.remote_cache["validation_log"]) >= 1
 
 
+def test_federated_int8_wire_run(tmp_path):
+    """dSGD with the 8-bit stochastic wire codec still converges to SUCCESS."""
+    eng = _make_engine(tmp_path, precision_bits=8).run(max_rounds=600)
+    assert eng.success, f"no SUCCESS after {eng.rounds} rounds"
+
+
 def test_federated_sites_stay_in_lockstep(tmp_path):
     """Identical init + identical averaged grads ⇒ identical params at every
     site after any number of rounds (the core federated invariant)."""

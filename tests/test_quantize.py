@@ -1,0 +1,65 @@
+"""int8 stochastic-rounding wire codec: numpy + Pallas-interpret paths,
+unbiasedness, and transparent round-trip through save/load_arrays.
+"""
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu.ops import dequantize_int8, quantize_int8
+from coinstac_dinunet_tpu.utils import tensorutils as tu
+
+
+@pytest.mark.parametrize("impl", ["numpy", "pallas_interpret"])
+def test_quantize_roundtrip_error_bounded(impl):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(37, 19)).astype(np.float32)  # non-multiple of 128
+    vals, scales, shape = quantize_int8(x, seed=1, impl=impl)
+    out = dequantize_int8(vals, scales, shape)
+    assert out.shape == x.shape
+    # per-group error bounded by one quantization step (= scale)
+    err = np.abs(out - x)
+    assert err.max() <= np.max(np.abs(x)) / 127.0 + 1e-6
+
+
+def test_quantize_stochastic_rounding_unbiased():
+    # averaging many independently-seeded quantizations converges to x
+    x = np.full((4, 50), 0.3_3, np.float32)
+    acc = np.zeros_like(x)
+    n = 200
+    for s in range(n):
+        vals, scales, shape = quantize_int8(x, seed=s, impl="numpy")
+        acc += dequantize_int8(vals, scales, shape)
+    mean_err = np.abs(acc / n - x).max()
+    one_step = np.max(np.abs(x)) / 127.0
+    assert mean_err < one_step * 0.2, mean_err
+
+
+def test_pallas_interpret_matches_numpy_scale():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(256,)).astype(np.float32)
+    _, s1, _ = quantize_int8(x, impl="numpy")
+    _, s2, _ = quantize_int8(x, impl="pallas_interpret")
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-6)
+
+
+def test_wire_codec_transparent(tmp_path):
+    rng = np.random.default_rng(3)
+    arrays = [
+        rng.normal(size=(33, 7)).astype(np.float32),
+        np.arange(10, dtype=np.int64),  # non-float passes through raw
+        rng.normal(size=(5,)).astype(np.float64),
+    ]
+    p = tmp_path / "w.bin"
+    tu.save_arrays(p, arrays, codec="int8")
+    back = tu.load_arrays(p)
+    assert back[0].dtype == np.float32 and back[0].shape == (33, 7)
+    np.testing.assert_array_equal(back[1], arrays[1])
+    for a, b in zip(arrays[::2], back[::2]):
+        step = np.max(np.abs(a)) / 127.0
+        assert np.abs(np.asarray(b, np.float64) - a).max() <= step + 1e-9
+
+
+def test_wire_codec_shrinks_payload(tmp_path):
+    x = np.random.default_rng(4).normal(size=(256, 256)).astype(np.float32)
+    raw = tu.pack_arrays([x])
+    q = tu.pack_arrays([x], codec="int8")
+    assert len(q) < len(raw) * 0.3  # ~4x smaller
