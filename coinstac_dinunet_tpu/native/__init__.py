@@ -1,0 +1,155 @@
+"""ctypes bindings for the native wire runtime (``wire.cc``).
+
+Builds ``libcoinnwire.so`` with g++ on first import (cached beside the
+source; rebuilt when ``wire.cc`` changes), exposes
+
+- :func:`pack_file` — gather-write a header + list of buffers with zero
+  payload joins,
+- :func:`load_file` / :func:`load_many` — bulk (and GIL-free parallel)
+  payload reads,
+- :func:`checksum` — 64-bit payload integrity hash,
+
+and degrades cleanly: :func:`available` is False when no compiler or load
+fails, and callers (``utils/tensorutils``, ``parallel/reducer``) fall back to
+the pure-Python path.  Set ``COINN_NATIVE=0`` to force the fallback.
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "wire.cc")
+_LIB = os.path.join(_DIR, "libcoinnwire.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        _SRC, "-o", _LIB,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("COINN_NATIVE", "1") == "0":
+            return None
+        try:
+            if (not os.path.exists(_LIB)
+                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+            lib.coinn_abi_version.restype = ctypes.c_int32
+            if lib.coinn_abi_version() != 1:
+                return None
+            lib.coinn_checksum.restype = ctypes.c_uint64
+            lib.coinn_checksum.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+            lib.coinn_pack_file.restype = ctypes.c_int32
+            lib.coinn_pack_file.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_int32,
+            ]
+            lib.coinn_load_file.restype = ctypes.c_uint64
+            lib.coinn_load_file.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ]
+            lib.coinn_load_many.restype = None
+            lib.coinn_load_many.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int32,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.coinn_free.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception:  # noqa: BLE001 — no compiler / bad toolchain
+            _lib = None
+        return _lib
+
+
+def available():
+    return _load() is not None
+
+
+def checksum(buf):
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native wire runtime unavailable")
+    b = bytes(buf)
+    return int(lib.coinn_checksum(b, len(b)))
+
+
+def pack_file(path, header, buffers):
+    """Write ``header`` then each buffer in ``buffers`` to ``path`` via the
+    native gather-write.  Returns False if the native path is unavailable
+    (caller should fall back)."""
+    lib = _load()
+    if lib is None:
+        return False
+    n = len(buffers)
+    # keep contiguous byte views alive for the duration of the call
+    views = [
+        b if isinstance(b, (bytes, bytearray)) else bytes(b) for b in buffers
+    ]
+    bufs = (ctypes.c_char_p * n)(*views)
+    sizes = (ctypes.c_uint64 * n)(*[len(v) for v in views])
+    rc = lib.coinn_pack_file(
+        os.fsencode(path), bytes(header), len(header),
+        ctypes.cast(bufs, ctypes.POINTER(ctypes.c_char_p)), sizes, n,
+    )
+    return rc == 0
+
+
+def load_file(path):
+    """Read the whole file via the native bulk reader; None on failure."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    size = lib.coinn_load_file(os.fsencode(path), ctypes.byref(out))
+    if size == 0:
+        if os.path.exists(path) and os.path.getsize(path) == 0:
+            return b""
+        return None
+    try:
+        return ctypes.string_at(out, size)
+    finally:
+        lib.coinn_free(out)
+
+
+def load_many(paths):
+    """Load several files concurrently (native threads, no GIL, no process
+    pool — ≙ ref ``distrib/reducer.py:18-23``).  Returns list of bytes (None
+    for failed entries), or None when native is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(paths)
+    if n == 0:
+        return []
+    arr = (ctypes.c_char_p * n)(*[os.fsencode(p) for p in paths])
+    outs = (ctypes.POINTER(ctypes.c_uint8) * n)()
+    sizes = (ctypes.c_uint64 * n)()
+    lib.coinn_load_many(
+        ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p)), n, outs, sizes
+    )
+    result = []
+    for i in range(n):
+        if sizes[i] == 0:
+            ok_empty = os.path.exists(paths[i]) and os.path.getsize(paths[i]) == 0
+            result.append(b"" if ok_empty else None)
+            continue
+        try:
+            result.append(ctypes.string_at(outs[i], sizes[i]))
+        finally:
+            lib.coinn_free(outs[i])
+    return result

@@ -92,6 +92,7 @@ def quantize_int8(x, seed=0, impl=None):
     """
     if impl is None:
         impl = "pallas" if jax.default_backend() == "tpu" else "numpy"
+    seed = int(seed) % (2 ** 31)  # callers may pass crc+counter sums ≥ int32 max
     shape = tuple(np.shape(x))
     flat = np.asarray(x, np.float32).reshape(-1) if impl == "numpy" else \
         jnp.asarray(x, jnp.float32).reshape(-1)

@@ -17,7 +17,7 @@ import os
 
 from .. import config
 from ..config.keys import Key, Mode
-from ..utils import stable_file_id, tensorutils
+from ..utils import tensorutils
 
 
 class COINNLearner:
@@ -42,22 +42,12 @@ class COINNLearner:
         return os.path.join(d, fname)
 
     def _save_wire(self, fname, arrays):
-        """Serialize outbound arrays with the configured wire precision.
-
-        At ``precision_bits=8`` this applies the stochastic int8 codec with a
-        seed salted by the site id AND advanced every call — rounding noise
-        must be independent across sites and rounds, or the aggregator's mean
-        gains no variance reduction from averaging."""
-        seed = (
-            stable_file_id(self.state.get("clientId", ""))
-            + int(self.cache.get("_wire_seed", 0))
-        ) % (2 ** 31)
-        tensorutils.save_arrays(
+        """Outbound payload at the configured wire precision, rounding seed
+        salted by this site's id (see :func:`tensorutils.save_wire`)."""
+        tensorutils.save_wire(
             self._transfer_path(fname), arrays,
-            codec=config.wire_codec(self.precision_bits), seed=seed,
-        )
-        self.cache["_wire_seed"] = (
-            int(self.cache.get("_wire_seed", 0)) + len(arrays)
+            salt=str(self.state.get("clientId", "")),
+            cache=self.cache, precision_bits=self.precision_bits,
         )
         return fname
 

@@ -3,21 +3,20 @@
 Capability parity with the reference ``distrib/reducer.py:11-54``: load every
 site's gradient payload, average, ship the result.  TPU-first differences:
 
-- Site payloads are loaded concurrently with a **thread pool** (the packed
-  wire format is a single contiguous read — no pickle, so threads beat the
-  reference's process pool ``reducer.py:18-23`` without fork overhead).
+- Site payloads are loaded concurrently by the **native wire runtime**
+  (``native/wire.cc`` — GIL-free C++ threads; Python-loop fallback), replacing
+  the reference's per-call multiprocessing pool (``reducer.py:18-23``).
 - The average runs as ONE jit-compiled stacked-mean over the site axis on the
   accelerator; leaves stay device-resident until serialization.
 """
 import os
-from concurrent.futures import ThreadPoolExecutor
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import config
-from ..utils import stable_file_id, tensorutils
+from ..utils import tensorutils
 
 
 @jax.jit
@@ -53,25 +52,17 @@ class COINNReducer:
         paths = [
             self._site_path(site, self.input[site][file_key]) for site in sites
         ]
-        with ThreadPoolExecutor(max_workers=max(len(paths), 1)) as ex:
-            return list(ex.map(tensorutils.load_arrays, paths))
+        return tensorutils.load_arrays_many(paths)
 
     def _save_out(self, fname, arrays):
         """Outbound (aggregator → sites) payloads honor the wire precision
-        too; the aggregator's rounding seed is salted apart from every site's
-        and advanced per call."""
+        too; the rounding seed is salted apart from every site's (see
+        :func:`tensorutils.save_wire`)."""
         d = self.state.get("transferDirectory", ".")
         os.makedirs(d, exist_ok=True)
-        seed = (
-            stable_file_id("remote-aggregator")
-            + int(self.cache.get("_wire_seed", 0))
-        ) % (2 ** 31)
-        tensorutils.save_arrays(
-            os.path.join(d, fname), arrays,
-            codec=config.wire_codec(self.precision_bits), seed=seed,
-        )
-        self.cache["_wire_seed"] = (
-            int(self.cache.get("_wire_seed", 0)) + len(arrays)
+        tensorutils.save_wire(
+            os.path.join(d, fname), arrays, salt="remote-aggregator",
+            cache=self.cache, precision_bits=self.precision_bits,
         )
         return fname
 

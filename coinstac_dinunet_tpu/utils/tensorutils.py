@@ -23,8 +23,8 @@ from .. import config
 _MAGIC = b"COINNTW1"  # COINN Tensor Wire v1
 
 
-def pack_arrays(arrays, codec=None, seed=0):
-    """Pack a list of ndarrays into one bytes payload (manifest + raw data).
+def _pack_parts(arrays, codec=None, seed=0):
+    """(header bytes, list of raw data blobs) for a list of ndarrays.
 
     ``codec='int8'`` stores each float array as stochastic-rounded group-wise
     int8 values + f32 scales (``ops/quantize.py``) — 4× smaller than f32 on
@@ -49,7 +49,14 @@ def pack_arrays(arrays, codec=None, seed=0):
             entries.append({"shape": list(a.shape), "dtype": a.dtype.str})
             blobs.append(a.tobytes())
     manifest = json.dumps(entries).encode("utf-8")
-    return b"".join([_MAGIC, struct.pack("<Q", len(manifest)), manifest] + blobs)
+    header = b"".join([_MAGIC, struct.pack("<Q", len(manifest)), manifest])
+    return header, blobs
+
+
+def pack_arrays(arrays, codec=None, seed=0):
+    """Pack a list of ndarrays into one contiguous bytes payload."""
+    header, blobs = _pack_parts(arrays, codec=codec, seed=seed)
+    return b"".join([header] + blobs)
 
 
 def unpack_arrays(payload):
@@ -86,19 +93,82 @@ def unpack_arrays(payload):
 
 
 def save_arrays(path, arrays, codec=None, seed=0):
-    """Write a list of arrays (or a single array) to ``path``."""
+    """Write a list of arrays (or a single array) to ``path``.
+
+    Uses the native gather-write (``native/wire.cc``) when available — the
+    payload buffers go straight from array memory to the file with no
+    intermediate join copy; falls back to a plain Python write."""
     if isinstance(arrays, np.ndarray):
         arrays = [arrays]
     arrays = [np.asarray(a) for a in arrays]
+    header, blobs = _pack_parts(arrays, codec=codec, seed=seed)
+    from .. import native
+
+    if native.pack_file(path, header, blobs):
+        return
     with open(path, "wb") as f:
-        f.write(pack_arrays(arrays, codec=codec, seed=seed))
+        f.write(header)
+        for b in blobs:
+            f.write(b)
 
 
 def load_arrays(path):
-    """Read back the list written by :func:`save_arrays`."""
-    with open(path, "rb") as f:
-        payload = f.read()
+    """Read back the list written by :func:`save_arrays` (native bulk read
+    when available)."""
+    from .. import native
+
+    payload = native.load_file(path) if native.available() else None
+    if payload is None:
+        with open(path, "rb") as f:
+            payload = f.read()
     return unpack_arrays(payload)
+
+
+def load_arrays_many(paths):
+    """Load several payload files concurrently — the aggregator's N-site
+    fan-in (≙ ref ``distrib/reducer.py:18-23`` multiprocessing pool).
+
+    Native C++ threads when available; a GIL-releasing thread pool otherwise.
+    Individual native read failures retry through the Python reader."""
+    from .. import native
+
+    paths = list(paths)
+    payloads = native.load_many(paths) if native.available() else None
+    if payloads is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=max(len(paths), 1)) as ex:
+            return list(ex.map(load_arrays, paths))
+    out = []
+    for p, payload in zip(paths, payloads):
+        if payload is None:  # transient native failure: retry via Python IO
+            out.append(load_arrays(p))
+        else:
+            out.append(unpack_arrays(payload))
+    return out
+
+
+def save_wire(path, arrays, salt="", cache=None, precision_bits=None):
+    """Serialize an outbound wire payload with the configured precision.
+
+    The single choke point both halves of the wire use (site learners and the
+    aggregator): at ``precision_bits=8`` it applies the stochastic int8 codec
+    with a seed salted by ``salt`` (site/aggregator identity) and advanced in
+    ``cache['_wire_seed']`` every call — rounding noise must be independent
+    across nodes and rounds or averaging gains no variance reduction.
+    """
+    from .. import config
+    from . import stable_file_id
+
+    cache = cache if cache is not None else {}
+    counter = int(cache.get("_wire_seed", 0))
+    seed = (stable_file_id(salt) + counter) % (2 ** 31)
+    save_arrays(
+        path, arrays, codec=config.wire_codec(precision_bits), seed=seed
+    )
+    cache["_wire_seed"] = counter + (
+        len(arrays) if isinstance(arrays, (list, tuple)) else 1
+    )
 
 
 def caste_ndarray(x, precision_bits=None):

@@ -33,6 +33,15 @@ def test_quantize_stochastic_rounding_unbiased():
     assert mean_err < one_step * 0.2, mean_err
 
 
+def test_seed_beyond_int32_accepted():
+    # _save_wire passes crc+counter sums that can reach/exceed 2**31
+    x = np.ones((4, 4), np.float32)
+    for impl in ("numpy", "pallas_interpret"):
+        vals, scales, shape = quantize_int8(x, seed=2 ** 31 + 5, impl=impl)
+        out = dequantize_int8(vals, scales, shape)
+        assert np.isfinite(out).all()
+
+
 def test_pallas_interpret_matches_numpy_scale():
     rng = np.random.default_rng(2)
     x = rng.normal(size=(256,)).astype(np.float32)
