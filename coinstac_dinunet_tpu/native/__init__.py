@@ -28,11 +28,20 @@ _tried = False
 
 
 def _build():
+    # build to a process-unique temp name then rename: atomic against
+    # concurrent builders (multi-node processes, pytest workers) and never
+    # overwrites a .so another live process has mapped
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-        _SRC, "-o", _LIB,
+        _SRC, "-o", tmp,
     ]
-    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def _load():
@@ -97,9 +106,7 @@ def pack_file(path, header, buffers):
         return False
     n = len(buffers)
     # keep contiguous byte views alive for the duration of the call
-    views = [
-        b if isinstance(b, (bytes, bytearray)) else bytes(b) for b in buffers
-    ]
+    views = [b if isinstance(b, bytes) else bytes(b) for b in buffers]
     bufs = (ctypes.c_char_p * n)(*views)
     sizes = (ctypes.c_uint64 * n)(*[len(v) for v in views])
     rc = lib.coinn_pack_file(

@@ -154,15 +154,23 @@ class NNTrainer:
         os.makedirs(log_dir, exist_ok=True)
         return os.path.join(log_dir, name or self.cache.get("latest_nn_state", "latest.ckpt"))
 
-    def save_checkpoint(self, name=None, full_path=None, save_optimizer=True):
-        """Serialize ALL models (+ optimizers) — every entry of the dict."""
+    def save_checkpoint(self, name=None, full_path=None, save_optimizer=True,
+                        extra=None):
+        """Serialize ALL models (+ optimizers) — every entry of the dict.
+
+        ``extra`` is a JSON-able dict stored alongside (epoch counters, score
+        logs) — what makes a checkpoint a full mid-run resume point, which
+        the reference cannot do (SURVEY §5 "no mid-run resume")."""
         payload = {
             "source": CHECKPOINT_SOURCE,
             "models": flax.serialization.to_state_dict(
                 jax.device_get(self.train_state.params)
             ),
             "step": int(self.train_state.step),
+            "rng": np.asarray(jax.device_get(self.train_state.rng)),
         }
+        if extra is not None:
+            payload["extra"] = extra
         if save_optimizer:
             # optax states are namedtuple chains; flatten to plain dicts
             payload["optimizers"] = flax.serialization.to_state_dict(
@@ -177,6 +185,7 @@ class NNTrainer:
         path = full_path or self.checkpoint_path(name)
         with open(path, "rb") as f:
             payload = flax.serialization.msgpack_restore(f.read())
+        self.last_checkpoint_extra = dict(payload.get("extra", {}))
         if payload.get("source") == CHECKPOINT_SOURCE:
             models = payload["models"]
         else:
@@ -194,8 +203,11 @@ class NNTrainer:
         step = self.train_state.step
         if "step" in payload:
             step = jnp.asarray(int(payload["step"]), jnp.int32)
+        rng = self.train_state.rng
+        if "rng" in payload:
+            rng = jnp.asarray(np.asarray(payload["rng"]), jnp.uint32)
         self.train_state = self.train_state.replace(
-            params=params, opt_state=opt_state, step=step
+            params=params, opt_state=opt_state, step=step, rng=rng
         )
         return self
 
@@ -367,9 +379,18 @@ class NNTrainer:
                 self.save_predictions(ds, predictions)
         return averages, metrics
 
+    _RESUME_KEYS = ("train_log", "validation_log", "best_val_epoch",
+                    "best_val_score")
+
     def train_local(self, train_dataset=None, val_dataset=None):
         """Full local training loop: epochs × batches with validation cadence,
-        best-checkpoint save, early stop, score logging (ref ``:192-243``)."""
+        best-checkpoint save, early stop, score logging (ref ``:192-243``).
+
+        With ``cache['resume']`` truthy, restarts mid-run from the latest
+        autosaved checkpoint: params, optimizer, rng, epoch counter and score
+        logs all resume — capability the reference lacks (SURVEY §5, cache
+        state dies with the process there).  Autosave cadence:
+        ``cache['autosave_epochs']`` (default every epoch)."""
         cache = self.cache
         epochs = int(cache.get("epochs", 10))
         local_iterations = int(cache.get("local_iterations", 1))
@@ -380,7 +401,21 @@ class NNTrainer:
         if val_dataset is None:
             val_dataset = self.data_handle.get_validation_dataset()
 
-        for epoch in range(1, epochs + 1):
+        start_epoch = 1
+        if cache.get("resume"):
+            ckpt = self.checkpoint_path(cache.get("latest_nn_state", "latest.ckpt"))
+            if os.path.exists(ckpt):
+                self.load_checkpoint(full_path=ckpt)
+                extra = getattr(self, "last_checkpoint_extra", {})
+                for k in self._RESUME_KEYS:
+                    if k in extra:
+                        cache[k] = extra[k]
+                start_epoch = int(extra.get("epoch", 0)) + 1
+                logger.info(
+                    f"Resuming from epoch {start_epoch}", cache.get("verbose", True)
+                )
+
+        for epoch in range(start_epoch, epochs + 1):
             ep_averages, ep_metrics = self.new_averages(), self.new_metrics()
             loader = self.data_handle.get_loader(
                 "train", dataset=train_dataset, shuffle=True,
@@ -416,8 +451,21 @@ class NNTrainer:
                 if self._stop_early(epoch):
                     logger.info(f"Early stop at epoch {epoch}", cache.get("verbose", True))
                     break
+            autosave_every = int(cache.get("autosave_epochs", 1))
+            if autosave_every > 0 and epoch % autosave_every == 0:
+                self._autosave(epoch)
         self._on_train_end()
         return self
+
+    def _autosave(self, epoch):
+        """Write the latest checkpoint as a full resume point."""
+        extra = {"epoch": epoch}
+        extra.update({
+            k: self.cache[k] for k in self._RESUME_KEYS if k in self.cache
+        })
+        self.save_checkpoint(
+            name=self.cache.get("latest_nn_state", "latest.ckpt"), extra=extra
+        )
 
     # ------------------------------------------------------------- user hooks
     def _on_validation_end(self, epoch, averages, metrics):
@@ -433,7 +481,9 @@ class NNTrainer:
         return stop_training_(epoch, self.cache)
 
     def _on_train_end(self):
-        self.save_checkpoint(name=self.cache.get("latest_nn_state", "latest.ckpt"))
+        # keep the resume record: a bare save here would clobber the autosave's
+        # epoch counter and make a later resume restart from epoch 1
+        self._autosave(len(self.cache.get("train_log", [])))
 
     def save_predictions(self, dataset, predictions):
         """User hook: persist per-dataset predictions (sparse test mode)."""

@@ -69,7 +69,7 @@ def _flash_kernel(aux_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
     nk = k_ref.shape[1] // block_k
     d = q_ref.shape[-1]
-    aux = aux_ref[0]
+    scalars = (aux_ref[0, 0], aux_ref[0, 1], aux_ref[0, 2])
 
     def body(j, carry):
         acc, m, l = carry
@@ -78,7 +78,7 @@ def _flash_kernel(aux_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (block_q, block_k)
-        valid = _valid_mask(s.shape, aux, 0, 1, iq, j, block_q, block_k, causal)
+        valid = _valid_mask(s.shape, scalars, 0, 1, iq, j, block_q, block_k, causal)
         s = jnp.where(valid, s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.exp(m - m_new)
@@ -99,7 +99,9 @@ def _flash_kernel(aux_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     )
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = acc / l_safe[:, None]
-    lse_ref[0] = m + jnp.log(l_safe)
+    # lse is (1, block_q, 1): the trailing singleton keeps the block shape
+    # legal for Mosaic (last two dims must be 8/128-divisible or full-size)
+    lse_ref[0, :, 0] = m + jnp.log(l_safe)
 
 
 def _pad_to(x, axis, multiple):
@@ -133,15 +135,15 @@ def _flash_pallas(q, k, v, aux, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tqp, d), jnp.float32),
-            jax.ShapeDtypeStruct((bh, tqp), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tqp, 1), jnp.float32),
         ],
         interpret=interpret,
     )(aux.reshape(1, 3), qp, kp, vp)
-    return out[:, :tq], lse[:, :tq]
+    return out[:, :tq], lse[:, :tq, 0]
 
 
 def _flash_xla(q, k, v, aux, scale, causal):

@@ -42,9 +42,14 @@ def _quant_kernel(seed_ref, x_ref, v_ref, s_ref):
     scale = jnp.maximum(absmax / 127.0, 1e-30)
     scaled = x / scale
     # stochastic round by hand (floor + Bernoulli(frac)) — same semantics as
-    # pltpu.stochastic_round but portable to the CPU interpreter for tests
+    # pltpu.stochastic_round but portable to the CPU interpreter for tests.
+    # Mosaic can't cast uint32→f32 directly: drop to 24 bits via int32 (exact
+    # in f32) first.
     bits = pltpu.bitcast(pltpu.prng_random_bits(scaled.shape), jnp.uint32)
-    u = bits.astype(jnp.float32) * (1.0 / 4294967296.0)
+    bits24 = pltpu.bitcast(
+        jax.lax.shift_right_logical(bits, jnp.uint32(8)), jnp.int32
+    )
+    u = bits24.astype(jnp.float32) * (1.0 / 16777216.0)
     lo = jnp.floor(scaled)
     vals = lo + (u < (scaled - lo)).astype(jnp.float32)
     v_ref[:] = jnp.clip(vals, -127, 127).astype(jnp.int8)

@@ -157,8 +157,7 @@ def save_wire(path, arrays, salt="", cache=None, precision_bits=None):
     ``cache['_wire_seed']`` every call — rounding noise must be independent
     across nodes and rounds or averaging gains no variance reduction.
     """
-    from .. import config
-    from . import stable_file_id
+    from . import stable_file_id  # deferred: dodges the utils/__init__ cycle
 
     cache = cache if cache is not None else {}
     counter = int(cache.get("_wire_seed", 0))

@@ -96,8 +96,9 @@ np.testing.assert_array_equal(back[0], a[0])
 print(open(%r, 'rb').read() == tu.pack_arrays(a))
 """
     p = str(tmp_path / "fb.bin")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, COINN_NATIVE="0", JAX_PLATFORMS="cpu",
-               PYTHONPATH="/root/repo")
+               PYTHONPATH=repo_root)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     r = subprocess.run(
         [sys.executable, "-c", code % (p, p, p)],
