@@ -256,7 +256,9 @@ class COINNLocal:
                 self.out.update(**trainer.test_distributed())
                 self.out["mode"] = self.cache["frozen_args"]["mode"]
                 self.out["phase"] = Phase.NEXT_RUN_WAITING.value
-                trainer.save_checkpoint(name=self.cache["latest_nn_state"])
+                # _autosave (not a bare save) keeps the epoch/log record a
+                # later cache['resume'] train_local needs
+                trainer._autosave(len(self.cache.get("train_log", [])))
                 utils.save_cache(self.cache, {"outputDirectory": self.cache["log_dir"]})
 
         elif self.out["phase"] == Phase.SUCCESS.value:

@@ -1,0 +1,182 @@
+"""Pipeline parallelism — GPipe-style microbatched stages over a ``pp`` axis.
+
+Completes the framework's parallelism matrix (dp / tp / sp / ep live in
+``sequence.py``; federated site-DP in ``mesh.py``).  No reference counterpart
+(SURVEY.md §2 "Absent": pipeline parallelism) — designed TPU-first:
+
+- The transformer's blocks are **stacked into one pytree with a leading layer
+  axis** and sharded ``P('pp')``: each pipeline rank holds ``L/pp``
+  contiguous blocks and applies them with a ``lax.scan`` (one trace,
+  whatever the depth).
+- The schedule is the classic GPipe loop inside ``shard_map``: ``M + pp - 1``
+  ticks; every tick each rank runs its stage on the activation in hand, then
+  the activations hop one rank forward with ``lax.ppermute`` (neighbor ICI
+  transfer, overlapped with the next tick's compute by XLA).  Rank 0 injects
+  microbatch ``i`` at tick ``i``; the last rank banks a finished microbatch
+  each tick from ``pp - 1`` on.  Bubble fraction is the standard
+  ``(pp-1)/(M+pp-1)``.
+- Loss (mean-pool classifier head) is computed on the last rank and
+  ``psum``-shared; grads of replicated params are ``psum``ed over ``pp`` (each
+  rank contributes only its stages' terms), stacked-layer grads stay sharded.
+  Batch additionally shards over a ``dp`` axis.
+
+Uses the same ``TSPConfig``/``init_tsp_params`` parameter pytree as
+``sequence.py`` (dense FFN path), so the two scale-out strategies are
+interchangeable on one checkpoint.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import flash_attention
+from .sequence import _layernorm, transformer_block
+
+__all__ = ["build_pp_mesh", "stack_layers", "make_pp_train_step",
+           "shard_pp_params", "shard_pp_batch"]
+
+
+def build_pp_mesh(pp=2, dp=1, devices=None):
+    devices = list(devices if devices is not None else jax.devices())
+    need = pp * dp
+    if need > len(devices):
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:need]).reshape(pp, dp), ("pp", "dp"))
+
+
+def stack_layers(params):
+    """List-of-layer-dicts → one pytree with a leading (n_layers,) axis."""
+    layers = params["layers"]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+    rest = {k: v for k, v in params.items() if k != "layers"}
+    rest["layers"] = stacked
+    return rest
+
+
+def _pp_specs(params):
+    def spec_for(path, leaf):
+        return P("pp") if any(
+            getattr(p, "key", None) == "layers" for p in path
+        ) else P()
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_pp_params(params, mesh):
+    """Stacked params → device_put with layers over pp, rest replicated."""
+    specs = _pp_specs(params)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def shard_pp_batch(x, y, mesh):
+    x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    y = jax.device_put(y, NamedSharding(mesh, P("dp")))
+    return x, y
+
+
+def _block(h, lp, cfg):
+    """Shared block math from ``sequence.py`` with plain (local) flash
+    attention and no sharding constraints."""
+    attn = lambda q, k, v: flash_attention(
+        q, k, v, causal=cfg.causal, impl=cfg.attn_impl
+    )
+    h, _ = transformer_block(h, lp, cfg, attn)
+    return h
+
+
+def make_pp_train_step(cfg, mesh, lr=1e-3, num_microbatches=None):
+    """Jit-compiled SGD step with GPipe pipelining over ``pp``.
+
+    ``num_microbatches`` defaults to the pp size (minimum that fills the
+    pipe; raise it to shrink the bubble)."""
+    pp = mesh.shape["pp"]
+    M = int(num_microbatches or pp)
+    assert cfg.num_experts == 0, "pipeline path uses the dense-FFN layers"
+
+    def local_loss(params, x, y):
+        # x: (B_local, T, F) — this dp rank's batch, replicated across pp
+        r = lax.axis_index("pp")
+        b = x.shape[0]
+        assert b % M == 0, f"batch {b} must divide microbatches {M}"
+        mb = b // M
+        dtype = cfg.dtype
+        t = x.shape[1]
+
+        # stage input for microbatch injection (stage 0 only uses this)
+        emb_all = (
+            jnp.asarray(x, dtype) @ params["in_proj"].astype(dtype)
+            + params["pos"][:t][None].astype(dtype)
+        ).reshape(M, mb, t, cfg.d_model)
+
+        def stage(h):
+            return lax.scan(
+                lambda c, lp: (_block(c, lp, cfg), None), h, params["layers"]
+            )[0]
+
+        def tick(carry, i):
+            h, outs = carry
+            inject = emb_all[jnp.clip(i, 0, M - 1)]
+            h = jnp.where((r == 0)[None, None, None], inject, h)
+            h = stage(h)
+            # bank the last rank's finished microbatch (valid from tick pp-1)
+            j = i - (pp - 1)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(j >= 0, h, outs[jnp.clip(j, 0, M - 1)]),
+                jnp.clip(j, 0, M - 1), 0,
+            )
+            h = lax.ppermute(
+                h, "pp", perm=[(k, (k + 1) % pp) for k in range(pp)]
+            )
+            return (h, outs), None
+
+        h0 = jnp.zeros((mb, t, cfg.d_model), dtype) + 0.0 * emb_all[0]
+        outs0 = jnp.zeros((M, mb, t, cfg.d_model), dtype) + 0.0 * emb_all
+        (_, outs), _ = lax.scan(tick, (h0, outs0), jnp.arange(M + pp - 1))
+
+        # classifier head on the last rank.  IMPORTANT: this is the rank-LOCAL
+        # loss term (ce on the last pp rank, 0 elsewhere) — the cross-rank
+        # reductions happen on the VALUE and on the GRADS explicitly in
+        # sharded_step, never inside the differentiated expression, so no
+        # collective transpose can double-count cotangents.
+        hfin = _layernorm(
+            outs.reshape(b, t, cfg.d_model).astype(jnp.float32), params["lnf"]
+        )
+        logits = jnp.mean(hfin, axis=1) @ params["head"]
+        logp = jax.nn.log_softmax(logits)
+        ce = -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+        is_last = (r == pp - 1).astype(jnp.float32)
+        return ce * is_last
+
+    def sharded_step(params, x, y):
+        local, grads = jax.value_and_grad(local_loss)(params, x, y)
+        # pp-sharded layer grads arrive complete via the ppermute-transposed
+        # chain; replicated params hold only this rank's stages' terms → sum.
+        grads = jax.tree_util.tree_map_with_path(
+            lambda path, g: g if any(
+                getattr(p, "key", None) == "layers" for p in path
+            ) else lax.psum(g, "pp"),
+            grads,
+        )
+        # global loss = mean over dp of per-rank ce → grads average over dp
+        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, "dp"), grads)
+        params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        loss = lax.pmean(lax.psum(local, "pp"), "dp")
+        return params, loss
+
+    p_specs = _pp_specs  # resolved per-call against the actual pytree
+
+    @jax.jit
+    def step(params, x, y):
+        specs = p_specs(params)
+        return jax.shard_map(
+            sharded_step,
+            mesh=mesh,
+            in_specs=(specs, P("dp"), P("dp")),
+            out_specs=(specs, P()),
+            check_vma=False,
+        )(params, x, y)
+
+    return step

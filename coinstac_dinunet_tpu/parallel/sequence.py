@@ -34,11 +34,20 @@ __all__ = ["TSPConfig", "build_tsp_mesh", "init_tsp_params", "shard_tsp_params",
 
 
 class TSPConfig:
-    """Static transformer hyperparameters for the sharded step."""
+    """Static transformer hyperparameters for the sharded step.
+
+    ``num_experts > 0`` replaces every block's dense FFN with a top-1
+    (switch-style) mixture of experts whose expert dimension shards over the
+    ``ep`` mesh axis — expert parallelism; GSPMD inserts the token
+    all-to-alls from the shardings.  ``capacity_factor`` bounds tokens per
+    expert (overflow tokens pass through the residual untouched, standard
+    switch behavior).
+    """
 
     def __init__(self, num_features=16, num_classes=2, d_model=128, num_heads=8,
                  num_layers=2, mlp_ratio=4, max_len=4096, causal=False,
-                 dtype=jnp.float32, attn_impl=None):
+                 dtype=jnp.float32, attn_impl=None, num_experts=0,
+                 capacity_factor=1.25, moe_aux_weight=0.01):
         self.num_features = num_features
         self.num_classes = num_classes
         self.d_model = d_model
@@ -49,17 +58,20 @@ class TSPConfig:
         self.causal = causal
         self.dtype = dtype
         self.attn_impl = attn_impl
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.moe_aux_weight = moe_aux_weight
         self.head_dim = d_model // num_heads
         assert d_model % num_heads == 0
 
 
-def build_tsp_mesh(dp=1, tp=1, sp=1, devices=None):
+def build_tsp_mesh(dp=1, tp=1, sp=1, ep=1, devices=None):
     devices = list(devices if devices is not None else jax.devices())
-    need = dp * tp * sp
+    need = dp * tp * sp * ep
     if need > len(devices):
         raise ValueError(f"need {need} devices, have {len(devices)}")
-    arr = np.array(devices[:need]).reshape(dp, tp, sp)
-    return Mesh(arr, ("dp", "tp", "sp"))
+    arr = np.array(devices[:need]).reshape(dp, tp, sp, ep)
+    return Mesh(arr, ("dp", "tp", "sp", "ep"))
 
 
 def init_tsp_params(key, cfg):
@@ -77,16 +89,29 @@ def init_tsp_params(key, cfg):
         "layers": [],
     }
     for _ in range(cfg.num_layers):
-        params["layers"].append({
+        layer = {
             "ln1": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
             "wqkv": init(next(k), (3, d, h, hd), 1 / math.sqrt(d)),
             "wo": init(next(k), (h, hd, d), 1 / math.sqrt(d)),
             "ln2": {"scale": jnp.ones((d,)), "bias": jnp.zeros((d,))},
-            "w1": init(next(k), (d, ff), 1 / math.sqrt(d)),
-            "b1": jnp.zeros((ff,)),
-            "w2": init(next(k), (ff, d), 1 / math.sqrt(ff)),
-            "b2": jnp.zeros((d,)),
-        })
+        }
+        if cfg.num_experts > 0:
+            e = cfg.num_experts
+            layer.update({
+                "wg": init(next(k), (d, e), 1 / math.sqrt(d)),
+                "w1e": init(next(k), (e, d, ff), 1 / math.sqrt(d)),
+                "b1e": jnp.zeros((e, ff)),
+                "w2e": init(next(k), (e, ff, d), 1 / math.sqrt(ff)),
+                "b2e": jnp.zeros((e, d)),
+            })
+        else:
+            layer.update({
+                "w1": init(next(k), (d, ff), 1 / math.sqrt(d)),
+                "b1": jnp.zeros((ff,)),
+                "w2": init(next(k), (ff, d), 1 / math.sqrt(ff)),
+                "b2": jnp.zeros((d,)),
+            })
+        params["layers"].append(layer)
     return params
 
 
@@ -100,6 +125,11 @@ def _param_specs(params):
             "w1": P(None, "tp"),                # (d, ff/tp)
             "b1": P("tp"),
             "w2": P("tp", None),                # (ff/tp, d)
+            # MoE: experts over ep, each expert's hidden dim over tp
+            "w1e": P("ep", None, "tp"),         # (E/ep, d, ff/tp)
+            "b1e": P("ep", "tp"),
+            "w2e": P("ep", "tp", None),         # (E/ep, ff/tp, d)
+            "b2e": P("ep", None),
         }.get(name, P())
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
@@ -117,9 +147,90 @@ def _layernorm(x, p):
     return (x - mu) * lax.rsqrt(var + 1e-6) * p["scale"] + p["bias"]
 
 
+def _no_constrain(a, _spec):
+    return a
+
+
+def transformer_block(h, lp, cfg, attn_fn, constrain=_no_constrain):
+    """One pre-LN block: attention + (dense | switch-MoE) FFN.
+
+    The single source of the block math for BOTH scale-out strategies —
+    ``tsp_forward`` (dp×tp×sp×ep, sharding constraints live) and the GPipe
+    pipeline (``pipeline.py``, constraints off) — so the two stay
+    interchangeable on one parameter pytree.  ``attn_fn(q, k, v)`` supplies
+    the attention implementation (ring over ``sp``, or plain flash).
+    Returns ``(h, moe_aux_loss)``.
+    """
+    dtype = cfg.dtype
+    z = _layernorm(h, lp["ln1"]).astype(dtype)
+    qkv = jnp.einsum("btd,cdhe->cbhte", z, lp["wqkv"].astype(dtype))
+    qkv = constrain(qkv, P(None, "dp", "tp", "sp", None))
+    attn = attn_fn(qkv[0], qkv[1], qkv[2])
+    o = jnp.einsum("bhte,hed->btd", attn, lp["wo"].astype(dtype))
+    h = h + constrain(o, P("dp", "sp", None))
+
+    z = _layernorm(h, lp["ln2"]).astype(dtype)
+    if cfg.num_experts > 0:
+        m, aux = _switch_moe(z, lp, cfg, constrain)
+        h = h + constrain(m, P("dp", "sp", None))
+        return h, aux
+    m = jax.nn.gelu(z @ lp["w1"].astype(dtype) + lp["b1"].astype(dtype))
+    m = constrain(m, P("dp", "sp", "tp"))
+    h = h + constrain(m @ lp["w2"].astype(dtype) + lp["b2"].astype(dtype),
+                      P("dp", "sp", None))
+    return h, jnp.zeros((), jnp.float32)
+
+
+def _switch_moe(z, lp, cfg, constrain):
+    """Top-1 (switch) mixture-of-experts FFN with capacity.
+
+    Tokens route to their argmax expert; at most ``C = capacity_factor ·
+    tokens/expert`` land per expert (overflow contributes nothing — it rides
+    the residual, standard switch behavior).  Dispatch/combine are one-hot
+    einsums, so the whole layer is static-shape and GSPMD turns the sharded
+    einsums into the expert all-to-alls.  Returns (output, aux_loss) where
+    aux_loss is the switch load-balancing term (mean gate prob × mean
+    assignment rate per expert, scaled by E).
+    """
+    b, t, d = z.shape
+    e = cfg.num_experts
+    tokens = b * t
+    cap = max(int(cfg.capacity_factor * tokens / e), 1)
+    zf = z.reshape(tokens, d)
+    gates = jax.nn.softmax(zf.astype(jnp.float32) @ lp["wg"], axis=-1)  # (N, E)
+    expert = jnp.argmax(gates, axis=-1)
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # (N, E)
+    # position of each token within its expert's capacity buffer
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # (N, E), -1 where unrouted
+    kept = (pos >= 0) & (pos < cap)
+    dispatch = jnp.einsum(
+        "ne,nec->nec", onehot * kept,
+        jax.nn.one_hot(jnp.clip(pos, 0, cap - 1), cap, dtype=jnp.float32),
+    )  # (N, E, C)
+    dispatch = constrain(dispatch, P(None, "ep", None))
+    xe = jnp.einsum("nec,nd->ecd", dispatch, zf.astype(jnp.float32))
+    xe = constrain(xe, P("ep", None, None)).astype(cfg.dtype)
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", xe, lp["w1e"].astype(cfg.dtype))
+        + lp["b1e"][:, None].astype(cfg.dtype)
+    )
+    h = constrain(h, P("ep", None, "tp"))
+    ye = (jnp.einsum("ecf,efd->ecd", h, lp["w2e"].astype(cfg.dtype))
+          + lp["b2e"][:, None].astype(cfg.dtype))
+    ye = constrain(ye.astype(jnp.float32), P("ep", None, None))
+    gate_val = jnp.sum(gates * onehot, axis=-1, keepdims=True)  # (N, 1)
+    out = jnp.einsum("nec,ecd->nd", dispatch, ye) * gate_val
+    # switch load-balancing auxiliary loss
+    density = jnp.mean(onehot, axis=0)
+    density_proxy = jnp.mean(gates, axis=0)
+    aux = jnp.sum(density * density_proxy) * e
+    return out.reshape(b, t, d).astype(cfg.dtype), aux
+
+
 def tsp_forward(params, x, cfg, mesh):
-    """Logits for (B, T, F) inputs; B sharded over dp, T over sp, heads/ff
-    over tp — all via sharding constraints except the explicit ring."""
+    """(logits, moe_aux_loss) for (B, T, F) inputs; B sharded over dp, T over
+    sp, heads/ff over tp, experts over ep — all via sharding constraints
+    except the explicit ring.  ``moe_aux_loss`` is 0 for dense FFNs."""
     dtype = cfg.dtype
     x = jnp.asarray(x, dtype)
     b, t, _ = x.shape
@@ -139,23 +250,14 @@ def tsp_forward(params, x, cfg, mesh):
         out_specs=qkv_spec,
     )
 
+    moe_aux = jnp.zeros((), jnp.float32)
     for lp in params["layers"]:
-        z = _layernorm(h, lp["ln1"]).astype(dtype)
-        qkv = jnp.einsum("btd,cdhe->cbhte", z, lp["wqkv"].astype(dtype))
-        qkv = constrain(qkv, P(None, "dp", "tp", "sp", None))
-        attn = ring(qkv[0], qkv[1], qkv[2])
-        o = jnp.einsum("bhte,hed->btd", attn, lp["wo"].astype(dtype))
-        h = h + constrain(o, P("dp", "sp", None))
-
-        z = _layernorm(h, lp["ln2"]).astype(dtype)
-        m = jax.nn.gelu(z @ lp["w1"].astype(dtype) + lp["b1"].astype(dtype))
-        m = constrain(m, P("dp", "sp", "tp"))
-        h = h + constrain(m @ lp["w2"].astype(dtype) + lp["b2"].astype(dtype),
-                          P("dp", "sp", None))
+        h, aux = transformer_block(h, lp, cfg, ring, constrain)
+        moe_aux = moe_aux + aux
 
     h = _layernorm(h.astype(jnp.float32), params["lnf"])
     pooled = jnp.mean(h, axis=1)  # (B, d) — mean over the full sequence
-    return pooled @ params["head"]
+    return pooled @ params["head"], moe_aux
 
 
 def make_tsp_train_step(cfg, mesh, lr=1e-3):
@@ -166,9 +268,10 @@ def make_tsp_train_step(cfg, mesh, lr=1e-3):
     """
 
     def loss_fn(params, x, y):
-        logits = tsp_forward(params, x, cfg, mesh)
+        logits, moe_aux = tsp_forward(params, x, cfg, mesh)
         logp = jax.nn.log_softmax(logits)
-        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+        ce = -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+        return ce + cfg.moe_aux_weight * moe_aux
 
     @jax.jit
     def step(params, x, y):
