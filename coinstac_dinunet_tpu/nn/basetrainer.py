@@ -277,7 +277,14 @@ class NNTrainer:
 
     def train_step(self, ts, stacked_batches):
         """compute_grads + apply_grads fused in one compiled call (the local
-        hot path — nothing leaves the device between grad and update)."""
+        hot path — nothing leaves the device between grad and update).
+
+        On accelerator backends the incoming ``ts`` is DONATED: its buffers
+        are reused for the result, so the caller must treat the passed-in
+        state as consumed (rebind: ``ts, aux = trainer.train_step(ts, ...)``).
+        On CPU donation is a no-op, so code that re-reads the old state only
+        breaks on TPU/GPU — set ``cache['donate_buffers'] = False`` to opt
+        out everywhere."""
         fn = self._compiled.get("train")
         if fn is None:
             metrics_shell, averages_shell = self._metrics_shell()
@@ -288,7 +295,16 @@ class NNTrainer:
                 ts = ts.replace(rng=aux["rng"])
                 return ts, aux
 
-            fn = self._compiled["train"] = jax.jit(_full)
+            # donate the incoming train state: params/opt buffers update in
+            # place on the accelerator instead of doubling HBM footprint
+            # (no-op on CPU, where donation only emits warnings)
+            donate = (
+                (0,)
+                if jax.default_backend() != "cpu"
+                and self.cache.get("donate_buffers", True)
+                else ()
+            )
+            fn = self._compiled["train"] = jax.jit(_full, donate_argnums=donate)
         return fn(ts, stacked_batches)
 
     def _grads_uncompiled(self, ts, stacked, metrics_shell, averages_shell):

@@ -23,6 +23,7 @@ from ..config.keys import AggEngine, Key, Mode, Phase
 from ..data import COINNDataHandle
 from ..parallel import COINNLearner, DADLearner, PowerSGDLearner
 from ..utils import logger
+from ..utils.profiling import PhaseTimer
 
 # engine/epoch state cleared on every fold transition
 _EPHEMERAL_KEYS = (
@@ -284,7 +285,13 @@ class COINNLocal:
 
     def __call__(self, *a, **kw):
         try:
-            self.compute(*a, **kw)
+            # per-phase wall-clock lands in cache['profile_stats'] (dumped to
+            # logs.json) when cache['profile'] is set — realtime per-site
+            # profiling the reference delegates to its engine (SURVEY §5)
+            with PhaseTimer(self.cache)(
+                f"local:{self.input.get('phase', Phase.INIT_RUNS.value)}"
+            ):
+                self.compute(*a, **kw)
             return {"output": self.out}
         except Exception:
             traceback.print_exc()

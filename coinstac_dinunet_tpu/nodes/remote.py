@@ -25,6 +25,7 @@ from ..config.keys import AggEngine, Key, Mode, Phase
 from ..data import EmptyDataHandle
 from ..parallel import COINNReducer, DADReducer, PowerSGDReducer
 from ..utils.logger import lazy_debug
+from ..utils.profiling import PhaseTimer
 from ..utils.utils import performance_improved_, stop_training_
 from ..vision import plotter
 from . import check, gather
@@ -283,7 +284,8 @@ class COINNRemote:
 
     def __call__(self, *a, **kw):
         try:
-            self.compute(*a, **kw)
+            with PhaseTimer(self.cache)("remote:round"):
+                self.compute(*a, **kw)
             return {
                 "output": self.out,
                 "success": check(all, "phase", Phase.SUCCESS.value, self.input),

@@ -18,6 +18,7 @@ Control (epoch barriers, validation cadence, early stop, fold rotation) stays
 host-side in :class:`MeshFederation`'s caller — see ``nodes/``; only the hot
 gradient plane is compiled here.
 """
+import functools
 import math
 
 import jax
@@ -167,7 +168,16 @@ class MeshFederation:
         batch_spec = P("site", None, "device")
         mesh = self.mesh
 
-        @jax.jit
+        # donate train state + engine comm state (both replaced every round);
+        # CPU donation is a warning-only no-op, so gate it
+        donate = (
+            (0, 2)
+            if jax.default_backend() != "cpu"
+            and self.trainer.cache.get("donate_buffers", True)
+            else ()
+        )
+
+        @functools.partial(jax.jit, donate_argnums=donate)
         def step(ts, stacked, comm):
             return jax.shard_map(
                 site_step,

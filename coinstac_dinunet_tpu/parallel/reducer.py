@@ -16,13 +16,37 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import config
-from ..utils import tensorutils
+from ..utils import logger, tensorutils
 
 
 @jax.jit
 def _stacked_mean(leaves):
     """leaves: list of (n_sites, ...) arrays → list of site-mean arrays."""
     return [jnp.mean(x, axis=0) for x in leaves]
+
+
+@jax.jit
+def _guarded_mean(leaves):
+    """Failure-detecting mean: sites whose payload contains any non-finite
+    value are excluded from every leaf's average (weight 0).
+
+    Returns ``(means, site_ok)`` where ``site_ok`` is the (n_sites,) bool
+    vector of healthy sites.  If no site is healthy the mean is all-zeros —
+    a zero gradient instead of NaN weights (note: stateful optimizers still
+    apply momentum-driven movement on a zero gradient).  One compiled call;
+    the reference has no failure detection at all (SURVEY §5).
+    """
+    ok = jnp.ones((leaves[0].shape[0],), jnp.bool_)
+    for x in leaves:
+        ok = ok & jnp.isfinite(x).all(axis=tuple(range(1, x.ndim)))
+    w = ok.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(w), 1.0)
+    means = [
+        jnp.tensordot(w, jnp.nan_to_num(x, nan=0.0, posinf=0.0, neginf=0.0),
+                      axes=(0, 0)) / denom
+        for x in leaves
+    ]
+    return means, ok
 
 
 class COINNReducer:
@@ -69,13 +93,38 @@ class COINNReducer:
     # ---------------------------------------------------------------- reduce
     def _average(self, site_leaves):
         """Stack each leaf across sites and mean on-device in one compiled
-        call (≙ ref ``reducer.py:25-32`` stack→GPU→mean)."""
+        call (≙ ref ``reducer.py:25-32`` stack→GPU→mean).
+
+        With ``cache['guard_nonfinite']`` (default on) sites shipping NaN/Inf
+        gradients — a diverged or corrupted node — are detected on-device and
+        excluded from the round; the skipped site ids land in
+        ``cache['skipped_sites']`` for the control plane/logs."""
         n_leaves = len(site_leaves[0])
+        if n_leaves == 0:  # e.g. rankDAD's "rest" payload with no 1-D params
+            return []
         stacked = [
             jnp.stack([jnp.asarray(site[i], dtype=jnp.float32) for site in site_leaves])
             for i in range(n_leaves)
         ]
         wire = config.wire_dtype(self.precision_bits)
+        if self.cache.get("guard_nonfinite", True):
+            means, ok = _guarded_mean(stacked)
+            ok = np.asarray(ok)
+            self.cache["_reduce_round"] = int(self.cache.get("_reduce_round", 0)) + 1
+            if not ok.all():
+                sites = sorted(self.input.keys())
+                bad = [s for s, good in zip(sites, ok) if not good]
+                self.cache.setdefault("skipped_sites", []).append({
+                    "reduce_round": self.cache["_reduce_round"],
+                    "epoch": int(self.cache.get("epoch", 0)),
+                    "sites": bad,
+                })
+                # a failure event is never verbosity-gated
+                logger.warn(
+                    f"non-finite gradients from sites {bad}; excluded this round",
+                    True,
+                )
+            return [np.asarray(x, dtype=wire) for x in means]
         return [np.asarray(x, dtype=wire) for x in _stacked_mean(stacked)]
 
     def reduce(self):

@@ -205,7 +205,9 @@ def _switch_moe(z, lp, cfg, constrain):
     kept = (pos >= 0) & (pos < cap)
     dispatch = jnp.einsum(
         "ne,nec->nec", onehot * kept,
-        jax.nn.one_hot(jnp.clip(pos, 0, cap - 1), cap, dtype=jnp.float32),
+        jax.nn.one_hot(
+            jnp.clip(pos, 0, cap - 1).astype(jnp.int32), cap, dtype=jnp.float32
+        ),
     )  # (N, E, C)
     dispatch = constrain(dispatch, P(None, "ep", None))
     xe = jnp.einsum("nec,nd->ecd", dispatch, zf.astype(jnp.float32))

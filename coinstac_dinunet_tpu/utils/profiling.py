@@ -1,0 +1,74 @@
+"""Profiling: per-phase wall-clock stats + on-demand XLA device traces.
+
+The reference's profiling surface is one helper that appends wall-clock
+deltas to a cache key and is never called (``utils/utils.py:25-31``;
+SURVEY.md §5 "Tracing/profiling: minimal").  Here profiling is a working
+subsystem:
+
+- :class:`PhaseTimer` — cheap wall-clock accounting keyed by phase/section
+  name, accumulated in the node cache (JSON-dumped with ``save_cache``, so
+  every site's per-phase time lands in its output directory).  Enabled by
+  ``cache['profile'] = True``; zero overhead otherwise.
+- :func:`device_trace` — context manager around ``jax.profiler.trace``:
+  writes a TensorBoard-loadable XLA trace (compilation, fusions, HBM
+  transfers, collective timing) for the wrapped section.
+- :func:`annotate` — ``jax.profiler.TraceAnnotation`` passthrough so
+  framework phases show up as named spans inside device traces.
+"""
+import contextlib
+import time
+
+__all__ = ["PhaseTimer", "device_trace", "annotate"]
+
+
+class PhaseTimer:
+    """Accumulates wall-clock seconds per named section into ``cache``.
+
+    Stats live under ``cache['profile_stats']`` as
+    ``{name: {"calls": n, "total_s": t, "max_s": m}}`` — JSON-able, so the
+    standard cache dump publishes them.  Construct once per node; every
+    ``with timer("phase"):`` is a measured section.  No-ops unless
+    ``cache['profile']`` is truthy.
+    """
+
+    def __init__(self, cache):
+        self.cache = cache
+
+    @property
+    def enabled(self):
+        return bool(self.cache.get("profile"))
+
+    @contextlib.contextmanager
+    def __call__(self, name):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            stats = self.cache.setdefault("profile_stats", {})
+            s = stats.setdefault(name, {"calls": 0, "total_s": 0.0, "max_s": 0.0})
+            s["calls"] += 1
+            s["total_s"] = round(s["total_s"] + dt, 6)
+            s["max_s"] = round(max(s["max_s"], dt), 6)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir):
+    """XLA profiler trace for the wrapped section (TensorBoard format)."""
+    import jax
+
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name):
+    """Named span that shows up inside device traces."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

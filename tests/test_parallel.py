@@ -332,3 +332,32 @@ def test_mesh_eval_reduces_counts_globally(tmp_path):
     metrics.update(m_state)
     total = sum(float(np.asarray(m_state[k])) for k in ("tp", "fp", "tn", "fn"))
     assert total == 4 * 8  # every sample from every site counted exactly once
+
+
+def test_guarded_mean_excludes_nonfinite_sites():
+    import jax.numpy as jnp
+
+    from coinstac_dinunet_tpu.parallel.reducer import _guarded_mean
+
+    good1 = [np.ones((3, 2), np.float32), np.full((4,), 2.0, np.float32)]
+    good2 = [np.full((3, 2), 3.0, np.float32), np.full((4,), 4.0, np.float32)]
+    bad = [np.full((3, 2), np.nan, np.float32), np.full((4,), 6.0, np.float32)]
+    stacked = [
+        jnp.stack([jnp.asarray(s[i]) for s in (good1, bad, good2)])
+        for i in range(2)
+    ]
+    means, ok = _guarded_mean(stacked)
+    assert list(np.asarray(ok)) == [True, False, True]
+    np.testing.assert_allclose(np.asarray(means[0]), np.full((3, 2), 2.0))
+    np.testing.assert_allclose(np.asarray(means[1]), np.full((4,), 3.0))
+
+
+def test_guarded_mean_all_bad_gives_noop():
+    import jax.numpy as jnp
+
+    from coinstac_dinunet_tpu.parallel.reducer import _guarded_mean
+
+    stacked = [jnp.full((2, 3), jnp.inf)]
+    means, ok = _guarded_mean(stacked)
+    assert not np.asarray(ok).any()
+    np.testing.assert_allclose(np.asarray(means[0]), np.zeros(3))

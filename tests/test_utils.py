@@ -117,3 +117,39 @@ def test_safe_concat_negative_axis_nhwc():
     small = jnp.ones((2, 6, 6, 5))
     out = safe_concat(large, small, axis=-1)
     assert out.shape == (2, 6, 6, 8)
+
+
+def test_phase_timer_accumulates():
+    import time as _time
+
+    from coinstac_dinunet_tpu.utils.profiling import PhaseTimer
+
+    cache = {"profile": True}
+    timer = PhaseTimer(cache)
+    for _ in range(3):
+        with timer("roundtrip"):
+            _time.sleep(0.002)
+    s = cache["profile_stats"]["roundtrip"]
+    assert s["calls"] == 3 and s["total_s"] >= 0.006 and s["max_s"] > 0
+
+    # disabled: no stats, no overhead path
+    cache2 = {}
+    with PhaseTimer(cache2)("x"):
+        pass
+    assert "profile_stats" not in cache2
+
+
+def test_phase_timer_records_through_federated_run(tmp_path):
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_nodes import _make_engine
+
+    eng = _make_engine(tmp_path, profile=True).run(max_rounds=600)
+    assert eng.success
+    stats = eng.remote_cache.get("profile_stats", {})
+    assert stats.get("remote:round", {}).get("calls", 0) > 0
+    site0 = eng.site_caches[eng.site_ids[0]].get("profile_stats", {})
+    assert any(k.startswith("local:") for k in site0)
