@@ -89,3 +89,44 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None, impl=None,
     lse0 = jnp.full((b, h, t_local), _NEG, jnp.float32) + 0.0 * q[..., 0].astype(jnp.float32)
     (acc, _, _, _), _ = lax.scan(step, (acc0, lse0, k, v), jnp.arange(n))
     return acc.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, scale=None, impl=None,
+                      block_q=128, block_k=128):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    The alternative SP schedule to :func:`ring_attention`: instead of rotating
+    K/V blocks, one ``all_to_all`` reshards from sequence-sharded to
+    HEAD-sharded — each rank then holds ``heads/n`` full-length sequences,
+    runs ordinary (flash) attention locally, and a second ``all_to_all``
+    reshards back.  Two collectives total (vs ``n-1`` ring hops), but each
+    rank must fit the full sequence for its head slice — the ring wins at
+    extreme lengths, Ulysses wins when heads ≥ ranks and T_local·n fits.
+
+    Args/returns match :func:`ring_attention` (local ``(batch, heads,
+    T_local, head_dim)`` shards under ``shard_map``); ``heads`` must be
+    divisible by the axis size.
+    """
+    n = lax.axis_size(axis_name)
+    b, h, t_local, d = q.shape
+    if h % n != 0:
+        raise ValueError(f"heads {h} must divide the '{axis_name}' axis size {n}")
+
+    def seq_to_heads(x):
+        # (b, h, t_local, d) → (b, h/n, n*t_local, d): scatter heads, gather seq
+        x = x.reshape(b, n, h // n, t_local, d)
+        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0, tiled=False)
+        # leading axis is now the source rank (= sequence block) dimension
+        return x.transpose(1, 2, 0, 3, 4).reshape(b, h // n, n * t_local, d)
+
+    def heads_to_seq(x):
+        x = x.reshape(b, h // n, n, t_local, d).transpose(2, 0, 1, 3, 4)
+        x = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1, tiled=False)
+        return x.reshape(b, h, t_local, d)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = flash_attention(
+        qh, kh, vh, causal=causal, scale=scale, impl=impl,
+        block_q=block_q, block_k=block_k,
+    )
+    return heads_to_seq(out)
