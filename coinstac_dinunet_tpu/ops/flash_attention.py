@@ -18,8 +18,14 @@ Design:
 - **f32 softmax state regardless of input dtype** (bf16 in, f32 accumulate on
   the MXU via ``preferred_element_type``).
 - **Fully-masked rows** use a large-negative sentinel rather than ``-inf`` so
-  the kernel stays NaN-free; such rows report ``lse ≈ -1e30`` and their output
-  is annihilated by the log-sum-exp merge in the ring step.
+  the kernel stays NaN-free; such rows emit zero output and report
+  ``lse ≈ -1e30``, which the log-sum-exp merge in the ring step annihilates.
+- **Position scalars are int32 end-to-end** (SMEM holds int32 natively); an
+  f32 round-trip would corrupt masks beyond 2^24 tokens.
+- **kv streams through the grid**: the kv axis is the innermost (sequential)
+  grid dimension with the online-softmax state carried in VMEM scratch, so
+  K/V VMEM residency is one (block_k, head_dim) tile regardless of sequence
+  length.
 - Backward is a blockwise XLA recompute from the saved ``(out, lse)``
   residuals (the standard flash backward identities, including the lse
   cotangent the ring merge produces) — no score matrix crosses passes.
@@ -29,6 +35,8 @@ Design:
 """
 import functools
 import math
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -43,14 +51,16 @@ except Exception:  # noqa: BLE001
     _HAVE_PALLAS = False
 
 _NEG = -1.0e30  # finite "-inf": keeps exp/max NaN-free for fully-masked rows
+_MASKED_LSE = _NEG / 2  # rows whose running max stays below this are fully masked
 _LANE = 128
 
 
 def _valid_mask(shape, aux, row_axis, col_axis, iq, j, block_q, block_k, causal):
     """Key-validity (and optionally causal) mask from global positions.
 
-    ``aux = [q_offset, k_offset, kv_len]`` f32 scalars; row/col global ids are
-    the offsets plus block-local coordinates.
+    ``aux = [q_offset, k_offset, kv_len]`` int32 scalars (int32 end-to-end —
+    an f32 round-trip would lose exactness above 2^24 positions); row/col
+    global ids are the offsets plus block-local coordinates.
     """
     q_off = aux[0].astype(jnp.int32)
     k_off = aux[1].astype(jnp.int32)
@@ -64,44 +74,61 @@ def _valid_mask(shape, aux, row_axis, col_axis, iq, j, block_q, block_k, causal)
 
 
 def _flash_kernel(aux_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                  *, scale, causal, block_q, block_k):
+                  acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k):
+    """One (q-block, k-block) tile.  The kv axis is the innermost grid
+    dimension (sequential on TPU), so only one (block_k, d) key/value tile is
+    resident in VMEM at a time — arbitrarily long sequences stream through.
+    The online-softmax state (acc, running max, running sum) lives in VMEM
+    scratch, which persists across the sequential kv steps; max/sum are kept
+    lane-broadcast (all 128 lanes equal) so no lane-slicing is needed."""
     iq = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
     q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
-    nk = k_ref.shape[1] // block_k
-    d = q_ref.shape[-1]
+    k = k_ref[0].astype(jnp.float32)          # (block_k, d)
+    v = v_ref[0].astype(jnp.float32)
     scalars = (aux_ref[0, 0], aux_ref[0, 1], aux_ref[0, 2])
+    s = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (block_q, block_k)
+    valid = _valid_mask(s.shape, scalars, 0, 1, iq, j, block_q, block_k, causal)
+    s = jnp.where(valid, s, _NEG)
 
-    def body(j, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (block_q, block_k)
-        valid = _valid_mask(s.shape, scalars, 0, 1, iq, j, block_q, block_k, causal)
-        s = jnp.where(valid, s, _NEG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l = l * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return acc, m_new, l
-
-    acc, m, l = lax.fori_loop(
-        0, nk, body,
-        (
-            jnp.zeros((block_q, d), jnp.float32),
-            jnp.full((block_q,), _NEG, jnp.float32),
-            jnp.zeros((block_q,), jnp.float32),
-        ),
+    m_prev = m_ref[:]                                   # (block_q, LANE)
+    l_prev = l_ref[:]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)          # (block_q, 1)
+    m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+    alpha = jnp.exp(m_prev - m_new)                     # lane-broadcast
+    p = jnp.exp(s - m_new[:, :1])
+    l_new = l_prev * alpha + jnp.broadcast_to(
+        jnp.sum(p, axis=-1, keepdims=True), l_prev.shape
     )
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = acc / l_safe[:, None]
-    # lse is (1, block_q, 1): the trailing singleton keeps the block shape
-    # legal for Mosaic (last two dims must be 8/128-divisible or full-size)
-    lse_ref[0, :, 0] = m + jnp.log(l_safe)
+    acc_ref[:] = acc_ref[:] * alpha[:, :1] + lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_ref[:] = m_new
+    l_ref[:] = l_new
+
+    @pl.when(j == nk - 1)
+    def _final():
+        m1 = jnp.max(m_ref[:], axis=-1)                 # lanes equal → value
+        l1 = jnp.max(l_ref[:], axis=-1)
+        l_safe = jnp.maximum(l1, 1e-30)
+        # fully-masked rows (m stuck at the sentinel) emit zeros, not mean(V)
+        row_ok = m1 > _MASKED_LSE
+        o_ref[0] = jnp.where(
+            row_ok[:, None], acc_ref[:] / l_safe[:, None], 0.0
+        )
+        # lse is (1, block_q, 1): the trailing singleton keeps the block shape
+        # legal for Mosaic (last two dims must be 8/128-divisible or full-size)
+        lse_ref[0, :, 0] = m1 + jnp.log(l_safe)
 
 
 def _pad_to(x, axis, multiple):
@@ -126,20 +153,25 @@ def _flash_pallas(q, k, v, aux, scale, causal, block_q, block_k, interpret):
     )
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, tqp // block_q),
+        grid=(bh, tqp // block_q, tkp // block_k),
         in_specs=[
-            pl.BlockSpec((1, 3), lambda b, i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, tkp, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, tkp, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 3), lambda b, i, j: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tqp, d), jnp.float32),
             jax.ShapeDtypeStruct((bh, tqp, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),      # acc
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # running max (lanes equal)
+            pltpu.VMEM((block_q, _LANE), jnp.float32),  # running sum (lanes equal)
         ],
         interpret=interpret,
     )(aux.reshape(1, 3), qp, kp, vp)
@@ -157,6 +189,8 @@ def _flash_xla(q, k, v, aux, scale, causal):
     p = jnp.exp(s - m[..., None])
     l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
     out = jnp.einsum("bqk,bkd->bqd", p / l[..., None], v.astype(jnp.float32))
+    # fully-masked rows emit zeros (not mean(V)); their lse keeps the sentinel
+    out = jnp.where((m > _MASKED_LSE)[..., None], out, 0.0)
     return out, m + jnp.log(l)
 
 
@@ -198,7 +232,7 @@ def _flash_bwd(scale, causal, impl, block_q, block_k, res, g):
     v_blocks = vp.reshape(*k_blocks.shape)
     # fully-masked rows carry the _NEG sentinel lse; zero it so exp stays
     # finite (their p is hard-zeroed by the validity mask anyway)
-    lse_safe = jnp.where(lse <= _NEG / 2, 0.0, lse)[..., None]
+    lse_safe = jnp.where(lse <= _MASKED_LSE, 0.0, lse)[..., None]
     delta = jnp.sum(g_out * out, axis=-1, keepdims=True)  # flash D_i identity
 
     def body(dq, xs):
@@ -219,9 +253,11 @@ def _flash_bwd(scale, causal, impl, block_q, block_k, res, g):
         (k_blocks.swapaxes(0, 1), v_blocks.swapaxes(0, 1), jnp.arange(nk)),
     )
     join = lambda b: b.swapaxes(0, 1).reshape(kp.shape)[:, :tk]
+    # aux is int32 → its tangent space is float0
+    aux_ct = np.zeros(aux.shape, dtype=jax.dtypes.float0)
     return (
         dq.astype(q.dtype), join(dk_b).astype(k.dtype),
-        join(dv_b).astype(v.dtype), jnp.zeros_like(aux),
+        join(dv_b).astype(v.dtype), aux_ct,
     )
 
 
@@ -263,7 +299,7 @@ def flash_attention(q, k, v, q_offset=0, k_offset=0, kv_len=None, causal=False,
     if impl is None:
         impl = default_impl()
     aux = jnp.asarray(
-        [q_offset, k_offset, tk if kv_len is None else kv_len], jnp.float32
+        [q_offset, k_offset, tk if kv_len is None else kv_len], jnp.int32
     )
     out, lse = _flash_pair(
         q.reshape(b * h, tq, d), k.reshape(b * h, tk, d),

@@ -36,8 +36,9 @@ GROUP = 128  # elements per scale group = VPU lane width
 
 
 def _quant_kernel(seed_ref, x_ref, v_ref, s_ref):
-    pltpu.prng_seed(seed_ref[0])
-    x = x_ref[:]  # (rows, GROUP) f32
+    # salt the seed with the grid position so row blocks draw independent bits
+    pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+    x = x_ref[:]  # (block_rows, GROUP) f32
     absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
     scale = jnp.maximum(absmax / 127.0, 1e-30)
     scaled = x / scale
@@ -56,17 +57,24 @@ def _quant_kernel(seed_ref, x_ref, v_ref, s_ref):
     s_ref[:] = scale
 
 
+# rows staged per grid step: 2048×128 f32 input ≈ 1 MiB VMEM (+ int8 out),
+# so arbitrarily large gradients stream through without exceeding VMEM
+_BLOCK_ROWS = 2048
+
+
 def _quantize_pallas(groups, seed, interpret):
     rows = groups.shape[0]
+    block = min(_BLOCK_ROWS, rows)
     return pl.pallas_call(
         _quant_kernel,
+        grid=(pl.cdiv(rows, block),),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, GROUP), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, GROUP), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows, GROUP), jnp.int8),
@@ -102,6 +110,8 @@ def quantize_int8(x, seed=0, impl=None):
     flat = np.asarray(x, np.float32).reshape(-1) if impl == "numpy" else \
         jnp.asarray(x, jnp.float32).reshape(-1)
     n = flat.shape[0]
+    if n == 0:
+        return (np.zeros((0, GROUP), np.int8), np.zeros((0, 1), np.float32), shape)
     pad = (-n) % GROUP
     if impl == "numpy":
         groups = np.pad(flat, (0, pad)).reshape(-1, GROUP)

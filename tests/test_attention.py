@@ -10,6 +10,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from coinstac_dinunet_tpu.ops import flash_attention
 from coinstac_dinunet_tpu.parallel import ring_attention
+from coinstac_dinunet_tpu.parallel.ring_attention import ulysses_attention
 
 
 def naive_attention(q, k, v, causal=False):
@@ -67,6 +68,19 @@ def test_flash_kv_len_masks_tail():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.parametrize("impl", ["xla", "pallas_interpret"])
+def test_flash_fully_masked_rows_emit_zeros(impl):
+    # kv_len=0 masks every key: all rows must be exactly zero, not mean(V)
+    q, k, v = _qkv(jax.random.PRNGKey(6), b=1, h=1, t=16, d=16)
+    out, lse = flash_attention(q, k, v, kv_len=0, impl=impl, return_lse=True)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+    assert np.all(np.asarray(lse) < -1e29)  # sentinel survives for ring merge
+    # q_offset before every causal key: same story for a causal slice
+    out2 = flash_attention(q, k, v, causal=True, q_offset=0, k_offset=64,
+                           impl=impl)
+    np.testing.assert_array_equal(np.asarray(out2), 0.0)
+
+
 # ------------------------------------------------------------ ring attention
 def _ring_vs_full(causal, n_ranks=4, t_local=16):
     devs = jax.devices()[:n_ranks]
@@ -96,6 +110,67 @@ def test_ring_attention_matches_full(causal):
 
 def test_ring_attention_eight_ranks():
     _ring_vs_full(causal=True, n_ranks=8, t_local=8)
+
+
+# --------------------------------------------------------- ulysses attention
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(causal):
+    n_ranks, t_local = 4, 16
+    mesh = Mesh(np.array(jax.devices()[:n_ranks]), ("sp",))
+    b, h, d = 2, 4, 16  # heads == ranks (minimum Ulysses shape)
+    t = n_ranks * t_local
+    q, k, v = _qkv(jax.random.PRNGKey(7), b=b, h=h, t=t, d=d)
+    spec = P(None, None, "sp")
+
+    def local(q, k, v):
+        return ulysses_attention(q, k, v, "sp", causal=causal, impl="xla")
+
+    out = jax.jit(
+        jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)
+    )(q, k, v)
+    full = flash_attention(q, k, v, causal=causal, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full), atol=1e-5)
+
+
+def test_ulysses_attention_grads_match_full():
+    n_ranks, t_local = 2, 8
+    mesh = Mesh(np.array(jax.devices()[:n_ranks]), ("sp",))
+    b, h, d = 1, 4, 8
+    t = n_ranks * t_local
+    q, k, v = _qkv(jax.random.PRNGKey(8), b=b, h=h, t=t, d=d)
+    spec = P(None, None, "sp")
+
+    def uly_loss(q, k, v):
+        def local(q, k, v):
+            o = ulysses_attention(q, k, v, "sp", causal=True, impl="xla")
+            return jax.lax.psum(jnp.sum(o ** 2), "sp")
+
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P()
+        )(q, k, v)
+
+    def full_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, impl="xla") ** 2)
+
+    g1 = jax.grad(uly_loss, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    n_ranks = 4
+    mesh = Mesh(np.array(jax.devices()[:n_ranks]), ("sp",))
+    q, k, v = _qkv(jax.random.PRNGKey(9), b=1, h=2, t=32, d=8)
+    spec = P(None, None, "sp")
+
+    def local(q, k, v):
+        return ulysses_attention(q, k, v, "sp", impl="xla")
+
+    with pytest.raises(ValueError, match="heads"):
+        jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)(q, k, v)
 
 
 def test_ring_attention_grads_match_full():

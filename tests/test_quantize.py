@@ -50,6 +50,32 @@ def test_pallas_interpret_matches_numpy_scale():
     np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-6)
 
 
+def test_pallas_grid_tiles_large_tensors(monkeypatch):
+    # shrink the block size so a modest tensor spans several grid steps —
+    # exercises the VMEM-bounded streaming path used for multi-MB gradients
+    from coinstac_dinunet_tpu.ops import quantize as q
+
+    monkeypatch.setattr(q, "_BLOCK_ROWS", 4)
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(23 * 128 + 17,)).astype(np.float32)  # 24 rows, ragged
+    vals, scales, shape = quantize_int8(x, seed=9, impl="pallas_interpret")
+    assert vals.shape == (24, 128) and scales.shape == (24, 1)
+    out = dequantize_int8(vals, scales, shape)
+    assert np.abs(out - x).max() <= np.max(np.abs(x)) / 127.0 + 1e-6
+    # per-row scales must match the numpy reference exactly (rounding is the
+    # only stochastic part)
+    _, s_np, _ = quantize_int8(x, impl="numpy")
+    np.testing.assert_allclose(np.asarray(scales), np.asarray(s_np), rtol=1e-6)
+
+
+def test_quantize_empty_tensor():
+    from coinstac_dinunet_tpu.ops.quantize import GROUP
+
+    vals, scales, shape = quantize_int8(np.zeros((0,), np.float32), impl="numpy")
+    assert vals.shape == (0, GROUP) and scales.shape == (0, 1)
+    assert dequantize_int8(vals, scales, shape).shape == (0,)
+
+
 def test_wire_codec_transparent(tmp_path):
     rng = np.random.default_rng(3)
     arrays = [
