@@ -15,14 +15,21 @@ Directory layout per site ``i`` under ``workdir``::
     remote_base/site_<i> site's transferDirectory == aggregator's inbox
     remote_xfer          aggregator's transferDirectory (broadcast outbox)
 """
+import datetime
+import math
 import os
 import shutil
 
-from .config.keys import Mode, Phase
+import numpy as np
+
+from . import config, utils
+from .config.keys import Key, Mode, Phase
 from .data import COINNDataHandle
 from .nodes import COINNLocal, COINNRemote
 from .trainer import COINNTrainer
 from .utils import logger
+from .utils.utils import performance_improved_, stop_training_
+from .vision import plotter
 
 
 class InProcessEngine:
@@ -130,6 +137,289 @@ class InProcessEngine:
                     True,
                 )
         return self
+
+
+class MeshEngine:
+    """Full federated lifecycle with the mesh transport as the gradient plane.
+
+    Host-side control mirrors :class:`~.nodes.COINNRemote`'s state machine —
+    fold rotation, lockstep epochs, the validation cadence, exact cross-site
+    count-merge of metrics, best-checkpoint saves, early stopping, per-fold
+    test reduction, the global score CSV and the results zip (ref
+    ``distrib/nodes/remote.py:238-287``) — while every training round is ONE
+    compiled ``shard_map`` step over the ``(site, device)`` mesh
+    (:class:`~.parallel.mesh.MeshFederation`) and evaluation is a compiled
+    psum-reduced eval step over the same mesh.
+
+    Semantics match :class:`InProcessEngine` byte-for-byte where the math is
+    shared: same per-site data layout and splits, same loader order (seeded
+    by ``(seed, epoch)``), same lockstep ``target_batches`` padding, same
+    best/early-stop decisions, same score artifacts.  What differs is the
+    wire: gradients never leave the devices.
+
+    Engine-transport-only features (explicitly rejected here): pretrain
+    broadcast (needs per-site model states) and sparse test mode.  Metrics
+    that are not jit-safe (AUC) fall back to per-site host evaluation with
+    identical count/rank math.
+    """
+
+    def __init__(self, workdir, n_sites, trainer_cls=COINNTrainer,
+                 dataset_cls=None, datahandle_cls=COINNDataHandle,
+                 devices=None, devices_per_site=None, site_args=None, **args):
+        if (args.get("pretrain_args") or {}).get("epochs"):
+            raise ValueError(
+                "pretrain broadcast requires the engine transport "
+                "(InProcessEngine); MeshEngine sites share one replicated state"
+            )
+        if args.get("load_sparse"):
+            raise ValueError("sparse test mode requires the engine transport")
+        self.workdir = str(workdir)
+        self.n_sites = int(n_sites)
+        self.trainer_cls = trainer_cls
+        self.dataset_cls = dataset_cls
+        self.datahandle_cls = datahandle_cls
+        self.devices = devices
+        self.devices_per_site = devices_per_site
+        self.site_args = site_args or {}
+
+        self.cache = dict(COINNLocal._ARG_DEFAULTS)
+        self.cache.update(args)
+        if self.cache.get("seed") is None:
+            self.cache["seed"] = config.current_seed
+
+        self.site_ids = [f"site_{i}" for i in range(self.n_sites)]
+        self.site_states = {}
+        for s in self.site_ids:
+            base = os.path.join(self.workdir, s)
+            outd = os.path.join(base, "out")
+            for d in (base, outd):
+                os.makedirs(d, exist_ok=True)
+            self.site_states[s] = {
+                "baseDirectory": base, "outputDirectory": outd, "clientId": s,
+            }
+        self.remote_out_dir = os.path.join(self.workdir, "remote_out")
+        os.makedirs(self.remote_out_dir, exist_ok=True)
+        self.site_caches = {}
+        self.success = False
+        self.results_zip = None
+        self._trainer = None
+
+    def site_data_dir(self, site_id, data_dir=None):
+        d = os.path.join(
+            self.site_states[site_id]["baseDirectory"],
+            data_dir or self.cache.get("data_dir", "data"),
+        )
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # ------------------------------------------------------------- lifecycle
+    def run(self):
+        """Drive every fold to completion; sets ``success`` at the end."""
+        handles = {}
+        for s in self.site_ids:
+            scache = dict(self.cache)
+            scache.update(self.site_args.get(s, {}))
+            self.site_caches[s] = scache
+            h = self.datahandle_cls(
+                cache=scache, state=self.site_states[s],
+                dataset_cls=self.dataset_cls,
+                dataloader_args=scache.get("dataloader_args"),
+            )
+            h.prepare_data()
+            handles[s] = h
+        rc = self.cache
+        rc["num_folds"] = len(next(iter(self.site_caches.values()))["splits"])
+        rc[Key.GLOBAL_TEST_SERIALIZABLE.value] = []
+        for fold in range(int(rc["num_folds"])):
+            self._run_fold(str(fold), handles)
+        self._finish()
+        return self
+
+    def _run_fold(self, split_ix, handles):
+        from .parallel.mesh import MeshFederation
+
+        rc = self.cache
+        for s in self.site_ids:
+            sc = self.site_caches[s]
+            sc["split_ix"] = split_ix
+            sc["split_file"] = sc["splits"][split_ix]
+        log_dir = os.path.join(
+            self.remote_out_dir, str(rc["task_id"]), f"fold_{split_ix}"
+        )
+        os.makedirs(log_dir, exist_ok=True)
+        rc.update(log_dir=log_dir, epoch=0, best_val_epoch=0, best_val_score=None)
+        rc[Key.TRAIN_LOG.value] = []
+        rc[Key.VALIDATION_LOG.value] = []
+        rc[Key.TEST_METRICS.value] = []
+        tag = f"{rc['task_id']}-{split_ix}"
+        rc["best_nn_state"] = f"best.{tag}.ckpt"
+        rc["latest_nn_state"] = f"latest.{tag}.ckpt"
+
+        trainer = self.trainer_cls(
+            cache=rc, input={},
+            state={"outputDirectory": self.remote_out_dir}, data_handle=None,
+        )
+        trainer.init_nn()
+        self._trainer = trainer
+        fed = MeshFederation(
+            trainer, self.n_sites, agg_engine=str(rc.get("agg_engine", "dSGD")),
+            devices=self.devices, devices_per_site=self.devices_per_site,
+        )
+
+        bs = int(rc.get("batch_size", 16))
+        train_sets = {s: handles[s].get_train_dataset() for s in self.site_ids}
+        # lockstep epochs: every site pads to the global max batches/epoch
+        # (≙ remote's target_batches broadcast)
+        target_batches = max(
+            (math.ceil(len(ds) / bs) for ds in train_sets.values() if len(ds)),
+            default=1,
+        )
+        k = max(int(rc.get("local_iterations", 1)), 1)
+        epochs = int(rc.get("epochs", 1))
+        val_every = max(int(rc.get("validation_epochs", 1)), 1)
+        ep_averages, ep_metrics = trainer.new_averages(), trainer.new_metrics()
+        epoch = 0
+        while True:
+            epoch += 1
+            rc["epoch"] = epoch
+            # loader epoch is 0-based (matches the cursor transport's
+            # cache['epoch'] at first use)
+            iters = [
+                iter(handles[s].get_loader(
+                    "train", dataset=train_sets[s], shuffle=True,
+                    seed=int(rc.get("seed", 0)), epoch=epoch - 1,
+                    target_batches=target_batches,
+                ))
+                for s in self.site_ids
+            ]
+            done = 0
+            while done < target_batches:
+                take = min(k, target_batches - done)
+                site_batches = [
+                    [next(it) for _ in range(take)] for it in iters
+                ]
+                aux = fed.train_step(site_batches)
+                ep_averages.update(aux["averages"])
+                if aux.get("metrics") is not None and ep_metrics.jit_safe:
+                    ep_metrics.update(aux["metrics"])
+                done += take
+            if epoch % val_every != 0:
+                continue
+            # ---- epoch barrier (≙ remote VALIDATION_WAITING → TRAIN_WAITING)
+            rc[Key.TRAIN_LOG.value].append([*ep_averages.get(), *ep_metrics.get()])
+            ep_averages, ep_metrics = trainer.new_averages(), trainer.new_metrics()
+            v_avg, v_met = self._mesh_eval(fed, handles, "validation")
+            rc[Key.VALIDATION_LOG.value].append([*v_avg.get(), *v_met.get()])
+            # no fallback: a missing monitor metric must fail loudly, exactly
+            # like the file-transport remote (``remote.py`` ``_save_if_better``)
+            score = v_met.extract(rc.get("monitor_metric", "f1"))
+            if performance_improved_(epoch, score, rc):
+                trainer.save_checkpoint(name=rc["best_nn_state"])
+            if logger.lazy_debug(epoch):
+                plotter.plot_progress(
+                    rc, log_dir,
+                    plot_keys=[Key.TRAIN_LOG.value, Key.VALIDATION_LOG.value],
+                )
+            if epoch >= epochs or stop_training_(epoch, rc):
+                break
+
+        # ---- fold test with the best params (≙ test_distributed + on_run_end)
+        if os.path.exists(trainer.checkpoint_path(rc["best_nn_state"])):
+            trainer.load_checkpoint(name=rc["best_nn_state"])
+        t_avg, t_met = self._mesh_eval(fed, handles, "test")
+        rc[Key.TEST_METRICS.value].append([*t_avg.get(), *t_met.get()])
+        rc[Key.GLOBAL_TEST_SERIALIZABLE.value].append(
+            {"averages": t_avg.serialize(), "metrics": t_met.serialize()}
+        )
+        plotter.plot_progress(
+            rc, log_dir, plot_keys=[Key.TRAIN_LOG.value, Key.VALIDATION_LOG.value]
+        )
+        utils.save_scores(rc, log_dir=log_dir, file_keys=[Key.TEST_METRICS.value])
+        utils.save_cache(rc, {"outputDirectory": log_dir})
+
+    # ------------------------------------------------------------- evaluation
+    def _mesh_eval(self, fed, handles, which):
+        """Globally-reduced evaluation: per-site loaders padded to lockstep
+        length, one psum-reduced compiled step per batch index."""
+        trainer = self._trainer
+        if not trainer.new_metrics().jit_safe:
+            return self._host_eval(handles, which)
+        bs = int(self.cache.get("batch_size", 16))
+        datasets = {
+            s: (handles[s].get_validation_dataset() if which == "validation"
+                else handles[s].get_test_dataset())
+            for s in self.site_ids
+        }
+        nb = max(
+            (math.ceil(len(ds) / bs) for ds in datasets.values() if len(ds)),
+            default=0,
+        )
+        metrics, averages = trainer.new_metrics(), trainer.new_averages()
+        if nb == 0:
+            return averages, metrics
+        loaders = {
+            s: (iter(handles[s].get_loader(
+                which, dataset=datasets[s], shuffle=False, target_batches=nb))
+                if len(datasets[s]) else None)
+            for s in self.site_ids
+        }
+        for _ in range(nb):
+            batches = [
+                (next(loaders[s]) if loaders[s] is not None else None)
+                for s in self.site_ids
+            ]
+            template = next(b for b in batches if b is not None)
+            filled = []
+            for b in batches:
+                if b is None:  # site with no data: fully-masked placeholder
+                    b = dict(template)
+                    b["_mask"] = np.zeros_like(np.asarray(template["_mask"]))
+                filled.append(b)
+            m_state, a_state = fed.eval_step(filled)
+            if m_state is not None:
+                metrics.update(m_state)
+            averages.update(a_state)
+        return averages, metrics
+
+    def _host_eval(self, handles, which):
+        """Per-site host-side evaluation with exact cross-site accumulation —
+        the fallback for metrics whose state is not jit-safe (AUC)."""
+        trainer = self._trainer
+        metrics, averages = trainer.new_metrics(), trainer.new_averages()
+        mode = Mode.VALIDATION if which == "validation" else Mode.TEST
+        for s in self.site_ids:
+            trainer.data_handle = handles[s]
+            ds = (handles[s].get_validation_dataset() if which == "validation"
+                  else handles[s].get_test_dataset())
+            if not len(ds):
+                continue
+            a, m = trainer.evaluation(mode, [ds])
+            metrics.accumulate(m)
+            averages.accumulate(a)
+        trainer.data_handle = None
+        return averages, metrics
+
+    # ---------------------------------------------------------------- wrap-up
+    def _finish(self):
+        """All folds done: reduce fold scores, write the CSV, zip results
+        (≙ remote ``_send_global_scores``)."""
+        trainer = self._trainer
+        rc = self.cache
+        pairs = rc[Key.GLOBAL_TEST_SERIALIZABLE.value]
+        averages = trainer.new_averages().reduce_sites(
+            [p["averages"] for p in pairs]
+        )
+        metrics = trainer.new_metrics().reduce_sites(
+            [p["metrics"] for p in pairs]
+        )
+        rc["global_test_metrics"] = [[*averages.get(), *metrics.get()]]
+        task_dir = os.path.join(self.remote_out_dir, str(rc["task_id"]))
+        utils.save_scores(rc, log_dir=task_dir, file_keys=["global_test_metrics"])
+        stamp = "_".join(str(datetime.datetime.now()).split(" "))
+        zip_name = f"{rc['task_id']}_{rc.get('agg_engine')}_{stamp}"
+        shutil.make_archive(os.path.join(self.workdir, zip_name), "zip", task_dir)
+        self.results_zip = f"{zip_name}.zip"
+        self.success = True
 
 
 class SiteRunner:

@@ -36,8 +36,11 @@ GROUP = 128  # elements per scale group = VPU lane width
 
 
 def _quant_kernel(seed_ref, x_ref, v_ref, s_ref):
-    # salt the seed with the grid position so row blocks draw independent bits
-    pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+    # salt with the grid position as a SECOND seed word: adding it to the
+    # caller seed would collide block b of array i with block 0 of array i+b
+    # (save_wire hands out consecutive per-array seeds), re-correlating the
+    # rounding noise that cross-site averaging depends on being independent
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
     x = x_ref[:]  # (block_rows, GROUP) f32
     absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
     scale = jnp.maximum(absmax / 127.0, 1e-30)

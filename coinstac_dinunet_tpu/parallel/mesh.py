@@ -63,14 +63,36 @@ class MeshFederation:
     error-feedback state (PowerSGD) is site-sharded.
     """
 
+    SUPPORTED_ENGINES = ("dSGD", "powerSGD", "rankDAD")
+
     def __init__(self, trainer, n_sites, agg_engine="dSGD", devices=None,
                  devices_per_site=None):
         self.trainer = trainer
         self.n_sites = int(n_sites)
         self.agg_engine = str(agg_engine)
+        if self.agg_engine not in self.SUPPORTED_ENGINES:
+            raise ValueError(
+                f"agg_engine {self.agg_engine!r} is not supported on the mesh "
+                f"transport (supported: {self.SUPPORTED_ENGINES}); refusing to "
+                "silently change the algorithm"
+            )
+        if self.agg_engine == "rankDAD" and devices_per_site is None:
+            devices_per_site = 1  # factor rows cannot split over devices
         self.mesh = build_site_mesh(self.n_sites, devices, devices_per_site)
+        if self.agg_engine == "rankDAD":
+            if self.mesh.devices.shape[1] != 1:
+                raise ValueError(
+                    "mesh rankDAD requires devices_per_site == 1 (per-sample "
+                    "factor rows cannot split over the device axis)"
+                )
+            if int(self.trainer.cache.get("local_iterations", 1)) > 1:
+                raise ValueError(
+                    "rankDAD does not support local_iterations > 1 "
+                    "(ref rankdad/__init__.py:48-49)"
+                )
         self.comm_state = {}  # site-sharded engine state (PowerSGD EF memory)
         self._hi_ix = None  # static: flat-leaf indices compressed by PowerSGD
+        self._dad = None  # rankDAD capture plan (layer keys, leaf map, shapes)
         self._step = None
         self._eval = None
 
@@ -107,6 +129,108 @@ class MeshFederation:
             qs.append(jnp.tile(q[None], (self.n_sites, 1, 1)))
         self.comm_state = {"errors": errors, "qs": qs}
         return self.comm_state
+
+    # ------------------------------------------------------- rankDAD plumbing
+    def init_rankdad_plan(self, site_batch):
+        """Shape-only capture discovery from one site-local batch (shared
+        machinery with the file-transport learner, ``rankdad.py``)."""
+        from .rankdad import discover_capture
+
+        ts = self.trainer.train_state
+        layer_keys, shapes, leaf_map, rest_ix = discover_capture(
+            self.trainer.iteration, ts.params, site_batch, ts.rng
+        )
+        self._dad = {
+            "layer_keys": tuple(layer_keys),
+            "shapes": dict(shapes),
+            "leaf_map": dict(leaf_map),
+            "rest_ix": tuple(rest_ix),
+        }
+        return self._dad
+
+    def _build_rankdad_step(self):
+        """One compiled rankDAD round: per-site capture + rank-r compression,
+        ``all_gather`` of the (B, C) factors over the ``site`` axis (concat
+        along the rank axis ≙ the reference reducer's sample-axis concat,
+        ``rankdad/__init__.py:70-98``), local reconstruction, synchronized
+        update.  Reconstruction is local, so no re-compression round is
+        needed (≙ file path with ``dad_recompress=False``)."""
+        from .rankdad import compress_layer_factors, make_dad_loss
+
+        trainer = self.trainer
+        metrics_shell, averages_shell = trainer._metrics_shell()
+        dad = self._dad
+        layer_keys = dad["layer_keys"]
+        leaf_map = dad["leaf_map"]
+        rest_ix = set(dad["rest_ix"])
+        shapes = dad["shapes"]
+        rank = int(trainer.cache.get("dad_reduction_rank", 10))
+        iters = int(trainer.cache.get("dad_num_pow_iters", 5))
+        n_sites = self.n_sites
+        _loss = make_dad_loss(trainer.iteration)
+
+        def site_step(ts, stacked):
+            # (1, k=1, B, ...) site shard → the site's single batch
+            batch = jax.tree_util.tree_map(lambda x: x[0, 0], stacked)
+            orig_rng = ts.rng
+            # same rng derivation as the file learner (``DADLearner.to_reduce``)
+            # so both transports compress with identical power-iteration seeds
+            rng_next, sub = jax.random.split(orig_rng)
+            key = jax.random.fold_in(sub, 17)
+            perturbs = {k: jnp.zeros(s, d) for k, (s, d) in shapes.items()}
+            (loss, (it, acts)), (vgrads, pgrads) = jax.value_and_grad(
+                _loss, argnums=(0, 1), has_aux=True
+            )(ts.params, perturbs, batch, sub)
+            Brs, Crs = compress_layer_factors(
+                pgrads, acts, layer_keys, leaf_map, key, rank, iters
+            )
+            leaves, treedef = jax.tree_util.tree_flatten(vgrads)
+            flat = list(leaves)
+            for lk in layer_keys:
+                B_all = jax.lax.all_gather(Brs[lk], "site", axis=0, tiled=True)
+                C_all = jax.lax.all_gather(Crs[lk], "site", axis=0, tiled=True)
+                G = (C_all.T @ B_all) / n_sites  # (din[+1], dout)
+                kern_ix, bias_ix = leaf_map[lk]
+                if bias_ix is not None:
+                    flat[kern_ix] = G[:-1].astype(leaves[kern_ix].dtype)
+                    flat[bias_ix] = G[-1].astype(leaves[bias_ix].dtype)
+                else:
+                    flat[kern_ix] = G.astype(leaves[kern_ix].dtype)
+            for i in rest_ix:
+                flat[i] = jax.lax.pmean(leaves[i], "site")
+            grads = jax.tree_util.tree_unflatten(treedef, flat)
+            ts = trainer._apply_updates(ts, grads)
+            ts = ts.replace(rng=rng_next)
+            m_state, a_state = trainer._step_outputs(
+                it, batch, metrics_shell, averages_shell
+            )
+            aux = {"loss": jax.lax.pmean(loss, "site"), "rng": ts.rng}
+            if m_state is not None:
+                aux["metrics"] = jax.lax.psum(m_state, "site")
+            aux["averages"] = jax.lax.psum(a_state, "site")
+            return ts, aux
+
+        batch_spec = P("site", None, "device")
+        mesh = self.mesh
+        donate = (
+            (0,)
+            if jax.default_backend() != "cpu"
+            and self.trainer.cache.get("donate_buffers", True)
+            else ()
+        )
+
+        @functools.partial(jax.jit, donate_argnums=donate)
+        def step(ts, stacked, comm):
+            ts, aux = jax.shard_map(
+                site_step,
+                mesh=mesh,
+                in_specs=(P(), batch_spec),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )(ts, stacked)
+            return ts, aux, comm
+
+        return step
 
     # ---------------------------------------------------------- compiled step
     def _build_step(self):
@@ -198,7 +322,20 @@ class MeshFederation:
                     rank=int(self.trainer.cache.get("matrix_approximation_rank", 1)),
                     seed=int(self.trainer.cache.get("seed", 0)),
                 )
-            self._step = self._build_step()
+            if self.agg_engine == "rankDAD":
+                if self._dad is None:
+                    if not isinstance(site_batches, (list, tuple)):
+                        raise ValueError(
+                            "first rankDAD train_step needs per-site batch "
+                            "lists (capture discovery reads one batch's shapes)"
+                        )
+                    first = {
+                        k: jnp.asarray(v) for k, v in site_batches[0][0].items()
+                    }
+                    self.init_rankdad_plan(first)
+                self._step = self._build_rankdad_step()
+            else:
+                self._step = self._build_step()
         stacked = (
             self.stack_site_batches(site_batches)
             if isinstance(site_batches, (list, tuple))

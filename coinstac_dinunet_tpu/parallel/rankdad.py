@@ -94,6 +94,88 @@ def _leaf_paths(params):
     return out
 
 
+def discover_capture(iteration, params, batch, rng):
+    """Shape-only discovery of the capture plan (shared by the file learner
+    and the mesh transport).
+
+    Returns ``(layer_keys, perturb_shapes, leaf_map, rest_ix)``:
+    ordered captured-layer keys, their output ``(shape, dtype)``s, a map
+    layer→(kernel leaf ix, bias leaf ix|None) into ``tree_leaves(params)``,
+    and the flat-leaf indices exchanged dSGD-style.
+    """
+    shapes = {}
+
+    def run(params, batch, rng):
+        acts, counts = {}, {}
+        with nn.intercept_methods(
+            _capture_interceptor(acts, counts, shapes=shapes)
+        ):
+            it = iteration(params, batch, rng)
+        return it["loss"]
+
+    jax.eval_shape(run, params, batch, rng)  # traces, zero FLOPs
+    layer_keys = list(shapes.keys())
+    paths = _leaf_paths(params)
+    leaf_map = {}
+    covered = set()
+    for key in layer_keys:
+        mparts = key.split("@")[0].split("/")
+
+        def _match(i, leaf_name):
+            want = mparts + [leaf_name]
+            return paths[i][-len(want):] == want
+
+        kern = [i for i in range(len(paths)) if _match(i, "kernel")]
+        bias = [i for i in range(len(paths)) if _match(i, "bias")]
+        if len(kern) != 1:
+            raise ValueError(
+                f"rankDAD: cannot uniquely map layer {key!r} to a kernel "
+                f"leaf (matches: {len(kern)}); use unique module names."
+            )
+        b = bias[0] if len(bias) == 1 else None
+        leaf_map[key] = (kern[0], b)
+        covered.add(kern[0])
+        if b is not None:
+            covered.add(b)
+    rest_ix = [i for i in range(len(paths)) if i not in covered]
+    return layer_keys, dict(shapes), leaf_map, rest_ix
+
+
+def make_dad_loss(iteration):
+    """Loss wrapper whose second grad argument (the zero perturbations) yields
+    the per-layer output gradients; also returns captured activations."""
+
+    def _loss(params, perturbs, batch, rng):
+        acts, counts = {}, {}
+        with nn.intercept_methods(
+            _capture_interceptor(acts, counts, perturbs=perturbs)
+        ):
+            it = iteration(params, batch, rng)
+        return it["loss"], (it, acts)
+
+    return _loss
+
+
+def compress_layer_factors(pgrads, acts, layer_keys, leaf_map, key, rank, iters):
+    """Per-layer (delta, act) → rank-``rank`` (B, C) factors.
+
+    The ones-column append makes the bias gradient exact inside the
+    factorization (beyond the reference's approximation, ``spi.py:190-210``).
+    """
+    Brs, Crs = {}, {}
+    for i, lk in enumerate(layer_keys):
+        delta = _flatten2d(pgrads[lk]).astype(jnp.float32)
+        act = _flatten2d(acts[lk]).astype(jnp.float32)
+        if leaf_map[lk][1] is not None:
+            act = jnp.concatenate(
+                [act, jnp.ones((act.shape[0], 1), act.dtype)], axis=1
+            )
+        Brs[lk], Crs[lk] = power_iteration_BC(
+            delta, act, jax.random.fold_in(key, i), rank=rank, iterations=iters
+        )
+    return Brs, Crs
+
+
 class DADLearner(COINNLearner):
     """Site-side rankDAD (≙ ref ``DADLearner`` + ``DADParallel``)."""
 
@@ -117,43 +199,10 @@ class DADLearner(COINNLearner):
     def _discover(self, params, batch, rng):
         """Shape-only pass: find captured layers + map them to param leaves."""
         st = self.dad
-        shapes = {}
-
-        def run(params, batch, rng):
-            acts, counts = {}, {}
-            with nn.intercept_methods(
-                _capture_interceptor(acts, counts, shapes=shapes)
-            ):
-                it = self.trainer.iteration(params, batch, rng)
-            return it["loss"]
-
-        jax.eval_shape(run, params, batch, rng)  # traces, zero FLOPs
-        st.layer_keys = list(shapes.keys())
+        st.layer_keys, shapes, st.leaf_map, st.rest_ix = discover_capture(
+            self.trainer.iteration, params, batch, rng
+        )
         st.perturbs = {k: jnp.zeros(s, d) for k, (s, d) in shapes.items()}
-        # map each captured layer to its kernel/bias leaves in the flat params
-        paths = _leaf_paths(params)
-        st.leaf_map = {}
-        covered = set()
-        for key in st.layer_keys:
-            mparts = key.split("@")[0].split("/")
-
-            def _match(i, leaf_name):
-                want = mparts + [leaf_name]
-                return paths[i][-len(want):] == want
-
-            kern = [i for i in range(len(paths)) if _match(i, "kernel")]
-            bias = [i for i in range(len(paths)) if _match(i, "bias")]
-            if len(kern) != 1:
-                raise ValueError(
-                    f"rankDAD: cannot uniquely map layer {key!r} to a kernel "
-                    f"leaf (matches: {len(kern)}); use unique module names."
-                )
-            b = bias[0] if len(bias) == 1 else None
-            st.leaf_map[key] = (kern[0], b)
-            covered.add(kern[0])
-            if b is not None:
-                covered.add(b)
-        st.rest_ix = [i for i in range(len(paths)) if i not in covered]
 
     # ------------------------------------------------------------- site steps
     def _dad_compiled(self):
@@ -164,15 +213,7 @@ class DADLearner(COINNLearner):
         layer_keys = tuple(st.layer_keys)
         leaf_map = dict(st.leaf_map)
         rest_ix = tuple(st.rest_ix)
-        iteration = self.trainer.iteration
-
-        def _loss(params, perturbs, batch, rng):
-            acts, counts = {}, {}
-            with nn.intercept_methods(
-                _capture_interceptor(acts, counts, perturbs=perturbs)
-            ):
-                it = iteration(params, batch, rng)
-            return it["loss"], (it, acts)
+        _loss = make_dad_loss(self.trainer.iteration)
 
         def _fn(params, perturbs, batch, rng, key):
             # one backward pass for both the output-grads (∂L/∂ε) and the
@@ -180,19 +221,9 @@ class DADLearner(COINNLearner):
             (loss, (it, acts)), (vgrads, pgrads) = jax.value_and_grad(
                 _loss, argnums=(0, 1), has_aux=True
             )(params, perturbs, batch, rng)
-            Brs, Crs = {}, {}
-            for i, lk in enumerate(layer_keys):
-                delta = _flatten2d(pgrads[lk]).astype(jnp.float32)
-                act = _flatten2d(acts[lk]).astype(jnp.float32)
-                if leaf_map[lk][1] is not None:
-                    # ones-column ⇒ bias grad is exact inside the factors
-                    act = jnp.concatenate(
-                        [act, jnp.ones((act.shape[0], 1), act.dtype)], axis=1
-                    )
-                Brs[lk], Crs[lk] = power_iteration_BC(
-                    delta, act, jax.random.fold_in(key, i), rank=rank,
-                    iterations=iters,
-                )
+            Brs, Crs = compress_layer_factors(
+                pgrads, acts, layer_keys, leaf_map, key, rank, iters
+            )
             vleaves = jax.tree_util.tree_leaves(vgrads)
             rest = [vleaves[i] for i in rest_ix]
             return Brs, Crs, rest, loss, it
