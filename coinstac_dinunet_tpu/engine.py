@@ -265,6 +265,7 @@ class MeshEngine:
             trainer, self.n_sites, agg_engine=str(rc.get("agg_engine", "dSGD")),
             devices=self.devices, devices_per_site=self.devices_per_site,
         )
+        self._last_fed = fed
 
         bs = int(rc.get("batch_size", 16))
         train_sets = {s: handles[s].get_train_dataset() for s in self.site_ids}
@@ -299,9 +300,7 @@ class MeshEngine:
                     [next(it) for _ in range(take)] for it in iters
                 ]
                 aux = fed.train_step(site_batches)
-                ep_averages.update(aux["averages"])
-                if aux.get("metrics") is not None and ep_metrics.jit_safe:
-                    ep_metrics.update(aux["metrics"])
+                trainer.fold_train_outputs(aux, ep_averages, ep_metrics)
                 done += take
             if epoch % val_every != 0:
                 continue

@@ -1,4 +1,9 @@
-from .loss import binary_cross_entropy_with_logits, cross_entropy, dice_loss_binary
+from .loss import (
+    binary_cross_entropy_with_logits,
+    classification_outputs,
+    cross_entropy,
+    dice_loss_binary,
+)
 from .metrics import (
     AUCROCMetrics,
     COINNAverages,
@@ -18,4 +23,5 @@ __all__ = [
     "dice_loss_binary",
     "cross_entropy",
     "binary_cross_entropy_with_logits",
+    "classification_outputs",
 ]

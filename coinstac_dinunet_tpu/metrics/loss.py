@@ -44,6 +44,26 @@ def cross_entropy(logits, labels, mask=None):
     return jnp.sum(nll * m) / jnp.maximum(denom, 1.0)
 
 
+def classification_outputs(logits, labels, mask=None):
+    """Standard ``iteration`` return dict for a softmax classifier.
+
+    Includes ``prob`` — the positive-class probability — for binary heads so
+    probability-ranked metrics (:class:`..metrics.AUCROCMetrics`, ref
+    ``metrics/metrics.py:292-329``) receive calibrated scores instead of
+    argmax labels (AUC over hard 0/1 predictions collapses to accuracy).
+    """
+    import jax
+
+    it = {
+        "loss": cross_entropy(logits, labels, mask=mask),
+        "pred": jnp.argmax(logits, -1),
+        "true": labels,
+    }
+    if logits.shape[-1] == 2:
+        it["prob"] = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)[..., 1]
+    return it
+
+
 def binary_cross_entropy_with_logits(logits, labels, mask=None):
     import jax
 

@@ -18,7 +18,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..data import COINNDataset
-from ..metrics import cross_entropy
+from ..metrics import classification_outputs
 from ..trainer import COINNTrainer
 from ..utils import stable_file_id
 
@@ -98,10 +98,4 @@ class VBMTrainer(COINNTrainer):
         logits = self.nn["vbm_net"].apply(
             params["vbm_net"], batch["inputs"], train=rng is not None, rng=rng
         )
-        mask = batch.get("_mask")
-        loss = cross_entropy(logits, batch["labels"], mask=mask)
-        return {
-            "loss": loss,
-            "pred": jnp.argmax(logits, -1),
-            "true": batch["labels"],
-        }
+        return classification_outputs(logits, batch["labels"], mask=batch.get("_mask"))

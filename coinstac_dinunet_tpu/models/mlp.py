@@ -9,7 +9,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..data import COINNDataset
-from ..metrics import cross_entropy
+from ..metrics import classification_outputs
 from ..trainer import COINNTrainer
 from ..utils import stable_file_id
 
@@ -74,10 +74,4 @@ class FSVTrainer(COINNTrainer):
         logits = self.nn["fsv_net"].apply(
             params["fsv_net"], batch["inputs"], train=rng is not None, rng=rng
         )
-        mask = batch.get("_mask")
-        loss = cross_entropy(logits, batch["labels"], mask=mask)
-        return {
-            "loss": loss,
-            "pred": jnp.argmax(logits, -1),
-            "true": batch["labels"],
-        }
+        return classification_outputs(logits, batch["labels"], mask=batch.get("_mask"))

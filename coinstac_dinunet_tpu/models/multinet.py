@@ -7,7 +7,7 @@ the last model — ``nn/basetrainer.py:103-114``, SURVEY §2 defects).
 """
 import jax.numpy as jnp
 
-from ..metrics import cross_entropy
+from ..metrics import classification_outputs
 from ..trainer import COINNTrainer
 from .cnn3d import VBM3DNet
 
@@ -33,10 +33,4 @@ class MultiNetTrainer(COINNTrainer):
         logits_a = self.nn["net_a"].apply(params["net_a"], x)
         logits_b = self.nn["net_b"].apply(params["net_b"], x)
         logits = 0.5 * (logits_a + logits_b)
-        mask = batch.get("_mask")
-        loss = cross_entropy(logits, batch["labels"], mask=mask)
-        return {
-            "loss": loss,
-            "pred": jnp.argmax(logits, -1),
-            "true": batch["labels"],
-        }
+        return classification_outputs(logits, batch["labels"], mask=batch.get("_mask"))

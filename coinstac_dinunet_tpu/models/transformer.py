@@ -18,7 +18,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..data import COINNDataset
-from ..metrics import cross_entropy
+from ..metrics import classification_outputs
 from ..ops import flash_attention
 from ..trainer import COINNTrainer
 from ..utils import stable_file_id
@@ -146,9 +146,4 @@ class SeqTrainer(COINNTrainer):
 
     def iteration(self, params, batch, rng=None):
         logits = self.nn["seq_net"].apply(params["seq_net"], batch["inputs"])
-        loss = cross_entropy(logits, batch["labels"], mask=batch.get("_mask"))
-        return {
-            "loss": loss,
-            "pred": jnp.argmax(logits, -1),
-            "true": batch["labels"],
-        }
+        return classification_outputs(logits, batch["labels"], mask=batch.get("_mask"))

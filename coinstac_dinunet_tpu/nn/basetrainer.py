@@ -307,7 +307,17 @@ class NNTrainer:
             fn = self._compiled["train"] = jax.jit(_full, donate_argnums=donate)
         return fn(ts, stacked_batches)
 
-    def _grads_uncompiled(self, ts, stacked, metrics_shell, averages_shell):
+    def _grads_uncompiled(self, ts, stacked, metrics_shell, averages_shell,
+                          grad_reduce=None):
+        """``grad_reduce(g, batch) -> g``: optional per-micro-batch gradient
+        reduction applied INSIDE the scan — the hook data-parallel wrappers
+        use to mask-weight-average shard gradients over a device axis so a
+        padded batch split unevenly across devices still yields exactly the
+        full-batch masked-mean gradient (see ``parallel/mesh.py``)."""
+        # non-jit-safe metrics (AUC) can't accumulate on device — carry the
+        # per-microbatch scores out of the scan so the host can feed them
+        collect_host = not getattr(metrics_shell, "jit_safe", True)
+
         def loss_fn(params, batch, rng):
             it = self.iteration(params, batch, rng)
             return it["loss"], it
@@ -316,20 +326,33 @@ class NNTrainer:
             rng, gsum, msum, asum = carry
             rng, sub = jax.random.split(rng)
             (loss, it), g = jax.value_and_grad(loss_fn, has_aux=True)(ts.params, batch, sub)
+            if grad_reduce is not None:
+                g = grad_reduce(g, batch)
             m_state, a_state = self._step_outputs(it, batch, metrics_shell, averages_shell)
             gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
             if m_state is not None:
                 msum = jax.tree_util.tree_map(jnp.add, msum, m_state)
             asum = jax.tree_util.tree_map(jnp.add, asum, a_state)
-            return (rng, gsum, msum, asum), loss
+            ys = {"loss": loss}
+            if collect_host:
+                hs = self.host_scores_payload(it, batch)
+                if hs is not None:
+                    ys["host_scores"] = hs
+            return (rng, gsum, msum, asum), ys
 
         k = jax.tree_util.tree_leaves(stacked)[0].shape[0]
         gsum0 = jax.tree_util.tree_map(jnp.zeros_like, ts.params)
         m0 = self._zeros_f32(metrics_shell.empty_state())
         a0 = self._zeros_f32(averages_shell.empty_state())
-        (rng, gsum, msum, asum), losses = jax.lax.scan(body, (ts.rng, gsum0, m0, a0), stacked)
+        (rng, gsum, msum, asum), ys = jax.lax.scan(body, (ts.rng, gsum0, m0, a0), stacked)
         grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
-        return grads, {"rng": rng, "metrics": msum, "averages": asum, "loss": jnp.mean(losses)}
+        # a non-jit-safe metric's device state is meaningless — report None so
+        # callers fall through to the host_scores path
+        aux = {"rng": rng, "metrics": (None if collect_host else msum),
+               "averages": asum, "loss": jnp.mean(ys["loss"])}
+        if "host_scores" in ys:
+            aux["host_scores"] = ys["host_scores"]
+        return grads, aux
 
     def eval_step(self, ts, batch):
         fn = self._compiled.get("eval")
@@ -358,6 +381,36 @@ class NNTrainer:
         self.train_state, aux = self.train_step(self.train_state, stacked)
         return aux
 
+    @staticmethod
+    def host_scores_payload(it, batch):
+        """(score, true, mask) f32 payload for host-side (non-jit-safe)
+        metric accumulation, or None when the iteration lacks pred/true.
+        ``score`` prefers the calibrated ``prob`` over argmax labels."""
+        if "pred" not in it or "true" not in it:
+            return None
+        mask = batch.get("_mask")
+        true = jnp.asarray(it["true"], jnp.float32)
+        return {
+            "score": jnp.asarray(it.get("prob", it["pred"]), jnp.float32),
+            "true": true,
+            "mask": (jnp.asarray(mask, jnp.float32) if mask is not None
+                     else jnp.ones(true.shape, jnp.float32)),
+        }
+
+    @staticmethod
+    def fold_train_outputs(aux, ep_averages, ep_metrics):
+        """Fold one round's aux into the epoch accumulators — device states
+        for jit-safe metrics, the carried-out ``host_scores`` otherwise."""
+        ep_averages.update(aux["averages"])
+        if aux.get("metrics") is not None:
+            ep_metrics.update(aux["metrics"])
+        elif "host_scores" in aux:
+            hs = aux["host_scores"]
+            ep_metrics.add(
+                np.asarray(hs["score"]), np.asarray(hs["true"]),
+                mask=np.asarray(hs["mask"]),
+            )
+
     def evaluation(self, mode=Mode.VALIDATION, dataset_list=None, save_pred=False,
                    distributed=False):
         """No-grad loop over one or more datasets with mask-weighted metrics."""
@@ -379,9 +432,11 @@ class NNTrainer:
                 if m_state is not None:
                     ds_metrics.update(m_state)
                 elif not ds_metrics.jit_safe and "pred" in it and "true" in it:
-                    # variable-shape metrics (AUC) accumulate host-side
+                    # variable-shape metrics (AUC) accumulate host-side;
+                    # probability-ranked metrics read ``prob`` when the
+                    # iteration provides it (argmax labels collapse AUC)
                     ds_metrics.add(
-                        np.asarray(it["pred"]), np.asarray(it["true"]),
+                        np.asarray(it.get("prob", it["pred"])), np.asarray(it["true"]),
                         mask=np.asarray(batch.get("_mask")) if "_mask" in batch else None,
                     )
                 ds_averages.update(a_state)
@@ -442,9 +497,7 @@ class NNTrainer:
                 batch_buf.append(batch)
                 if len(batch_buf) == local_iterations:
                     aux = self.training_iteration_local(batch_buf)
-                    ep_averages.update(aux["averages"])
-                    if aux["metrics"] is not None:
-                        ep_metrics.update(aux["metrics"])
+                    self.fold_train_outputs(aux, ep_averages, ep_metrics)
                     batch_buf = []
                     if logger.lazy_debug(i):
                         logger.info(
@@ -453,9 +506,7 @@ class NNTrainer:
                         )
             if batch_buf:
                 aux = self.training_iteration_local(batch_buf)
-                ep_averages.update(aux["averages"])
-                if aux["metrics"] is not None:
-                    ep_metrics.update(aux["metrics"])
+                self.fold_train_outputs(aux, ep_averages, ep_metrics)
             cache["train_log"].append(ep_averages.get() + ep_metrics.get())
 
             if epoch % int(cache.get("validation_epochs", 1)) == 0 and len(val_dataset):

@@ -113,9 +113,7 @@ class COINNLearner:
         if averages is None:
             averages = self.cache["_ep_averages"] = self.trainer.new_averages()
             metrics = self.cache["_ep_metrics"] = self.trainer.new_metrics()
-        averages.update(aux["averages"])
-        if aux.get("metrics") is not None and metrics.jit_safe:
-            metrics.update(aux["metrics"])
+        self.trainer.fold_train_outputs(aux, averages, metrics)
 
     def train_serializable(self):
         """Pop the epoch accumulators as a wire payload (epoch barrier)."""

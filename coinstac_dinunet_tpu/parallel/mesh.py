@@ -94,7 +94,12 @@ class MeshFederation:
         self._hi_ix = None  # static: flat-leaf indices compressed by PowerSGD
         self._dad = None  # rankDAD capture plan (layer keys, leaf map, shapes)
         self._step = None
+        self._warmup_step = None  # plain-dSGD step used for PowerSGD warm-up
         self._eval = None
+        # completed update rounds — drives the PowerSGD dSGD warm-up window
+        # (≙ file transport's ``_PowerSGDState.iteration`` and ref
+        # ``powersgd/__init__.py:61-64``)
+        self.rounds_done = 0
 
     # -------------------------------------------------------------- batching
     def stack_site_batches(self, per_site_batches):
@@ -115,17 +120,19 @@ class MeshFederation:
         """Per-site error-feedback + warm-start Q for every ≥2-D leaf.
 
         Stored with a leading ``site`` axis; Qs start identical at every site
-        (seeded — ref ``powersgd/__init__.py:101-107``) and stay identical
-        because both wire rounds end in a mean."""
+        AND identical to the file transport's (same :func:`.powersgd.seeded_Q`
+        keyed by the leaf's position in the high-rank list), and stay
+        identical because both wire rounds end in a mean."""
+        from .powersgd import seeded_Q
+
         leaves = jax.tree_util.tree_leaves(self.trainer.train_state.params)
         self._hi_ix = tuple(i for i, l in enumerate(leaves) if l.ndim >= 2)
         errors, qs = [], []
-        for i in self._hi_ix:
+        for j, i in enumerate(self._hi_ix):
             leaf = leaves[i]
             m = (leaf.shape[0], int(np.prod(leaf.shape[1:])))
             errors.append(jnp.zeros((self.n_sites, *m), jnp.float32))
-            key = jax.random.PRNGKey(int(seed) * 1000 + i)
-            q = jax.random.normal(key, (m[1], rank), jnp.float32)
+            q = seeded_Q(seed, j, m[1], rank)
             qs.append(jnp.tile(q[None], (self.n_sites, 1, 1)))
         self.comm_state = {"errors": errors, "qs": qs}
         return self.comm_state
@@ -207,6 +214,13 @@ class MeshFederation:
             aux = {"loss": jax.lax.pmean(loss, "site"), "rng": ts.rng}
             if m_state is not None:
                 aux["metrics"] = jax.lax.psum(m_state, "site")
+            elif not getattr(metrics_shell, "jit_safe", True):
+                hs = trainer.host_scores_payload(it, batch)
+                if hs is not None:
+                    aux["host_scores"] = jax.tree_util.tree_map(
+                        lambda x: jax.lax.all_gather(x, "site", axis=0, tiled=True),
+                        hs,
+                    )
             aux["averages"] = jax.lax.psum(a_state, "site")
             return ts, aux
 
@@ -233,35 +247,53 @@ class MeshFederation:
         return step
 
     # ---------------------------------------------------------- compiled step
-    def _build_step(self):
+    def _build_step(self, engine=None):
         trainer = self.trainer
         metrics_shell, averages_shell = trainer._metrics_shell()
-        engine = self.agg_engine
+        engine = engine or self.agg_engine
         hi_ix = self._hi_ix
 
         def _powersgd_exchange(grads, comm):
-            """Both PowerSGD wire rounds as in-step collectives."""
+            """Both PowerSGD wire rounds as in-step collectives, built from
+            the SAME per-leaf kernels as the file transport
+            (:mod:`.powersgd` ``compress_P/compress_Q/reconstruct``)."""
+            from .powersgd import compress_P, compress_Q, reconstruct
+
             leaves, treedef = jax.tree_util.tree_flatten(grads)
             new_err, new_q, out = [], [], list(leaves)
             for j, i in enumerate(hi_ix):
                 leaf = leaves[i]
+                # grads are already device-reduced inside the scan
+                # (_device_grad_reduce), so only the site axis remains
                 m2 = leaf.reshape(leaf.shape[0], -1).astype(jnp.float32)
-                m2 = jax.lax.pmean(m2, "device")  # intra-site DP first
                 # comm leaves keep their (sharded, now size-1) site axis
                 M = m2 + comm["errors"][j][0]
-                p = jax.lax.pmean(M @ comm["qs"][j][0], "site")  # wire round 1
+                p = jax.lax.pmean(compress_P(M, comm["qs"][j][0]), "site")  # wire round 1
                 phat = orthogonalize(p)
-                qn = jax.lax.pmean(M.T @ phat, "site")  # wire round 2
-                recon = phat @ qn.T
+                qn = jax.lax.pmean(compress_Q(M, phat), "site")  # wire round 2
+                recon = reconstruct(phat, qn)
                 new_err.append((M - recon)[None])
                 new_q.append(qn[None])
                 out[i] = recon.reshape(leaf.shape).astype(leaf.dtype)
             lo = set(hi_ix)
             for i in range(len(out)):
                 if i not in lo:
-                    out[i] = jax.lax.pmean(leaves[i], ("site", "device"))
+                    out[i] = jax.lax.pmean(leaves[i], "site")
             grads = jax.tree_util.tree_unflatten(treedef, out)
             return grads, {"errors": new_err, "qs": new_q}
+
+        def _device_grad_reduce(g, batch):
+            """Mask-weighted mean over the device shards of one micro-batch —
+            reproduces the single-device full-batch masked-mean gradient
+            exactly even when the padded tail splits unevenly."""
+            mask = batch.get("_mask")
+            n = (jnp.sum(jnp.asarray(mask, jnp.float32)) if mask is not None
+                 else jnp.asarray(
+                     jax.tree_util.tree_leaves(batch)[0].shape[0], jnp.float32))
+            denom = jnp.maximum(jax.lax.psum(n, "device"), 1.0)
+            return jax.tree_util.tree_map(
+                lambda x: jax.lax.psum(x * n, "device") / denom, g
+            )
 
         def site_step(ts, stacked, comm):
             # drop the sharded (now size-1) site axis from the batch view
@@ -270,12 +302,14 @@ class MeshFederation:
             # per-site decorrelated randomness for the forward pass…
             ts = ts.replace(rng=jax.random.fold_in(orig_rng, jax.lax.axis_index("site")))
             grads, aux = trainer._grads_uncompiled(
-                ts, stacked, metrics_shell, averages_shell
+                ts, stacked, metrics_shell, averages_shell,
+                grad_reduce=_device_grad_reduce,
             )
             if engine == "powerSGD":
                 grads, comm = _powersgd_exchange(grads, comm)
             else:
-                grads = jax.lax.pmean(grads, ("site", "device"))
+                # device axis already reduced inside the scan
+                grads = jax.lax.pmean(grads, "site")
             ts = trainer._apply_updates(ts, grads)
             # …but the carried rng advances identically everywhere, keeping
             # the train state bitwise replicated across sites
@@ -283,6 +317,16 @@ class MeshFederation:
             aux = dict(aux)
             if aux.get("metrics") is not None:
                 aux["metrics"] = jax.lax.psum(aux["metrics"], ("site", "device"))
+            if "host_scores" in aux:
+                # per-site score streams (non-jit-safe metrics, e.g. AUC):
+                # gather along the micro-batch axis so the replicated output
+                # carries every site's samples for host accumulation
+                aux["host_scores"] = jax.tree_util.tree_map(
+                    lambda x: jax.lax.all_gather(
+                        x, ("site", "device"), axis=0, tiled=True
+                    ),
+                    aux["host_scores"],
+                )
             aux["averages"] = jax.lax.psum(aux["averages"], ("site", "device"))
             aux["loss"] = jax.lax.pmean(aux["loss"], ("site", "device"))
             aux["rng"] = ts.rng
@@ -315,7 +359,11 @@ class MeshFederation:
 
     def train_step(self, site_batches):
         """One federated round: per-site grad accumulation, cross-site
-        aggregation, synchronized update — a single compiled call."""
+        aggregation, synchronized update — a single compiled call.
+
+        PowerSGD honors ``start_powerSGD_iter``: the first N rounds run the
+        plain-dSGD step (error feedback untouched), matching the file
+        transport and ref ``powersgd/__init__.py:61-64,130-134``."""
         if self._step is None:
             if self.agg_engine == "powerSGD" and not self.comm_state:
                 self.init_powersgd_state(
@@ -336,15 +384,23 @@ class MeshFederation:
                 self._step = self._build_rankdad_step()
             else:
                 self._step = self._build_step()
+        step = self._step
+        if self.agg_engine == "powerSGD" and self.rounds_done < int(
+            self.trainer.cache.get("start_powerSGD_iter", 10)
+        ):
+            if self._warmup_step is None:
+                self._warmup_step = self._build_step(engine="dSGD")
+            step = self._warmup_step
         stacked = (
             self.stack_site_batches(site_batches)
             if isinstance(site_batches, (list, tuple))
             else site_batches
         )
-        ts, aux, self.comm_state = self._step(
+        ts, aux, self.comm_state = step(
             self.trainer.train_state, stacked, self.comm_state
         )
         self.trainer.train_state = ts
+        self.rounds_done += 1
         return aux
 
     # ------------------------------------------------------------- evaluation

@@ -46,20 +46,45 @@ def _as_matrix(g):
     return g.reshape(g.shape[0], -1)
 
 
+# ---- per-leaf compression kernels, shared by BOTH transports (the file/JSON
+# ---- protocol below and the mesh's in-step collectives, ``mesh.py``) ------
+def compress_P(M, Q):
+    """Wire round 1 payload: P = M @ Q (ref ``powersgd/__init__.py:93-128``)."""
+    return M @ Q
+
+
+def compress_Q(M, Phat):
+    """Wire round 2 payload: Q = Mᵀ @ P̂ (ref ``:147-177``)."""
+    return M.T @ Phat
+
+
+def reconstruct(Phat, Q):
+    """Rank-r gradient estimate P̂ Qᵀ; error feedback = M − estimate."""
+    return Phat @ Q.T
+
+
+def seeded_Q(seed, j, ncols, rank):
+    """Deterministic Q init for the j-th high-rank leaf — the SAME key on
+    every site and on both transports (≙ ref seeded randn,
+    ``powersgd/__init__.py:101-107``)."""
+    key = jax.random.PRNGKey(int(seed) * 1000 + int(j))
+    return jax.random.normal(key, (int(ncols), int(rank)), dtype=jnp.float32)
+
+
 @jax.jit
 def _compute_P(Ms, Qs):
-    return [M @ Q for M, Q in zip(Ms, Qs)]
+    return [compress_P(M, Q) for M, Q in zip(Ms, Qs)]
 
 
 @jax.jit
 def _compute_Q(Ms, Ps):
     Phats = [orthogonalize(P) for P in Ps]
-    return [M.T @ Ph for M, Ph in zip(Ms, Phats)], Phats
+    return [compress_Q(M, Ph) for M, Ph in zip(Ms, Phats)], Phats
 
 
 @jax.jit
 def _reconstruct(Ms, Phats, Qs):
-    recon = [Ph @ Q.T for Ph, Q in zip(Phats, Qs)]
+    recon = [reconstruct(Ph, Q) for Ph, Q in zip(Phats, Qs)]
     errors = [M - R for M, R in zip(Ms, recon)]
     return recon, errors
 
@@ -97,8 +122,7 @@ class PowerSGDLearner(COINNLearner):
     def _seeded_Q(self, i, shape):
         """Same seed at every site ⇒ identical Q init everywhere (the
         reference's seeded randn, ``powersgd/__init__.py:101-107``)."""
-        key = jax.random.PRNGKey(int(self.cache.get("seed", 0)) * 1000 + i)
-        return jax.random.normal(key, (shape[1], self.rank), dtype=jnp.float32)
+        return seeded_Q(self.cache.get("seed", 0), i, shape[1], self.rank)
 
     # ---------------------------------------------------------------- phases
     def to_reduce(self):

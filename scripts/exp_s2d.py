@@ -1,0 +1,92 @@
+"""Validate: space-to-depth stem vs plain cin=1 stem conv, numerics + speed."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def timeit(fn, *args, steps=20, warmup=3):
+    def fence(out):
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        return float(np.asarray(leaf).ravel()[0])
+    for _ in range(warmup):
+        out = fn(*args)
+    fence(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    fence(out)
+    return (time.perf_counter() - t0) / steps
+
+
+B, D, W_OUT = 128, 64, 16
+key = jax.random.PRNGKey(0)
+x = jax.random.normal(key, (B, D, D, D, 1), jnp.float32)
+w = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 3, 1, W_OUT), jnp.float32) * 0.1
+
+DN = lax.conv_dimension_numbers(x.shape, w.shape, ("NDHWC", "DHWIO", "NDHWC"))
+
+
+def plain(x, w):
+    return lax.conv_general_dilated(
+        jnp.asarray(x, jnp.bfloat16), jnp.asarray(w, jnp.bfloat16),
+        window_strides=(2, 2, 2), padding="SAME", dimension_numbers=DN,
+    )
+
+
+def s2d_kernel(w):
+    """(3,3,3,1,F) stride-2 kernel -> (2,2,2,8,F) kernel on block-2 s2d input.
+
+    Original tap t in {0,1,2} at input index 2o+t maps to block o + t//2,
+    in-block offset t%2.  New kernel position (bp, off) with bp=t//2, off=t%2.
+    """
+    k2 = jnp.zeros((2, 2, 2, 8, w.shape[-1]), w.dtype)
+    for td in range(3):
+        for th in range(3):
+            for tw in range(3):
+                bd, od = td // 2, td % 2
+                bh, oh = th // 2, th % 2
+                bw, ow = tw // 2, tw % 2
+                c = od * 4 + oh * 2 + ow
+                k2 = k2.at[bd, bh, bw, c, :].set(w[td, th, tw, 0, :])
+    return k2
+
+
+def s2d(x):
+    b, d, h, ww, _ = x.shape
+    x = x.reshape(b, d // 2, 2, h // 2, 2, ww // 2, 2, 1)
+    x = x.transpose(0, 1, 3, 5, 2, 4, 6, 7)
+    return x.reshape(b, d // 2, h // 2, ww // 2, 8)
+
+
+DN2 = lax.conv_dimension_numbers((B, D // 2, D // 2, D // 2, 8),
+                                 (2, 2, 2, 8, W_OUT), ("NDHWC", "DHWIO", "NDHWC"))
+
+
+def fused(x, w):
+    k2 = s2d_kernel(jnp.asarray(w, jnp.bfloat16))
+    return lax.conv_general_dilated(
+        s2d(jnp.asarray(x, jnp.bfloat16)), k2,
+        window_strides=(1, 1, 1), padding=((0, 1), (0, 1), (0, 1)),
+        dimension_numbers=DN2,
+    )
+
+
+f_plain = jax.jit(lambda x, w: jnp.sum(jnp.asarray(plain(x, w), jnp.float32)))
+f_fused = jax.jit(lambda x, w: jnp.sum(jnp.asarray(fused(x, w), jnp.float32)))
+
+a = jax.jit(plain)(x, w)
+b = jax.jit(fused)(x, w)
+print("shapes", a.shape, b.shape)
+diff = float(jnp.max(jnp.abs(jnp.asarray(a, jnp.float32) - jnp.asarray(b, jnp.float32))))
+print("max|plain-s2d| =", diff)
+
+t1 = timeit(f_plain, x, w)
+t2 = timeit(f_fused, x, w)
+print(f"plain stem: {t1*1e3:.2f} ms   s2d stem: {t2*1e3:.2f} ms   speedup {t1/t2:.1f}x")
+
+# also: what if input arrives already in bf16?
+xb = jnp.asarray(x, jnp.bfloat16)
+t3 = timeit(jax.jit(lambda x, w: jnp.sum(jnp.asarray(fused(x, w), jnp.float32))), xb, w)
+print(f"s2d stem (bf16 input): {t3*1e3:.2f} ms")

@@ -1,0 +1,121 @@
+"""Profile the flagship VBM 3-D CNN step: where does the time go?
+
+Every timed function reduces its output to a scalar inside jit and the timer
+materializes it with np.asarray — on the axon relay backend block_until_ready
+can ack before execution, so host materialization is the only honest fence.
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+def timeit(fn, *args, steps=20, warmup=3):
+    """fn must return something whose first leaf is small; we materialize it."""
+    def fence(out):
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        return float(np.asarray(leaf).ravel()[0])
+
+    for _ in range(warmup):
+        out = fn(*args)
+    fence(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    fence(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    from coinstac_dinunet_tpu.models import VBMTrainer
+
+    shape, batch, width = (64, 64, 64), 128, 16
+    cache = {
+        "input_shape": shape, "model_width": width, "num_classes": 2,
+        "batch_size": batch, "seed": 0, "learning_rate": 1e-3,
+        "compute_dtype": "bfloat16", "donate_buffers": False,
+    }
+    trainer = VBMTrainer(cache=cache, state={}, data_handle=None)
+    trainer.init_nn()
+    rng = np.random.default_rng(0)
+    batch_d = {
+        "inputs": jnp.asarray(rng.normal(size=(1, batch, *shape)).astype(np.float32)),
+        "labels": jnp.asarray(rng.integers(0, 2, size=(1, batch)).astype(np.int32)),
+        "_mask": jnp.ones((1, batch), jnp.float32),
+    }
+    flat = {k: v[0] for k, v in batch_d.items()}
+
+    ts = trainer.train_state
+    t_full = timeit(lambda: trainer.train_step(ts, batch_d)[1]["loss"])
+    print(f"train_step: {t_full*1e3:.2f} ms  -> {batch/t_full:.0f} samples/s")
+
+    params = ts.params
+    model = trainer.nn["vbm_net"]
+
+    fwd = jax.jit(lambda p, x: jnp.sum(model.apply(p, x)))
+    t_fwd = timeit(fwd, params["vbm_net"], flat["inputs"])
+    print(f"forward:    {t_fwd*1e3:.2f} ms")
+
+    def loss_fn(p):
+        it = trainer.iteration(p, flat, None)
+        return it["loss"]
+    vg = jax.jit(lambda p: jax.value_and_grad(loss_fn)(p)[0])
+    t_bwd = timeit(vg, params)
+    print(f"fwd+bwd:    {t_bwd*1e3:.2f} ms")
+
+    class Trunc(nn.Module):
+        width: int
+        stages: int
+        use_gn: bool = True
+        dtype: jnp.dtype = jnp.bfloat16
+
+        @nn.compact
+        def __call__(self, x):
+            if x.ndim == 4:
+                x = x[..., None]
+            x = jnp.asarray(x, self.dtype)
+            w = self.width
+            plan = [(w, 2), (w, 1), (2 * w, 2), (2 * w, 1),
+                    (4 * w, 2), (4 * w, 1), (8 * w, 2)]
+            for i, (f, s) in enumerate(plan[: self.stages]):
+                x = nn.Conv(f, (3, 3, 3), strides=(s,) * 3, padding="SAME",
+                            use_bias=False, dtype=self.dtype)(x)
+                if self.use_gn:
+                    x = nn.GroupNorm(num_groups=min(8, f), dtype=self.dtype)(x)
+                x = nn.relu(x)
+            return jnp.sum(jnp.asarray(x, jnp.float32))
+
+    x = flat["inputs"]
+    key = jax.random.PRNGKey(0)
+    prev = 0.0
+    for nstages in range(1, 8):
+        m = Trunc(width=width, stages=nstages)
+        p = jax.jit(m.init)(key, x[:1])
+        t = timeit(jax.jit(m.apply), p, x)
+        print(f"fwd stages<={nstages}: {t*1e3:.2f} ms (+{(t-prev)*1e3:.2f})")
+        prev = t
+
+    m = Trunc(width=width, stages=7, use_gn=False)
+    p = jax.jit(m.init)(key, x[:1])
+    t = timeit(jax.jit(m.apply), p, x)
+    print(f"fwd no-GN:  {t*1e3:.2f} ms")
+    g_nogn = jax.jit(lambda p: jax.value_and_grad(lambda q: m.apply(q, x))(p)[0])
+    t = timeit(g_nogn, p)
+    print(f"fwd+bwd no-GN: {t*1e3:.2f} ms")
+
+    flops_fwd = 0
+    d = np.array(shape)
+    cin = 1
+    for f, s in [(width, 2), (width, 1), (2*width, 2), (2*width, 1),
+                 (4*width, 2), (4*width, 1), (8*width, 2)]:
+        d = np.ceil(d / s).astype(int)
+        flops_fwd += 2 * 27 * cin * f * int(np.prod(d))
+        cin = f
+    print(f"fwd GFLOP/sample: {flops_fwd/1e9:.3f}; train ~3x = {3*flops_fwd/1e9:.3f}")
+    print(f"train_step achieved TFLOPS: {3*flops_fwd*batch/t_full/1e12:.1f}"
+          f" ({3*flops_fwd*batch/t_full/1e12/197*100:.0f}% MFU @197TF peak)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+"""AUC seam end-to-end: probabilities (not argmax labels) reach
+AUCROCMetrics through every path — local evaluation, the file-transport
+distributed validation → remote reduce, and MeshEngine's host fallback —
+and the resulting AUC is the exact global rank-sum AUC, distinct from
+accuracy (ref contract: ``metrics/metrics.py:292-329``).
+"""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from coinstac_dinunet_tpu.engine import InProcessEngine, MeshEngine
+from coinstac_dinunet_tpu.metrics import AUCROCMetrics, classification_outputs
+from coinstac_dinunet_tpu.trainer import COINNTrainer
+
+from test_trainer import XorDataset, _trainer
+
+BASE = dict(
+    task_id="xor", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+    batch_size=8, epochs=2, validation_epochs=1, learning_rate=5e-2,
+    input_shape=(2,), seed=11, patience=50,
+    monitor_metric="auc", num_classes=2,
+)
+
+
+class XorProbTrainer(COINNTrainer):
+    """Xor classifier whose ``iteration`` ships calibrated probabilities."""
+
+    def _init_nn_model(self):
+        import flax.linen as fnn
+
+        class MLP(fnn.Module):
+            @fnn.compact
+            def __call__(self, x):
+                x = fnn.relu(fnn.Dense(16)(x))
+                return fnn.Dense(2)(x)
+
+        self.nn["net"] = MLP()
+
+    def iteration(self, params, batch, rng=None):
+        logits = self.nn["net"].apply(params["net"], batch["inputs"])
+        return classification_outputs(logits, batch["labels"], mask=batch.get("_mask"))
+
+
+def _fill_sites(eng, per_site=24):
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(per_site):
+            with open(os.path.join(d, f"s_{i * per_site + j}"), "w") as f:
+                f.write("x")
+
+
+def _rank_sum_auc(probs, labels):
+    """Independent O(n²) Mann-Whitney AUC for ground truth."""
+    probs, labels = np.asarray(probs, np.float64), np.asarray(labels)
+    pos = probs[labels > 0.5]
+    neg = probs[labels <= 0.5]
+    wins = sum((p > n) + 0.5 * (p == n) for p in pos for n in neg)
+    return wins / (len(pos) * len(neg))
+
+
+def test_classification_outputs_prob_key():
+    logits = jnp.asarray([[2.0, -1.0], [0.0, 3.0]])
+    labels = jnp.asarray([0, 1])
+    it = classification_outputs(logits, labels)
+    probs = np.asarray(it["prob"])
+    expect = np.exp([-1.0 - 0.0, 3.0 - 3.0])  # softmax[:,1] sanity
+    np.testing.assert_allclose(
+        probs, [1 / (1 + np.e**3), 1 / (1 + np.e**-3)], atol=1e-6
+    )
+    # multi-class heads have no binary positive-class probability
+    it3 = classification_outputs(jnp.zeros((2, 3)), labels)
+    assert "prob" not in it3
+
+
+def test_auc_uses_probabilities_not_argmax():
+    """On a calibrated fixture the prob-fed AUC is exact and differs from the
+    AUC computed over hard argmax labels (the round-2 defect)."""
+    probs = np.asarray([0.1, 0.4, 0.35, 0.8, 0.65, 0.9])
+    labels = np.asarray([0, 0, 1, 1, 0, 1])
+    m = AUCROCMetrics()
+    m.add(probs, labels)
+    assert abs(m.auc - _rank_sum_auc(probs, labels)) < 1e-4  # .auc rounds to 5dp
+    m_hard = AUCROCMetrics()
+    m_hard.add((probs > 0.5).astype(np.float64), labels)
+    assert abs(m.auc - m_hard.auc) > 0.05
+
+
+def test_evaluation_feeds_prob_to_auc(tmp_path):
+    """Trainer.evaluation routes ``prob`` into the host-side AUC metric and
+    the result equals the exact rank-sum AUC of the model's probabilities."""
+    trainer = _trainer(tmp_path, n=96, monitor_metric="auc", num_classes=2)
+    # swap in a prob-emitting iteration (same params/model)
+    trainer.iteration = lambda params, batch, rng=None: classification_outputs(
+        trainer.nn["net"].apply(params["net"], batch["inputs"]),
+        batch["labels"], mask=batch.get("_mask"),
+    )
+    trainer._compiled = {}
+    ds = trainer.data_handle.get_validation_dataset()
+    averages, metrics = trainer.evaluation(dataset_list=[ds])
+    assert isinstance(metrics, AUCROCMetrics)
+
+    # independent recomputation of every sample's probability
+    probs, labels = [], []
+    for i in range(len(ds)):
+        item = ds[i]
+        logits = trainer.nn["net"].apply(
+            trainer.train_state.params["net"], item["inputs"][None]
+        )
+        p = np.exp(logits[0, 1]) / np.sum(np.exp(np.asarray(logits[0], np.float64)))
+        probs.append(float(p))
+        labels.append(int(item["labels"]))
+    expect = _rank_sum_auc(probs, labels)
+    assert abs(metrics.auc - expect) < 1e-4  # .auc rounds to 5dp
+    assert 0.0 < metrics.auc <= 1.0
+
+
+def test_auc_monitor_file_transport_lifecycle(tmp_path):
+    """monitor_metric='auc' drives the full federated lifecycle on the
+    file/JSON transport: distributed validation serializes (prob, label)
+    pairs and the remote reduce computes the exact global AUC."""
+    eng = InProcessEngine(
+        tmp_path, n_sites=4, trainer_cls=XorProbTrainer,
+        dataset_cls=XorDataset, **BASE,
+    )
+    _fill_sites(eng, per_site=16)
+    eng.run(max_rounds=900)
+    assert eng.success
+    vlog = np.asarray(eng.remote_cache["validation_log"], np.float64)
+    assert vlog.shape[0] >= 1
+    aucs = vlog[:, -1]
+    assert np.all(aucs > 0.0) and np.all(aucs <= 1.0)
+    # the global test reduction also ran on (prob, label) pairs
+    g = np.asarray(eng.remote_cache["global_test_metrics"], np.float64)
+    assert g.shape[0] == 1 and 0.0 < g[0, -1] <= 1.0
+
+
+def test_auc_monitor_mesh_engine_matches_file_transport(tmp_path):
+    """MeshEngine with a non-jit-safe monitor: host-side train metric
+    accumulation (gathered ``host_scores``) + ``_host_eval`` produce the
+    same score trajectory as the file transport."""
+    file_eng = InProcessEngine(
+        tmp_path / "file", n_sites=4, trainer_cls=XorProbTrainer,
+        dataset_cls=XorDataset, **BASE,
+    )
+    _fill_sites(file_eng, per_site=16)
+    file_eng.run(max_rounds=900)
+    assert file_eng.success
+
+    mesh_eng = MeshEngine(
+        tmp_path / "mesh", n_sites=4, trainer_cls=XorProbTrainer,
+        dataset_cls=XorDataset, **BASE,
+    )
+    _fill_sites(mesh_eng, per_site=16)
+    mesh_eng.run()
+    assert mesh_eng.success
+
+    for key in ("train_log", "validation_log", "test_metrics",
+                "global_test_metrics"):
+        a = np.asarray(file_eng.remote_cache[key], np.float64)
+        b = np.asarray(mesh_eng.cache[key], np.float64)
+        assert a.shape == b.shape, (key, a, b)
+        np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
+    # the train-log AUC column is populated (round-2: silently dropped)
+    t = np.asarray(mesh_eng.cache["train_log"], np.float64)
+    assert np.all(t[:, -1] > 0.0)
