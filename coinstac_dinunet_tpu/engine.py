@@ -32,13 +32,58 @@ from .utils.utils import performance_improved_, stop_training_
 from .vision import plotter
 
 
+def load_inputspec(path, site_index=None):
+    """Parse a COINSTAC simulator ``inputspec.json`` into plain args.
+
+    The simulator format (ref ``site_runner.py:13-15``) is a list of per-site
+    ``{key: {"value": ...}}`` dicts (or one such dict shared by all sites).
+    ``site_index=None`` returns the list of per-site arg dicts; an int
+    returns that site's args.
+    """
+    import json
+
+    if os.path.isdir(path):
+        path = os.path.join(path, "inputspec.json")
+    with open(path) as f:
+        spec = json.load(f)
+    if isinstance(spec, dict):
+        spec = [spec]
+
+    def unwrap(site_spec):
+        return {
+            k: (v["value"] if isinstance(v, dict) and "value" in v else v)
+            for k, v in site_spec.items()
+        }
+
+    sites = [unwrap(s) for s in spec]
+    if site_index is None:
+        return sites
+    site_index = int(site_index)
+    if not 0 <= site_index < len(sites):
+        raise IndexError(
+            f"site_index {site_index} out of range for {len(sites)}-site inputspec"
+        )
+    return sites[site_index]
+
+
 class InProcessEngine:
-    """Runs N site nodes + one aggregator, relaying outputs and files."""
+    """Runs N site nodes + one aggregator, relaying outputs and files.
+
+    ``inputspec`` (path to a COINSTAC simulator ``inputspec.json`` or its
+    directory) seeds per-site args exactly like the simulator would; explicit
+    ``**args`` / ``site_args`` win over the spec.
+    """
 
     def __init__(self, workdir, n_sites, trainer_cls=COINNTrainer,
                  dataset_cls=None, datahandle_cls=COINNDataHandle,
                  remote_trainer_cls=None, learner_cls=None, reducer_cls=None,
-                 site_args=None, **args):
+                 site_args=None, inputspec=None, **args):
+        # spec args sit BELOW explicit **args and site_args (lowest priority)
+        self.site_spec = {}
+        if inputspec is not None:
+            per_site = load_inputspec(inputspec)
+            for i in range(int(n_sites)):
+                self.site_spec[f"site_{i}"] = per_site[min(i, len(per_site) - 1)]
         self.workdir = str(workdir)
         self.n_sites = int(n_sites)
         self.trainer_cls = trainer_cls
@@ -94,7 +139,8 @@ class InProcessEngine:
                 cache=self.site_caches[s],
                 input=self.site_inputs[s],
                 state=self.site_states[s],
-                **{**self.args, **self.site_args.get(s, {})},
+                **{**self.site_spec.get(s, {}), **self.args,
+                   **self.site_args.get(s, {})},
             )
             result = node(
                 trainer_cls=self.trainer_cls,
@@ -230,10 +276,78 @@ class MeshEngine:
         rc = self.cache
         rc["num_folds"] = len(next(iter(self.site_caches.values()))["splits"])
         rc[Key.GLOBAL_TEST_SERIALIZABLE.value] = []
+        done_folds = {}
+        if rc.get("resume"):
+            done_folds = self._load_run_state().get("completed_folds", {})
         for fold in range(int(rc["num_folds"])):
+            if str(fold) in done_folds:
+                rc[Key.GLOBAL_TEST_SERIALIZABLE.value].append(done_folds[str(fold)])
+                continue
             self._run_fold(str(fold), handles)
         self._finish()
         return self
+
+    # ------------------------------------------------------- mid-run resume
+    def _run_state_path(self):
+        return os.path.join(self.workdir, ".mesh_resume.json")
+
+    def _load_run_state(self):
+        import json
+
+        try:
+            with open(self._run_state_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def _record_fold_done(self, split_ix, payload):
+        import json
+
+        run_state = self._load_run_state()
+        run_state.setdefault("completed_folds", {})[str(split_ix)] = payload
+        with open(self._run_state_path(), "w") as f:
+            json.dump(run_state, f)
+
+    def _epoch_autosave(self, trainer, fed, epoch):
+        """Full mesh resume point at the epoch barrier: params/opt/rng +
+        score logs + carried engine state (PowerSGD EF/Qs/warm-up counter).
+        Cadence/opt-out via ``cache['autosave_epochs']`` (0 disables)."""
+        rc = self.cache
+        every = int(rc.get("autosave_epochs", 1) or 0)
+        if every <= 0 or int(epoch) % every != 0:
+            return
+        extra = {
+            "epoch": int(epoch),
+            "train_log": rc[Key.TRAIN_LOG.value],
+            "validation_log": rc[Key.VALIDATION_LOG.value],
+            "best_val_epoch": rc.get("best_val_epoch", 0),
+            "best_val_score": rc.get("best_val_score"),
+            "fed": fed.serialize_comm_state(),
+        }
+        trainer.save_checkpoint(name=rc["latest_nn_state"], extra=extra)
+
+    def _try_fold_resume(self, trainer, fed):
+        """Restart the current fold from its latest epoch-barrier autosave.
+        Returns the completed-epoch counter to continue from (0 = fresh)."""
+        rc = self.cache
+        path = trainer.checkpoint_path(rc["latest_nn_state"])
+        if not (rc.get("resume") and os.path.exists(path)):
+            return 0
+        trainer.load_checkpoint(full_path=path)
+        extra = getattr(trainer, "last_checkpoint_extra", {})
+        rc[Key.TRAIN_LOG.value] = [list(r) for r in extra.get("train_log", [])]
+        rc[Key.VALIDATION_LOG.value] = [
+            list(r) for r in extra.get("validation_log", [])
+        ]
+        rc["best_val_epoch"] = int(extra.get("best_val_epoch", 0))
+        rc["best_val_score"] = extra.get("best_val_score")
+        fed.restore_comm_state(dict(extra.get("fed", {})))
+        epoch = int(extra.get("epoch", 0))
+        logger.info(
+            f"MeshEngine: resuming fold {rc['split_ix']} from epoch {epoch}",
+            rc.get("verbose", True),
+        )
+        return epoch
 
     def _run_fold(self, split_ix, handles):
         from .parallel.mesh import MeshFederation
@@ -247,7 +361,8 @@ class MeshEngine:
             self.remote_out_dir, str(rc["task_id"]), f"fold_{split_ix}"
         )
         os.makedirs(log_dir, exist_ok=True)
-        rc.update(log_dir=log_dir, epoch=0, best_val_epoch=0, best_val_score=None)
+        rc.update(log_dir=log_dir, split_ix=split_ix, epoch=0,
+                  best_val_epoch=0, best_val_score=None)
         rc[Key.TRAIN_LOG.value] = []
         rc[Key.VALIDATION_LOG.value] = []
         rc[Key.TEST_METRICS.value] = []
@@ -269,6 +384,10 @@ class MeshEngine:
 
         bs = int(rc.get("batch_size", 16))
         train_sets = {s: handles[s].get_train_dataset() for s in self.site_ids}
+        if not any(len(ds) for ds in train_sets.values()):
+            raise ValueError(
+                f"fold {split_ix}: every site's train dataset is empty"
+            )
         # lockstep epochs: every site pads to the global max batches/epoch
         # (≙ remote's target_batches broadcast)
         target_batches = max(
@@ -279,26 +398,39 @@ class MeshEngine:
         epochs = int(rc.get("epochs", 1))
         val_every = max(int(rc.get("validation_epochs", 1)), 1)
         ep_averages, ep_metrics = trainer.new_averages(), trainer.new_metrics()
-        epoch = 0
-        while True:
+        epoch = self._try_fold_resume(trainer, fed)
+        # the resume point may already satisfy the stop condition (crash
+        # after the last barrier but before the fold test finished)
+        fold_complete = epoch >= epochs or (epoch > 0 and stop_training_(epoch, rc))
+        while not fold_complete:
             epoch += 1
             rc["epoch"] = epoch
             # loader epoch is 0-based (matches the cursor transport's
-            # cache['epoch'] at first use)
+            # cache['epoch'] at first use); a site with no train data gets a
+            # fully-masked placeholder stream (mirrors _mesh_eval) so its
+            # rank participates in the lockstep step contributing nothing
             iters = [
-                iter(handles[s].get_loader(
+                (iter(handles[s].get_loader(
                     "train", dataset=train_sets[s], shuffle=True,
                     seed=int(rc.get("seed", 0)), epoch=epoch - 1,
                     target_batches=target_batches,
-                ))
+                )) if len(train_sets[s]) else None)
                 for s in self.site_ids
             ]
             done = 0
             while done < target_batches:
                 take = min(k, target_batches - done)
                 site_batches = [
-                    [next(it) for _ in range(take)] for it in iters
+                    ([next(it) for _ in range(take)] if it is not None else None)
+                    for it in iters
                 ]
+                template = next(b for b in site_batches if b is not None)
+                for i, b in enumerate(site_batches):
+                    if b is None:
+                        site_batches[i] = [
+                            {**tb, "_mask": np.zeros_like(np.asarray(tb["_mask"]))}
+                            for tb in template
+                        ]
                 aux = fed.train_step(site_batches)
                 trainer.fold_train_outputs(aux, ep_averages, ep_metrics)
                 done += take
@@ -319,6 +451,7 @@ class MeshEngine:
                     rc, log_dir,
                     plot_keys=[Key.TRAIN_LOG.value, Key.VALIDATION_LOG.value],
                 )
+            self._epoch_autosave(trainer, fed, epoch)
             if epoch >= epochs or stop_training_(epoch, rc):
                 break
 
@@ -327,9 +460,9 @@ class MeshEngine:
             trainer.load_checkpoint(name=rc["best_nn_state"])
         t_avg, t_met = self._mesh_eval(fed, handles, "test")
         rc[Key.TEST_METRICS.value].append([*t_avg.get(), *t_met.get()])
-        rc[Key.GLOBAL_TEST_SERIALIZABLE.value].append(
-            {"averages": t_avg.serialize(), "metrics": t_met.serialize()}
-        )
+        fold_payload = {"averages": t_avg.serialize(), "metrics": t_met.serialize()}
+        rc[Key.GLOBAL_TEST_SERIALIZABLE.value].append(fold_payload)
+        self._record_fold_done(split_ix, utils.clean_recursive(fold_payload))
         plotter.plot_progress(
             rc, log_dir, plot_keys=[Key.TRAIN_LOG.value, Key.VALIDATION_LOG.value]
         )
@@ -418,16 +551,35 @@ class MeshEngine:
         zip_name = f"{rc['task_id']}_{rc.get('agg_engine')}_{stamp}"
         shutil.make_archive(os.path.join(self.workdir, zip_name), "zip", task_dir)
         self.results_zip = f"{zip_name}.zip"
+        # the run completed: clear the resume record so a LATER run in the
+        # same workdir can never silently replay this run's fold results
+        try:
+            os.remove(self._run_state_path())
+        except OSError:
+            pass
         self.success = True
 
 
 class SiteRunner:
     """Single-site, no-engine debug harness (≙ ref ``SiteRunner``): drives a
     site through INIT_RUNS then NEXT_RUN with ``pretrain=True`` so the full
-    local training loop runs without any aggregator."""
+    local training loop runs without any aggregator.
 
-    def __init__(self, workdir, task_id="task", site_id="local0", **args):
+    Drop-in compatibility with COINSTAC computation specs (ref
+    ``site_runner.py:8-26``): pass ``inputspec`` (an ``inputspec.json`` path
+    or the simulator data dir holding one) + ``site_index`` and the spec's
+    ``{key: {"value": ...}}`` entries become the run's args; the directory
+    layout matches the simulator's ``input/local{i}/simulatorRun``.
+    """
+
+    def __init__(self, workdir, task_id="task", site_id=None, inputspec=None,
+                 site_index=0, **args):
         self.workdir = str(workdir)
+        if site_id is None:
+            site_id = f"local{int(site_index)}"
+        if inputspec is not None:
+            spec_args = load_inputspec(inputspec, site_index=site_index)
+            args = {**spec_args, **args}  # explicit kwargs win
         base = os.path.join(self.workdir, "input", site_id, "simulatorRun")
         outd = os.path.join(self.workdir, "output", site_id)
         xfer = os.path.join(self.workdir, "transfer", site_id)

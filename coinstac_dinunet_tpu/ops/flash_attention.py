@@ -173,6 +173,13 @@ def _flash_pallas(q, k, v, aux, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, _LANE), jnp.float32),  # running max (lanes equal)
             pltpu.VMEM((block_q, _LANE), jnp.float32),  # running sum (lanes equal)
         ],
+        # the kv axis (j) MUST run sequentially: scratch carries the
+        # online-softmax state across j and the output is written only at
+        # j == nk-1.  TPU grids default to sequential execution, but pin it
+        # so the compiler can never parallelize the carried axis.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(aux.reshape(1, 3), qp, kp, vp)
     return out[:, :tq], lse[:, :tq, 0]

@@ -29,6 +29,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import orthogonalize
 
 
+from .powersgd import _aslist  # msgpack list/dict normalization (shared)
+
+
 def build_site_mesh(n_sites, devices=None, devices_per_site=None):
     """Mesh of shape (site, device) over the available devices.
 
@@ -137,6 +140,32 @@ class MeshFederation:
         self.comm_state = {"errors": errors, "qs": qs}
         return self.comm_state
 
+    def serialize_comm_state(self):
+        """Host-side snapshot of the carried engine state (PowerSGD EF
+        memory + warm-started Qs, with their leading site axis) + the
+        warm-up round counter — what a mesh-run resume point must carry."""
+        comm = jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x)), self.comm_state
+        )
+        return {"comm": comm, "rounds_done": int(self.rounds_done)}
+
+    def restore_comm_state(self, payload):
+        """Rebuild carried engine state from :meth:`serialize_comm_state`."""
+        self.rounds_done = int(payload.get("rounds_done", 0))
+        comm = payload.get("comm") or {}
+        if self.agg_engine == "powerSGD" and comm:
+            # establish _hi_ix (and default shapes) first, then overwrite
+            self.init_powersgd_state(
+                rank=int(self.trainer.cache.get("matrix_approximation_rank", 1)),
+                seed=int(self.trainer.cache.get("seed", 0)),
+            )
+            self.comm_state = {
+                "errors": [jnp.asarray(np.asarray(e), jnp.float32)
+                           for e in _aslist(comm.get("errors"))],
+                "qs": [jnp.asarray(np.asarray(q), jnp.float32)
+                       for q in _aslist(comm.get("qs"))],
+            }
+
     # ------------------------------------------------------- rankDAD plumbing
     def init_rankdad_plan(self, site_batch):
         """Shape-only capture discovery from one site-local batch (shared
@@ -173,7 +202,6 @@ class MeshFederation:
         shapes = dad["shapes"]
         rank = int(trainer.cache.get("dad_reduction_rank", 10))
         iters = int(trainer.cache.get("dad_num_pow_iters", 5))
-        n_sites = self.n_sites
         _loss = make_dad_loss(trainer.iteration)
 
         def site_step(ts, stacked):
@@ -191,12 +219,18 @@ class MeshFederation:
             Brs, Crs = compress_layer_factors(
                 pgrads, acts, layer_keys, leaf_map, key, rank, iters
             )
+            # participation weight: a site whose batch is fully masked
+            # contributes nothing and is excluded from the denominator
+            mask = batch.get("_mask")
+            w = ((jnp.sum(jnp.asarray(mask, jnp.float32)) > 0).astype(jnp.float32)
+                 if mask is not None else jnp.float32(1))
+            wsum = jnp.maximum(jax.lax.psum(w, "site"), 1.0)
             leaves, treedef = jax.tree_util.tree_flatten(vgrads)
             flat = list(leaves)
             for lk in layer_keys:
-                B_all = jax.lax.all_gather(Brs[lk], "site", axis=0, tiled=True)
+                B_all = jax.lax.all_gather(Brs[lk] * w, "site", axis=0, tiled=True)
                 C_all = jax.lax.all_gather(Crs[lk], "site", axis=0, tiled=True)
-                G = (C_all.T @ B_all) / n_sites  # (din[+1], dout)
+                G = (C_all.T @ B_all) / wsum  # (din[+1], dout)
                 kern_ix, bias_ix = leaf_map[lk]
                 if bias_ix is not None:
                     flat[kern_ix] = G[:-1].astype(leaves[kern_ix].dtype)
@@ -204,7 +238,7 @@ class MeshFederation:
                 else:
                     flat[kern_ix] = G.astype(leaves[kern_ix].dtype)
             for i in rest_ix:
-                flat[i] = jax.lax.pmean(leaves[i], "site")
+                flat[i] = jax.lax.psum(leaves[i] * w, "site") / wsum
             grads = jax.tree_util.tree_unflatten(treedef, flat)
             ts = trainer._apply_updates(ts, grads)
             ts = ts.replace(rng=rng_next)
@@ -253,7 +287,14 @@ class MeshFederation:
         engine = engine or self.agg_engine
         hi_ix = self._hi_ix
 
-        def _powersgd_exchange(grads, comm):
+        def _site_mean(x, w, wsum):
+            """Participation-weighted mean over the site axis: a site whose
+            round carried no unmasked samples contributes nothing AND is
+            excluded from the denominator (file-transport parity — a site
+            that never ships grads is absent from the reducer's average)."""
+            return jax.lax.psum(x * w, "site") / wsum
+
+        def _powersgd_exchange(grads, comm, w, wsum):
             """Both PowerSGD wire rounds as in-step collectives, built from
             the SAME per-leaf kernels as the file transport
             (:mod:`.powersgd` ``compress_P/compress_Q/reconstruct``)."""
@@ -268,9 +309,9 @@ class MeshFederation:
                 m2 = leaf.reshape(leaf.shape[0], -1).astype(jnp.float32)
                 # comm leaves keep their (sharded, now size-1) site axis
                 M = m2 + comm["errors"][j][0]
-                p = jax.lax.pmean(compress_P(M, comm["qs"][j][0]), "site")  # wire round 1
+                p = _site_mean(compress_P(M, comm["qs"][j][0]), w, wsum)  # wire round 1
                 phat = orthogonalize(p)
-                qn = jax.lax.pmean(compress_Q(M, phat), "site")  # wire round 2
+                qn = _site_mean(compress_Q(M, phat), w, wsum)  # wire round 2
                 recon = reconstruct(phat, qn)
                 new_err.append((M - recon)[None])
                 new_q.append(qn[None])
@@ -278,7 +319,7 @@ class MeshFederation:
             lo = set(hi_ix)
             for i in range(len(out)):
                 if i not in lo:
-                    out[i] = jax.lax.pmean(leaves[i], "site")
+                    out[i] = _site_mean(leaves[i], w, wsum)
             grads = jax.tree_util.tree_unflatten(treedef, out)
             return grads, {"errors": new_err, "qs": new_q}
 
@@ -305,11 +346,24 @@ class MeshFederation:
                 ts, stacked, metrics_shell, averages_shell,
                 grad_reduce=_device_grad_reduce,
             )
+            # site participation weight: 1 iff this site's round carried any
+            # unmasked sample (over every micro-batch and device shard)
+            mask = stacked.get("_mask")
+            if mask is not None:
+                n_site = jax.lax.psum(
+                    jnp.sum(jnp.asarray(mask, jnp.float32)), "device"
+                )
+                w = (n_site > 0).astype(jnp.float32)
+            else:
+                w = jnp.float32(1)
+            wsum = jnp.maximum(jax.lax.psum(w, "site"), 1.0)
             if engine == "powerSGD":
-                grads, comm = _powersgd_exchange(grads, comm)
+                grads, comm = _powersgd_exchange(grads, comm, w, wsum)
             else:
                 # device axis already reduced inside the scan
-                grads = jax.lax.pmean(grads, "site")
+                grads = jax.tree_util.tree_map(
+                    lambda g: _site_mean(g, w, wsum), grads
+                )
             ts = trainer._apply_updates(ts, grads)
             # …but the carried rng advances identically everywhere, keeping
             # the train state bitwise replicated across sites

@@ -151,6 +151,40 @@ def test_mesh_engine_powersgd_matches_file_transport(tmp_path):
         np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
 
 
+def test_mesh_engine_zero_sample_site(tmp_path):
+    """A site with NO data participates in the lockstep mesh step via
+    fully-masked placeholder batches (train mirrors _mesh_eval), is excluded
+    from the gradient average's denominator, and the whole run's scores
+    EQUAL a run without the empty site at all."""
+    def _fill(eng, n_populated):
+        for i, s in enumerate(eng.site_ids):
+            d = eng.site_data_dir(s)
+            if i >= n_populated:
+                continue
+            for j in range(16):
+                with open(os.path.join(d, f"s_{i * 16 + j}"), "w") as f:
+                    f.write("x")
+
+    eng = MeshEngine(tmp_path / "with_empty", n_sites=4,
+                     trainer_cls=XorTrainer, dataset_cls=XorDataset, **BASE)
+    _fill(eng, n_populated=3)  # site_3 has no files at all
+    eng.run()
+    assert eng.success
+
+    ref = MeshEngine(tmp_path / "ref", n_sites=3, trainer_cls=XorTrainer,
+                     dataset_cls=XorDataset, **BASE)
+    _fill(ref, n_populated=3)
+    ref.run()
+    assert ref.success
+
+    for key in ("train_log", "validation_log", "test_metrics",
+                "global_test_metrics"):
+        a = np.asarray(ref.cache[key], np.float64)
+        b = np.asarray(eng.cache[key], np.float64)
+        assert a.shape == b.shape, (key, a, b)
+        np.testing.assert_allclose(a, b, atol=1e-6, err_msg=key)
+
+
 def test_mesh_federation_rejects_unknown_engine():
     from coinstac_dinunet_tpu.parallel.mesh import MeshFederation
 

@@ -140,6 +140,59 @@ def test_site_runner_local_training(tmp_path):
     assert os.listdir(runner.state["transferDirectory"])
 
 
+def test_site_runner_from_inputspec(tmp_path):
+    """Drop-in COINSTAC computation-spec bootstrap (ref ``site_runner.py:
+    13-15``): a simulator-format inputspec.json drives the whole run."""
+    spec = [
+        {
+            "data_dir": {"value": "data"},
+            "split_ratio": {"value": [0.7, 0.3]},
+            "batch_size": {"value": 8},
+            "epochs": {"value": 3},
+            "learning_rate": {"value": 5e-2},
+            "input_shape": {"value": [2]},
+            "seed": {"value": 3},
+            "pretrain_args": {"value": {"epochs": 3}},
+        }
+    ]
+    with open(os.path.join(tmp_path, "inputspec.json"), "w") as f:
+        json.dump(spec, f)
+    runner = SiteRunner(
+        tmp_path, task_id="xor", inputspec=str(tmp_path), site_index=0,
+    )
+    assert runner.state["clientId"] == "local0"
+    assert runner.args["batch_size"] == 8 and runner.args["epochs"] == 3
+    for i in range(24):
+        with open(os.path.join(runner.data_dir, f"s_{i}"), "w") as f:
+            f.write("x")
+    runner.run(XorTrainer, dataset_cls=XorDataset)
+    assert len(runner.cache["train_log"]) >= 1
+
+
+def test_engine_from_inputspec(tmp_path):
+    """InProcessEngine seeds per-site args from a multi-site inputspec."""
+    spec = [
+        {"batch_size": {"value": 8}, "epochs": {"value": 2}},
+        {"batch_size": {"value": 8}, "epochs": {"value": 2}},
+    ]
+    with open(os.path.join(tmp_path, "inputspec.json"), "w") as f:
+        json.dump(spec, f)
+    eng = InProcessEngine(
+        tmp_path, n_sites=2, trainer_cls=XorTrainer, dataset_cls=XorDataset,
+        inputspec=str(tmp_path), task_id="xor", data_dir="data",
+        split_ratio=[0.7, 0.15, 0.15], learning_rate=5e-2, input_shape=(2,),
+        seed=3, validation_epochs=1, patience=20,
+    )
+    assert eng.site_spec["site_0"]["batch_size"] == 8
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(16):
+            with open(os.path.join(d, f"s_{i * 16 + j}"), "w") as f:
+                f.write("x")
+    eng.run(max_rounds=500)
+    assert eng.success
+
+
 def test_remote_reduces_counts_exactly(tmp_path):
     """Cross-site metric reduction merges raw counts (not score means)."""
     eng = _make_engine(tmp_path, n_sites=2, epochs=1)
