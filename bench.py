@@ -1,84 +1,278 @@
-"""Benchmark: VBM 3-D CNN federated training throughput (BASELINE.md).
+"""Benchmark suite: all five BASELINE.md configs + federated-round scaling.
 
-Measures samples/sec/chip for the flagship config — VBM 3-D CNN with dSGD
-federated aggregation.  On a multi-device platform the whole federated round
-runs as one compiled mesh step (sites = mesh ranks, gradient mean = psum over
-ICI); on one chip it is the single-site compiled train step.
+Headline metric (the ONE JSON line's ``value``): samples/sec/chip of the
+flagship config — VBM 3-D CNN federated training (BASELINE.md config 3).
+On a multi-device platform the whole federated round runs as one compiled
+mesh step (sites = mesh ranks, gradient mean = psum over ICI); on one chip
+it is the single-site compiled train step.
+
+Also reported inside the same JSON line:
+
+- ``configs``: per-config samples/sec/chip + achieved TFLOPS + MFU for the
+  five BASELINE.md configs (1 FSV-MLP local, 2 FSV-MLP dSGD, 3 VBM 3-D CNN,
+  4 ResNet-18, 5 multi-network 2×VBM).  Single-chip hardware measures each
+  config's per-chip step; the federated dimension is measured separately:
+- ``round_wallclock_s``: wall-clock seconds per federated dSGD round at
+  2/4/8/16/32 sites on a virtual CPU mesh (subprocess per site count —
+  BASELINE.json's "federated-round wall-clock 2→32 sites" metric; the real
+  chip count here is 1, so scaling runs on the virtual platform).
+- ``mfu``: flagship model-FLOPs utilization against the chip's peak.
 
 ``vs_baseline``: the reference publishes no numbers (SURVEY §6), so the
-recorded ratio is against a torch-CPU implementation of the same model and
-step measured on this host — the reference's own compute path when no GPU is
-present (its north-star scenario).  Prints ONE JSON line.
+ratio is against a torch-CPU implementation of the same flagship model and
+step on this host — the reference's own compute path when no GPU is present.
 """
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+_REPO = os.path.dirname(os.path.abspath(__file__))
 
-def _bench_ours(shape, batch, width, steps=20, warmup=3):
+# bf16 peak FLOPS per chip by device kind (dense, no sparsity)
+_PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+}
+
+
+def _peak_flops():
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for k, v in _PEAK_TFLOPS.items():
+        if kind.startswith(k):
+            return v * 1e12
+    return None
+
+
+def _fence(x):
+    return float(np.asarray(x).ravel()[0])
+
+
+def _step_flops(fn, *args):
+    """Model FLOPs of one compiled step from XLA's cost analysis."""
+    import jax
+
+    try:
+        cost = jax.jit(fn).lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost["flops"])
+    except Exception:
+        return None
+
+
+def _bench_single_step(trainer, batch, steps, warmup):
+    """samples/sec/chip + (flops/step|None) for one single-chip train step.
+
+    NOTE: timing boundaries force a host materialization of the loss — on
+    relayed/tunneled device backends block_until_ready can ack before the
+    step chain has actually executed.
+    """
+    stacked = trainer._stack_batches([batch])
+    ts = trainer.train_state
+    for _ in range(warmup):
+        ts, aux = trainer.train_step(ts, stacked)
+    _fence(aux["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        ts, aux = trainer.train_step(ts, stacked)
+    _fence(aux["loss"])
+    dt = time.perf_counter() - t0
+    trainer.train_state = ts
+    # model FLOPs of the fwd+bwd (the optimizer's elementwise work is noise)
+    flops = _step_flops(
+        lambda ts, st: trainer._grads_uncompiled(
+            ts, st, *trainer._metrics_shell()
+        )[0],
+        ts, stacked,
+    )
+    batch_n = np.asarray(batch["labels"]).shape[0]
+    return steps * batch_n / dt, flops
+
+
+def _mk_trainer(trainer_cls, cache):
+    trainer = trainer_cls(cache=dict(cache), state={}, data_handle=None)
+    trainer.init_nn()
+    return trainer
+
+
+def _synth_batch(rng, shape, batch, channels=None):
+    size = (batch, *shape) if channels is None else (batch, *shape, channels)
+    return {
+        "inputs": rng.normal(size=size).astype(np.float32),
+        "labels": rng.integers(0, 2, size=batch).astype(np.int32),
+        "_mask": np.ones(batch, np.float32),
+    }
+
+
+def _config_matrix(fast):
+    """The five BASELINE.md configs as (name, trainer_cls, cache, batch_fn)."""
+    from coinstac_dinunet_tpu.models import (
+        FSVTrainer, MultiNetTrainer, ResNetTrainer, VBMTrainer,
+    )
+
+    rng = np.random.default_rng(0)
+    vbm_shape = (24, 24, 24) if fast else (64, 64, 64)
+    vbm_batch = 4 if fast else 128
+    img_shape = (32, 32) if fast else (64, 64)
+    img_batch = 8 if fast else 256
+    mlp_batch = 64 if fast else 1024
+    # per-chip numbers must be measured on ONE chip: disable the trainer's
+    # automatic local data-parallel fan-out
+    base = {"num_classes": 2, "seed": 0, "learning_rate": 1e-3,
+            "local_data_parallel": False}
+    return [
+        # 1. FSV MLP, 1 site, local (PR1 ref config)
+        ("fsv_mlp_local", FSVTrainer,
+         {**base, "input_size": 66, "batch_size": mlp_batch,
+          "compute_dtype": "float32"},
+         lambda: _synth_batch(rng, (66,), mlp_batch)),
+        # 2. FSV MLP, 4 sites, dSGD — same per-chip step; the federated
+        #    dimension is covered by round_wallclock_s
+        ("fsv_mlp_4site_dsgd", FSVTrainer,
+         {**base, "input_size": 66, "batch_size": mlp_batch,
+          "compute_dtype": "float32"},
+         lambda: _synth_batch(rng, (66,), mlp_batch)),
+        # 3. VBM 3-D CNN, 8 sites, k-fold CV (flagship)
+        ("vbm3d_cnn_8site", VBMTrainer,
+         {**base, "input_shape": vbm_shape, "model_width": 8 if fast else 16,
+          "batch_size": vbm_batch, "compute_dtype": "bfloat16"},
+         lambda: _synth_batch(rng, vbm_shape, vbm_batch)),
+        # 4. ResNet-18 image classification, 16 sites
+        ("resnet18_16site", ResNetTrainer,
+         {**base, "input_shape": (*img_shape, 3), "model_width": 16 if fast else 64,
+          "batch_size": img_batch, "compute_dtype": "bfloat16"},
+         lambda: _synth_batch(rng, img_shape, img_batch, channels=3)),
+        # 5. multi-network (2× VBM CNN), 32 sites, custom reducer
+        ("multinet_2x_32site", MultiNetTrainer,
+         {**base, "input_shape": tuple(s // 2 for s in vbm_shape),
+          "model_width": 8 if fast else 16, "batch_size": vbm_batch,
+          "compute_dtype": "bfloat16"},
+         lambda: _synth_batch(rng, tuple(s // 2 for s in vbm_shape), vbm_batch)),
+    ]
+
+
+def _bench_configs(fast, peak):
+    steps = 3 if fast else 30
+    warmup = 1 if fast else 3
+    out = {}
+    for name, cls, cache, batch_fn in _config_matrix(fast):
+        trainer = _mk_trainer(cls, cache)
+        sps, flops = _bench_single_step(trainer, batch_fn(), steps, warmup)
+        batch_n = int(cache["batch_size"])
+        entry = {"samples_per_sec_per_chip": round(sps, 2)}
+        if flops:
+            tf = sps / batch_n * flops / 1e12
+            entry["achieved_tflops"] = round(tf, 4)
+            if peak:
+                entry["mfu"] = round(tf * 1e12 / peak, 4)
+        out[name] = entry
+    return out
+
+
+def _bench_flagship_mesh(shape, batch, width, steps, warmup):
+    """The headline number on >1 device: one compiled federated VBM round
+    over the (site, device) mesh.  samples/sec/chip."""
     import jax
 
     from coinstac_dinunet_tpu.models import VBMTrainer
     from coinstac_dinunet_tpu.parallel.mesh import MeshFederation
 
-    devices = jax.devices()
-    n_dev = len(devices)
+    n_dev = len(jax.devices())
     cache = {
         "input_shape": shape, "model_width": width, "num_classes": 2,
         "batch_size": batch, "seed": 0, "learning_rate": 1e-3,
         "compute_dtype": "bfloat16",
     }
-    trainer = VBMTrainer(cache=cache, state={}, data_handle=None)
-    trainer.init_nn()
-
+    trainer = _mk_trainer(VBMTrainer, cache)
     rng = np.random.default_rng(0)
+    n_sites = min(8, n_dev)
+    fed = MeshFederation(trainer, n_sites=n_sites)
+    per_site = [[_synth_batch(rng, shape, batch)] for _ in range(n_sites)]
+    stacked = fed.stack_site_batches(per_site)
+    for _ in range(warmup):
+        aux = fed.train_step(stacked)
+    _fence(aux["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        aux = fed.train_step(stacked)
+    _fence(aux["loss"])
+    dt = time.perf_counter() - t0
+    chips = n_sites * fed.mesh.devices.shape[1]
+    return steps * batch * n_sites / dt / chips
 
-    def make_batch():
-        return {
-            "inputs": rng.normal(size=(batch, *shape)).astype(np.float32),
-            "labels": rng.integers(0, 2, size=batch).astype(np.int32),
-            "_mask": np.ones(batch, np.float32),
-        }
 
-    # NOTE: timing boundaries force a host materialization of the loss
-    # (np.asarray) — on relayed/tunneled device backends block_until_ready
-    # can ack before the step chain has actually executed.
-    if n_dev >= 2:
-        n_sites = min(8, n_dev)
-        fed = MeshFederation(trainer, n_sites=n_sites)
-        per_site = [[make_batch()] for _ in range(n_sites)]
-        stacked = fed.stack_site_batches(per_site)
-        for _ in range(warmup):
-            aux = fed.train_step(stacked)
-        float(np.asarray(aux["loss"]))
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            aux = fed.train_step(stacked)
-        float(np.asarray(aux["loss"]))
-        dt = time.perf_counter() - t0
-        chips = n_sites * fed.mesh.devices.shape[1]
-        total = steps * batch * n_sites
-    else:
-        stacked = trainer._stack_batches([make_batch()])
-        ts = trainer.train_state
-        for _ in range(warmup):
-            ts, aux = trainer.train_step(ts, stacked)
-        float(np.asarray(aux["loss"]))
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            ts, aux = trainer.train_step(ts, stacked)
-        float(np.asarray(aux["loss"]))
-        dt = time.perf_counter() - t0
-        chips = 1
-        total = steps * batch
-    return total / dt / chips, n_dev
+def _bench_round_scaling(fast):
+    """Federated dSGD round wall-clock at 2..32 sites on a virtual CPU mesh
+    (one subprocess per site count so the device count can be pinned)."""
+    site_counts = (2, 4, 8) if fast else (2, 4, 8, 16, 32)
+    code = r"""
+import json, os, sys, time
+import numpy as np
+n = int(sys.argv[1])
+import jax
+jax.config.update("jax_platforms", "cpu")
+from coinstac_dinunet_tpu.models import FSVTrainer
+from coinstac_dinunet_tpu.parallel.mesh import MeshFederation
+cache = {"input_size": 66, "batch_size": 32, "num_classes": 2, "seed": 0,
+         "learning_rate": 1e-3, "compute_dtype": "float32",
+         "local_data_parallel": False}
+t = FSVTrainer(cache=cache, state={}, data_handle=None)
+t.init_nn()
+fed = MeshFederation(t, n_sites=n, devices_per_site=1)
+rng = np.random.default_rng(0)
+per_site = [[{"inputs": rng.normal(size=(32, 66)).astype(np.float32),
+              "labels": rng.integers(0, 2, size=32).astype(np.int32),
+              "_mask": np.ones(32, np.float32)}] for _ in range(n)]
+stacked = fed.stack_site_batches(per_site)
+for _ in range(3):
+    aux = fed.train_step(stacked)
+float(np.asarray(aux["loss"]))
+steps = 20
+t0 = time.perf_counter()
+for _ in range(steps):
+    aux = fed.train_step(stacked)
+float(np.asarray(aux["loss"]))
+print(json.dumps({"round_s": (time.perf_counter() - t0) / steps}))
+"""
+    out = {}
+    for n in site_counts:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        res = None
+        try:
+            res = subprocess.run(
+                [sys.executable, "-c", code, str(n)], env=env, cwd=_REPO,
+                capture_output=True, text=True, timeout=600,
+            )
+            line = res.stdout.strip().splitlines()[-1]
+            out[str(n)] = round(json.loads(line)["round_s"], 5)
+        except Exception as exc:
+            err = (res.stderr.strip()[-300:] if res is not None and res.stderr
+                   else str(exc))
+            print(f"# round-scaling n={n} failed: {err}", file=sys.stderr)
+            out[str(n)] = None
+    return out
 
 
 def _bench_torch_cpu(shape, batch, width, steps=3):
-    """The same model/step in torch on CPU — the reference framework's
-    compute path on a GPU-less host."""
+    """The same flagship model/step in torch on CPU — the reference
+    framework's compute path on a GPU-less host."""
     try:
         import torch
         import torch.nn as tnn
@@ -104,7 +298,6 @@ def _bench_torch_cpu(shape, batch, width, steps=3):
     loss_fn = tnn.CrossEntropyLoss()
     x = torch.randn(batch, 1, *shape)
     y = torch.randint(0, 2, (batch,))
-    # one warmup step
     opt.zero_grad(); loss_fn(model(x), y).backward(); opt.step()
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -118,15 +311,28 @@ def _bench_torch_cpu(shape, batch, width, steps=3):
 def main():
     fast = bool(os.environ.get("COINN_BENCH_FAST"))
     shape = (24, 24, 24) if fast else (64, 64, 64)
-    # batch 128 is the single-chip throughput knee on TPU v5e (measured sweep
-    # 16→512); both sides (ours and the torch baseline) use the same batch
+    # batch 128 is the single-chip throughput knee on TPU v5e (measured
+    # sweep 16→512); both sides (ours and torch) use the same batch
     batch = 4 if fast else 128
     width = 8 if fast else 16
     steps = 5 if fast else 60
 
-    ours, n_dev = _bench_ours(shape, batch, width, steps=steps)
+    import jax
+
+    n_dev = len(jax.devices())
+    peak = _peak_flops()
+    configs = _bench_configs(fast, peak)
+    if n_dev >= 2:
+        ours = _bench_flagship_mesh(shape, batch, width, steps, 3)
+    else:
+        # single chip: the flagship config's per-chip step IS the headline
+        # (same shape/batch/width) — don't re-time the heaviest model
+        ours = configs["vbm3d_cnn_8site"]["samples_per_sec_per_chip"]
     base = _bench_torch_cpu(shape, batch, width, steps=2 if fast else 3)
     vs = round(ours / base, 3) if base else None
+    scaling = _bench_round_scaling(fast)
+
+    flagship = configs.get("vbm3d_cnn_8site", {})
     print(json.dumps({
         "metric": "vbm3d_cnn_samples_per_sec_per_chip",
         "value": round(ours, 2),
@@ -134,9 +340,14 @@ def main():
         "vs_baseline": vs,
         "baseline": "torch-cpu same model+step on this host",
         "baseline_samples_per_sec": round(base, 2) if base else None,
+        "mfu": flagship.get("mfu"),
+        "achieved_tflops": flagship.get("achieved_tflops"),
+        "peak_tflops": round(peak / 1e12, 1) if peak else None,
         "devices": n_dev,
         "input_shape": list(shape),
         "batch_size": batch,
+        "configs": configs,
+        "round_wallclock_s_cpu_mesh": scaling,
     }))
 
 

@@ -16,6 +16,7 @@ import numpy as np
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 from ..data import COINNDataset
 from ..metrics import classification_outputs
@@ -38,6 +39,70 @@ class _ConvBlock(nn.Module):
         return nn.relu(x)
 
 
+def _s2d_map():
+    """(27, 64) one-hot map from the 3³ kernel taps to the block-2
+    space-to-depth kernel positions.
+
+    SAME padding for k=3, s=2 pads (0, 1), so output o reads input taps
+    2o+t, t ∈ {0,1,2}; under block-2 space-to-depth that tap lives in block
+    o + t//2 at in-block offset t%2.  Taps map to ((t//2 per dim) kernel
+    position, (t%2 per dim) input channel); the (1,1)-per-dim positions
+    stay structurally zero.
+    """
+    T = np.zeros((27, 64), np.float32)
+    for td in range(3):
+        for th in range(3):
+            for tw in range(3):
+                t = (td * 3 + th) * 3 + tw
+                pos = ((td // 2) * 2 + th // 2) * 2 + tw // 2
+                cin = (td % 2) * 4 + (th % 2) * 2 + (tw % 2)
+                T[t, pos * 8 + cin] = 1.0
+    return T
+
+
+class _StemConv(nn.Module):
+    """Stride-2 3³ conv on a 1-channel volume, executed as its block-2
+    space-to-depth reparametrization (the MLPerf ResNet conv0 trick).
+
+    A cin=1 conv pathologically underfills the TPU MXU's 128-wide
+    contraction (measured 4.3 ms of the flagship's 5.5 ms forward at
+    batch 128 · 64³): XLA pads the size-1 channel dim onto the lanes, doing
+    >100× redundant work.  Reshaping 2×2×2 input blocks into 8 channels and
+    convolving with the equivalently remapped 2³×8 kernel computes the SAME
+    function (max |Δ| ≈ 3e-7 vs the plain conv) with a 64-deep contraction.
+    The parameter keeps the canonical (3,3,3,1,F) shape; odd spatial dims
+    fall back to the plain conv.
+    """
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        f = self.features
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (3, 3, 3, 1, f),
+            jnp.float32,
+        )
+        k = jnp.asarray(kernel, self.dtype)
+        b, d, h, w, _ = x.shape
+        if d % 2 or h % 2 or w % 2:
+            return lax.conv_general_dilated(
+                x, k, (2, 2, 2), "SAME",
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+            )
+        k2 = (
+            jnp.asarray(_s2d_map(), self.dtype).T @ k.reshape(27, f)
+        ).reshape(2, 2, 2, 8, f)
+        xs = x.reshape(b, d // 2, 2, h // 2, 2, w // 2, 2, 1)
+        xs = xs.transpose(0, 1, 3, 5, 2, 4, 6, 7)
+        xs = xs.reshape(b, d // 2, h // 2, w // 2, 8)
+        return lax.conv_general_dilated(
+            xs, k2, (1, 1, 1), ((0, 1), (0, 1), (0, 1)),
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        )
+
+
 class VBM3DNet(nn.Module):
     """Volumetric CNN: stem + 4 strided stages + GAP head."""
 
@@ -52,7 +117,10 @@ class VBM3DNet(nn.Module):
             x = x[..., None]
         x = jnp.asarray(x, self.dtype)
         w = self.width
-        x = _ConvBlock(w, stride=2, dtype=self.dtype)(x)  # /2
+        # stem: space-to-depth stride-2 conv (see _StemConv) + GN + relu
+        x = _StemConv(w, dtype=self.dtype)(x)  # /2
+        x = nn.GroupNorm(num_groups=min(8, w), dtype=self.dtype)(x)
+        x = nn.relu(x)
         x = _ConvBlock(w, dtype=self.dtype)(x)
         x = _ConvBlock(2 * w, stride=2, dtype=self.dtype)(x)  # /4
         x = _ConvBlock(2 * w, dtype=self.dtype)(x)

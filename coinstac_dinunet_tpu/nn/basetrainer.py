@@ -215,6 +215,72 @@ class NNTrainer:
     def _metrics_shell(self):
         return self.new_metrics(), self.new_averages()
 
+    # ---- local multi-device data parallelism ----------------------------
+    # ≙ the reference's automatic torch.nn.DataParallel fan-out over a
+    # site's GPUs (ref ``nn/basetrainer.py:62-74``): train/eval steps shard
+    # the batch over every local device via shard_map; the mask-weighted
+    # gradient reduction keeps numerics identical to single-device.
+    # Opt out with ``cache['local_data_parallel'] = False``; cap the device
+    # count with ``cache['local_devices']``.
+    @staticmethod
+    def make_grad_reduce(axis):
+        """Mask-weighted mean over ``axis`` device shards of one micro-batch —
+        reproduces the full-batch masked-mean gradient exactly even when the
+        padded tail splits unevenly across shards."""
+
+        def grad_reduce(g, batch):
+            mask = batch.get("_mask")
+            n = (jnp.sum(jnp.asarray(mask, jnp.float32)) if mask is not None
+                 else jnp.asarray(
+                     jax.tree_util.tree_leaves(batch)[0].shape[0], jnp.float32))
+            denom = jnp.maximum(jax.lax.psum(n, axis), 1.0)
+            return jax.tree_util.tree_map(
+                lambda x: jax.lax.psum(x * n, axis) / denom, g
+            )
+
+        return grad_reduce
+
+    def _dp_device_count(self, batch_dim):
+        """Largest local-device count that divides the (static, padded) batch
+        dimension; 1 disables the data-parallel path."""
+        if self.cache.get("local_data_parallel", True) is False:
+            return 1
+        n = len(jax.devices())
+        cap = self.cache.get("local_devices")
+        if cap:
+            n = min(n, int(cap))
+        while n > 1 and batch_dim % n:
+            n -= 1
+        return max(n, 1)
+
+    def _dp_mesh(self, n):
+        from jax.sharding import Mesh
+
+        return Mesh(np.array(jax.devices()[:n]), ("device",))
+
+    def _reduce_dp_aux(self, aux, stacked):
+        aux = dict(aux)
+        if aux.get("metrics") is not None:
+            aux["metrics"] = jax.lax.psum(aux["metrics"], "device")
+        if "host_scores" in aux:
+            aux["host_scores"] = jax.tree_util.tree_map(
+                lambda x: jax.lax.all_gather(x, "device", axis=0, tiled=True),
+                aux["host_scores"],
+            )
+        aux["averages"] = jax.lax.psum(aux["averages"], "device")
+        # weight the reported loss by each shard's real-sample count so a
+        # padded tail split unevenly across shards reports the same loss as
+        # the single-device full-batch masked mean
+        mask = stacked.get("_mask")
+        if mask is not None:
+            n = jnp.sum(jnp.asarray(mask, jnp.float32))
+            aux["loss"] = jax.lax.psum(aux["loss"] * n, "device") / jnp.maximum(
+                jax.lax.psum(n, "device"), 1.0
+            )
+        else:
+            aux["loss"] = jax.lax.pmean(aux["loss"], "device")
+        return aux
+
     @staticmethod
     def _zeros_f32(tree):
         """f32 device-side zero state (host empty_state() is f64 numpy)."""
@@ -253,7 +319,14 @@ class NNTrainer:
     def compute_grads(self, ts, stacked_batches):
         """Mean gradients over ``local_iterations`` stacked micro-batches via
         ``lax.scan`` (compiled grad accumulation).  Returns (grads, aux).
-        This is the site-side half of a federated round (≙ learner.backward)."""
+        This is the site-side half of a federated round (≙ learner.backward).
+        With >1 local device the batch fans out over a ``device`` mesh axis
+        (≙ ref DataParallel) and the returned grads are the exact masked-mean."""
+        n = self._dp_device_count(
+            jax.tree_util.tree_leaves(stacked_batches)[0].shape[1]
+        )
+        if n > 1:
+            return self._compute_grads_dp(ts, stacked_batches, n)
         fn = self._compiled.get("grads")
         if fn is None:
             metrics_shell, averages_shell = self._metrics_shell()
@@ -262,6 +335,36 @@ class NNTrainer:
                 return self._grads_uncompiled(ts, stacked, metrics_shell, averages_shell)
 
             fn = self._compiled["grads"] = jax.jit(_grads)
+        return fn(ts, stacked_batches)
+
+    def _compute_grads_dp(self, ts, stacked_batches, n):
+        from jax.sharding import PartitionSpec as P
+
+        fn = self._compiled.get(("grads_dp", n))
+        if fn is None:
+            metrics_shell, averages_shell = self._metrics_shell()
+            grad_reduce = self.make_grad_reduce("device")
+
+            def shard_grads(ts, stacked):
+                orig_rng = ts.rng
+                ts = ts.replace(
+                    rng=jax.random.fold_in(orig_rng, jax.lax.axis_index("device"))
+                )
+                grads, aux = self._grads_uncompiled(
+                    ts, stacked, metrics_shell, averages_shell,
+                    grad_reduce=grad_reduce,
+                )
+                aux = self._reduce_dp_aux(aux, stacked)
+                aux["rng"] = jax.random.split(orig_rng)[0]
+                return grads, aux
+
+            fn = self._compiled[("grads_dp", n)] = jax.jit(
+                jax.shard_map(
+                    shard_grads, mesh=self._dp_mesh(n),
+                    in_specs=(P(), P(None, "device")), out_specs=(P(), P()),
+                    check_vma=False,
+                )
+            )
         return fn(ts, stacked_batches)
 
     def apply_grads(self, ts, grads, new_rng=None):
@@ -284,7 +387,17 @@ class NNTrainer:
         state as consumed (rebind: ``ts, aux = trainer.train_step(ts, ...)``).
         On CPU donation is a no-op, so code that re-reads the old state only
         breaks on TPU/GPU — set ``cache['donate_buffers'] = False`` to opt
-        out everywhere."""
+        out everywhere.
+
+        With >1 local device the batch shards over a ``device`` mesh axis
+        (≙ the reference's automatic DataParallel, ``nn/basetrainer.py:
+        62-74``); the mask-weighted reduction keeps the update identical to
+        the single-device step (up to per-shard dropout streams)."""
+        n = self._dp_device_count(
+            jax.tree_util.tree_leaves(stacked_batches)[0].shape[1]
+        )
+        if n > 1:
+            return self._train_step_dp(ts, stacked_batches, n)
         fn = self._compiled.get("train")
         if fn is None:
             metrics_shell, averages_shell = self._metrics_shell()
@@ -305,6 +418,47 @@ class NNTrainer:
                 else ()
             )
             fn = self._compiled["train"] = jax.jit(_full, donate_argnums=donate)
+        return fn(ts, stacked_batches)
+
+    def _train_step_dp(self, ts, stacked_batches, n):
+        from jax.sharding import PartitionSpec as P
+
+        fn = self._compiled.get(("train_dp", n))
+        if fn is None:
+            metrics_shell, averages_shell = self._metrics_shell()
+            grad_reduce = self.make_grad_reduce("device")
+
+            def shard_step(ts, stacked):
+                orig_rng = ts.rng
+                # per-shard decorrelated dropout streams; the carried rng
+                # advances identically everywhere (replication invariant)
+                ts = ts.replace(
+                    rng=jax.random.fold_in(orig_rng, jax.lax.axis_index("device"))
+                )
+                grads, aux = self._grads_uncompiled(
+                    ts, stacked, metrics_shell, averages_shell,
+                    grad_reduce=grad_reduce,
+                )
+                ts = self._apply_updates(ts, grads)
+                ts = ts.replace(rng=jax.random.split(orig_rng)[0])
+                aux = self._reduce_dp_aux(aux, stacked)
+                aux["rng"] = ts.rng
+                return ts, aux
+
+            donate = (
+                (0,)
+                if jax.default_backend() != "cpu"
+                and self.cache.get("donate_buffers", True)
+                else ()
+            )
+            fn = self._compiled[("train_dp", n)] = jax.jit(
+                jax.shard_map(
+                    shard_step, mesh=self._dp_mesh(n),
+                    in_specs=(P(), P(None, "device")), out_specs=(P(), P()),
+                    check_vma=False,
+                ),
+                donate_argnums=donate,
+            )
         return fn(ts, stacked_batches)
 
     def _grads_uncompiled(self, ts, stacked, metrics_shell, averages_shell,
@@ -355,6 +509,9 @@ class NNTrainer:
         return grads, aux
 
     def eval_step(self, ts, batch):
+        n = self._dp_device_count(jax.tree_util.tree_leaves(batch)[0].shape[0])
+        if n > 1:
+            return self._eval_step_dp(ts, batch, n)
         fn = self._compiled.get("eval")
         if fn is None:
             metrics_shell, averages_shell = self._metrics_shell()
@@ -365,6 +522,48 @@ class NNTrainer:
                 return m_state, a_state, it
 
             fn = self._compiled["eval"] = jax.jit(_eval)
+        return fn(ts, batch)
+
+    def _eval_step_dp(self, ts, batch, n):
+        from jax.sharding import PartitionSpec as P
+
+        fn = self._compiled.get(("eval_dp", n))
+        if fn is None:
+            metrics_shell, averages_shell = self._metrics_shell()
+
+            def shard_eval(ts, batch):
+                it = self.iteration(ts.params, batch, None)
+                m_state, a_state = self._step_outputs(
+                    it, batch, metrics_shell, averages_shell
+                )
+                if m_state is not None:
+                    m_state = jax.lax.psum(m_state, "device")
+                a_state = jax.lax.psum(a_state, "device")
+                # carry the FULL it dict through (the hook's contract is
+                # "anything else is carried through"): per-sample arrays
+                # gather back into full-batch order (host-side AUC +
+                # save_predictions rely on it), scalars average
+                shard_b = jax.tree_util.tree_leaves(batch)[0].shape[0]
+                out_it = {}
+                for k, v in it.items():
+                    arr = jnp.asarray(v)
+                    if arr.ndim >= 1 and arr.shape[0] == shard_b:
+                        out_it[k] = jax.lax.all_gather(
+                            arr, "device", axis=0, tiled=True
+                        )
+                    elif arr.ndim == 0:
+                        out_it[k] = jax.lax.pmean(arr, "device")
+                    else:
+                        out_it[k] = arr  # replicated (e.g. per-class stats)
+                return m_state, a_state, out_it
+
+            fn = self._compiled[("eval_dp", n)] = jax.jit(
+                jax.shard_map(
+                    shard_eval, mesh=self._dp_mesh(n),
+                    in_specs=(P(), P("device")), out_specs=(P(), P(), P()),
+                    check_vma=False,
+                )
+            )
         return fn(ts, batch)
 
     # ----------------------------------------------------------- train / eval

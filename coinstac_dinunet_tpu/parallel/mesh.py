@@ -323,18 +323,9 @@ class MeshFederation:
             grads = jax.tree_util.tree_unflatten(treedef, out)
             return grads, {"errors": new_err, "qs": new_q}
 
-        def _device_grad_reduce(g, batch):
-            """Mask-weighted mean over the device shards of one micro-batch —
-            reproduces the single-device full-batch masked-mean gradient
-            exactly even when the padded tail splits unevenly."""
-            mask = batch.get("_mask")
-            n = (jnp.sum(jnp.asarray(mask, jnp.float32)) if mask is not None
-                 else jnp.asarray(
-                     jax.tree_util.tree_leaves(batch)[0].shape[0], jnp.float32))
-            denom = jnp.maximum(jax.lax.psum(n, "device"), 1.0)
-            return jax.tree_util.tree_map(
-                lambda x: jax.lax.psum(x * n, "device") / denom, g
-            )
+        # mask-weighted mean over the intra-site device shards (shared with
+        # the trainer's local DataParallel path)
+        _device_grad_reduce = trainer.make_grad_reduce("device")
 
         def site_step(ts, stacked, comm):
             # drop the sharded (now size-1) site axis from the batch view

@@ -96,6 +96,29 @@ def test_vbm_mesh_federation_8_sites(tmp_path):
     assert np.isfinite(float(aux["loss"]))
 
 
+def test_vbm_s2d_stem_equals_plain_conv():
+    """The stem's space-to-depth reparametrization computes EXACTLY the
+    plain stride-2 SAME 3³ conv for the same canonical kernel — on even and
+    (via the fallback) odd spatial dims."""
+    from jax import lax
+
+    from coinstac_dinunet_tpu.models.cnn3d import _StemConv
+
+    for shape in ((16, 16, 16), (15, 17, 16)):
+        x = jax.random.normal(jax.random.PRNGKey(0), (3, *shape, 1), jnp.float32)
+        stem = _StemConv(features=8, dtype=jnp.float32)
+        params = stem.init(jax.random.PRNGKey(1), x)
+        got = stem.apply(params, x)
+        want = lax.conv_general_dilated(
+            x, params["params"]["kernel"], (2, 2, 2), "SAME",
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        )
+        assert got.shape == want.shape
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5,
+        )
+
+
 def test_fsv_synthetic_learnable_signal(tmp_path):
     """The synthetic task carries class signal — loss decreases."""
     tr = _setup(tmp_path, FSVTrainer, FSVDataset, n=32, input_size=20,

@@ -225,6 +225,57 @@ def test_midrun_resume_is_exact(tmp_path):
     assert b.cache.get("best_val_score") == ref.cache.get("best_val_score")
 
 
+def test_local_data_parallel_matches_single_device(tmp_path):
+    """train_local on all local devices (≙ ref DataParallel,
+    ``nn/basetrainer.py:62-74``) produces the SAME params and score logs as
+    a single-device run — the mask-weighted device reduction makes the
+    padded tail batch exact."""
+    import jax
+
+    # 27 samples, batch 8 → last train batch is padded: the weighted
+    # reduction's correctness is actually exercised
+    dp = _trainer(tmp_path / "dp", n=27, epochs=4)
+    assert dp._dp_device_count(8) == 8  # the 8-device virtual platform
+    dp.train_local()
+    assert ("train_dp", 8) in dp._compiled  # the sharded path really ran
+
+    single = _trainer(tmp_path / "single", n=27, epochs=4,
+                      local_data_parallel=False)
+    single.train_local()
+    assert ("train_dp", 8) not in single._compiled
+
+    for l1, l2 in zip(jax.tree_util.tree_leaves(dp.train_state.params),
+                      jax.tree_util.tree_leaves(single.train_state.params)):
+        np.testing.assert_allclose(
+            np.asarray(l1, np.float64), np.asarray(l2, np.float64),
+            rtol=1e-5, atol=1e-7,
+        )
+    np.testing.assert_allclose(
+        np.asarray(dp.cache["train_log"], np.float64),
+        np.asarray(single.cache["train_log"], np.float64), atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(dp.cache["validation_log"], np.float64),
+        np.asarray(single.cache["validation_log"], np.float64), atol=1e-5,
+    )
+
+
+def test_local_dp_eval_preserves_prediction_order(tmp_path):
+    """The DP eval step gathers per-sample outputs back into full-batch
+    order so save_predictions / host-side AUC see the loader's order."""
+    import jax.numpy as jnp
+
+    trainer = _trainer(tmp_path, n=32)
+    ds = trainer.data_handle.get_validation_dataset()
+    loader = trainer.data_handle.get_loader("validation", dataset=ds, shuffle=False)
+    batch = {k: jnp.asarray(v) for k, v in next(iter(loader)).items()}
+    _, _, it_dp = trainer.eval_step(trainer.train_state, batch)
+    trainer2 = _trainer(tmp_path / "b", n=32, local_data_parallel=False)
+    _, _, it_single = trainer2.eval_step(trainer2.train_state, batch)
+    np.testing.assert_array_equal(np.asarray(it_dp["pred"]),
+                                  np.asarray(it_single["pred"]))
+
+
 def test_resume_without_checkpoint_starts_fresh(tmp_path):
     t = _trainer(tmp_path, epochs=2, resume=True)
     t.train_local()  # no autosave exists yet: must not raise
