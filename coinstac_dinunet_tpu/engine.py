@@ -435,6 +435,10 @@ class MeshEngine:
                 trainer.fold_train_outputs(aux, ep_averages, ep_metrics)
                 done += take
             if epoch % val_every != 0:
+                # no stop check off the validation cadence: the file-transport
+                # remote evaluates the epoch limit only at the validation
+                # barrier (remote.py _next_epoch), so with epochs % val_every
+                # != 0 both transports train up to the next validation epoch
                 continue
             # ---- epoch barrier (≙ remote VALIDATION_WAITING → TRAIN_WAITING)
             rc[Key.TRAIN_LOG.value].append([*ep_averages.get(), *ep_metrics.get()])
@@ -450,6 +454,7 @@ class MeshEngine:
                 plotter.plot_progress(
                     rc, log_dir,
                     plot_keys=[Key.TRAIN_LOG.value, Key.VALIDATION_LOG.value],
+                    epoch=epoch,
                 )
             self._epoch_autosave(trainer, fed, epoch)
             if epoch >= epochs or stop_training_(epoch, rc):
@@ -464,7 +469,8 @@ class MeshEngine:
         rc[Key.GLOBAL_TEST_SERIALIZABLE.value].append(fold_payload)
         self._record_fold_done(split_ix, utils.clean_recursive(fold_payload))
         plotter.plot_progress(
-            rc, log_dir, plot_keys=[Key.TRAIN_LOG.value, Key.VALIDATION_LOG.value]
+            rc, log_dir, plot_keys=[Key.TRAIN_LOG.value, Key.VALIDATION_LOG.value],
+            epoch=rc.get("epoch"),
         )
         utils.save_scores(rc, log_dir=log_dir, file_keys=[Key.TEST_METRICS.value])
         utils.save_cache(rc, {"outputDirectory": log_dir})

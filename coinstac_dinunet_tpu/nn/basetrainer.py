@@ -268,9 +268,11 @@ class NNTrainer:
                 aux["host_scores"],
             )
         aux["averages"] = jax.lax.psum(aux["averages"], "device")
-        # weight the reported loss by each shard's real-sample count so a
-        # padded tail split unevenly across shards reports the same loss as
-        # the single-device full-batch masked mean
+        # weight the reported loss by each shard's real-sample count; for a
+        # single micro-batch this reproduces the single-device full-batch
+        # masked mean exactly (with grad accumulation the per-micro-batch
+        # weights are approximated by the shard total — display-only; the
+        # epoch averages state stays exact either way)
         mask = stacked.get("_mask")
         if mask is not None:
             n = jnp.sum(jnp.asarray(mask, jnp.float32))
@@ -337,33 +339,48 @@ class NNTrainer:
             fn = self._compiled["grads"] = jax.jit(_grads)
         return fn(ts, stacked_batches)
 
-    def _compute_grads_dp(self, ts, stacked_batches, n):
+    def _build_dp_step(self, n, apply_updates, donate):
+        """Compiled batch-sharded step over ``n`` local devices: per-shard
+        decorrelated dropout streams, mask-weighted gradient reduction, and
+        an identically-advancing carried rng (replication invariant).  With
+        ``apply_updates`` the optimizer runs in-step (train); without, the
+        reduced grads return to the caller (federated backward)."""
         from jax.sharding import PartitionSpec as P
 
+        metrics_shell, averages_shell = self._metrics_shell()
+        grad_reduce = self.make_grad_reduce("device")
+
+        def shard_step(ts, stacked):
+            orig_rng = ts.rng
+            ts = ts.replace(
+                rng=jax.random.fold_in(orig_rng, jax.lax.axis_index("device"))
+            )
+            grads, aux = self._grads_uncompiled(
+                ts, stacked, metrics_shell, averages_shell,
+                grad_reduce=grad_reduce,
+            )
+            aux = self._reduce_dp_aux(aux, stacked)
+            aux["rng"] = jax.random.split(orig_rng)[0]
+            if not apply_updates:
+                return grads, aux
+            ts = self._apply_updates(ts, grads)
+            ts = ts.replace(rng=aux["rng"])
+            return ts, aux
+
+        return jax.jit(
+            jax.shard_map(
+                shard_step, mesh=self._dp_mesh(n),
+                in_specs=(P(), P(None, "device")), out_specs=(P(), P()),
+                check_vma=False,
+            ),
+            donate_argnums=donate,
+        )
+
+    def _compute_grads_dp(self, ts, stacked_batches, n):
         fn = self._compiled.get(("grads_dp", n))
         if fn is None:
-            metrics_shell, averages_shell = self._metrics_shell()
-            grad_reduce = self.make_grad_reduce("device")
-
-            def shard_grads(ts, stacked):
-                orig_rng = ts.rng
-                ts = ts.replace(
-                    rng=jax.random.fold_in(orig_rng, jax.lax.axis_index("device"))
-                )
-                grads, aux = self._grads_uncompiled(
-                    ts, stacked, metrics_shell, averages_shell,
-                    grad_reduce=grad_reduce,
-                )
-                aux = self._reduce_dp_aux(aux, stacked)
-                aux["rng"] = jax.random.split(orig_rng)[0]
-                return grads, aux
-
-            fn = self._compiled[("grads_dp", n)] = jax.jit(
-                jax.shard_map(
-                    shard_grads, mesh=self._dp_mesh(n),
-                    in_specs=(P(), P(None, "device")), out_specs=(P(), P()),
-                    check_vma=False,
-                )
+            fn = self._compiled[("grads_dp", n)] = self._build_dp_step(
+                n, apply_updates=False, donate=()
             )
         return fn(ts, stacked_batches)
 
@@ -421,43 +438,16 @@ class NNTrainer:
         return fn(ts, stacked_batches)
 
     def _train_step_dp(self, ts, stacked_batches, n):
-        from jax.sharding import PartitionSpec as P
-
         fn = self._compiled.get(("train_dp", n))
         if fn is None:
-            metrics_shell, averages_shell = self._metrics_shell()
-            grad_reduce = self.make_grad_reduce("device")
-
-            def shard_step(ts, stacked):
-                orig_rng = ts.rng
-                # per-shard decorrelated dropout streams; the carried rng
-                # advances identically everywhere (replication invariant)
-                ts = ts.replace(
-                    rng=jax.random.fold_in(orig_rng, jax.lax.axis_index("device"))
-                )
-                grads, aux = self._grads_uncompiled(
-                    ts, stacked, metrics_shell, averages_shell,
-                    grad_reduce=grad_reduce,
-                )
-                ts = self._apply_updates(ts, grads)
-                ts = ts.replace(rng=jax.random.split(orig_rng)[0])
-                aux = self._reduce_dp_aux(aux, stacked)
-                aux["rng"] = ts.rng
-                return ts, aux
-
             donate = (
                 (0,)
                 if jax.default_backend() != "cpu"
                 and self.cache.get("donate_buffers", True)
                 else ()
             )
-            fn = self._compiled[("train_dp", n)] = jax.jit(
-                jax.shard_map(
-                    shard_step, mesh=self._dp_mesh(n),
-                    in_specs=(P(), P(None, "device")), out_specs=(P(), P()),
-                    check_vma=False,
-                ),
-                donate_argnums=donate,
+            fn = self._compiled[("train_dp", n)] = self._build_dp_step(
+                n, apply_updates=True, donate=donate
             )
         return fn(ts, stacked_batches)
 

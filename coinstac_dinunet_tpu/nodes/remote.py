@@ -135,6 +135,7 @@ class COINNRemote:
             plotter.plot_progress(
                 self.cache, self.cache["log_dir"],
                 plot_keys=[Key.TRAIN_LOG.value, Key.VALIDATION_LOG.value],
+                epoch=self.cache.get("epoch"),
             )
         return info
 
@@ -165,6 +166,7 @@ class COINNRemote:
         plotter.plot_progress(
             self.cache, self.cache["log_dir"],
             plot_keys=[Key.TRAIN_LOG.value, Key.VALIDATION_LOG.value],
+            epoch=self.cache.get("epoch"),
         )
         utils.save_scores(
             self.cache, log_dir=self.cache["log_dir"],

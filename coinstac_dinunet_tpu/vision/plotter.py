@@ -16,8 +16,28 @@ def _rolling_mean(x, w):
     return np.convolve(x, kernel, mode="valid")
 
 
+def _epoch_ticks(n_rows, epoch, max_ticks=10):
+    """(tick positions, labels) relabeling the row axis in epoch numbers
+    (≙ ref ``vision/plotter.py:51-60``), thinned to ``max_ticks``.
+
+    Works in both directions: more rows than epochs (several log rows per
+    epoch) and fewer rows than epochs (``validation_epochs > 1`` — row i is
+    epoch ``(i+1)·epoch/n_rows``).
+    """
+    step = max(int(np.ceil(n_rows / max_ticks)), 1)
+    positions = list(range(0, n_rows, step))
+    labels = []
+    for p in positions:
+        e = (p + 1) * float(epoch) / n_rows
+        labels.append(int(round(e)) if abs(e - round(e)) < 1e-9 else round(e, 1))
+    return positions, labels
+
+
 def plot_progress(cache, log_dir=None, plot_keys=("train_log",), epoch=None):
-    """Render raw + rolling-mean curves for every key's accumulated rows."""
+    """Render raw + rolling-mean curves for every key's accumulated rows.
+
+    ``epoch``: when given and different from the row count, x-ticks are
+    remapped so labels read in epochs rather than log rows."""
     import matplotlib
 
     matplotlib.use("Agg", force=True)
@@ -58,7 +78,13 @@ def plot_progress(cache, log_dir=None, plot_keys=("train_log",), epoch=None):
                         linewidth=2,
                     )
                 col += 1
-            ax.set_xlabel("epoch" if "log" in key else "step")
+            if epoch and int(epoch) != len(rows) and int(epoch) > 0:
+                ticks, labels = _epoch_ticks(len(rows), epoch)
+                ax.set_xticks(ticks)
+                ax.set_xticklabels(labels)
+                ax.set_xlabel("epoch")
+            else:
+                ax.set_xlabel("epoch" if "log" in key else "step")
             ax.legend(loc="best", fontsize=8)
             ax.grid(alpha=0.3)
         fig.tight_layout()
