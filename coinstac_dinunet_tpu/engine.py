@@ -82,6 +82,12 @@ class InProcessEngine:
         self.site_spec = {}
         if inputspec is not None:
             per_site = load_inputspec(inputspec)
+            if 1 < len(per_site) < int(n_sites):
+                raise ValueError(
+                    f"inputspec has {len(per_site)} per-site entries but the "
+                    f"engine was built with n_sites={n_sites}; only a "
+                    "single-entry spec broadcasts to every site"
+                )
             for i in range(int(n_sites)):
                 self.site_spec[f"site_{i}"] = per_site[min(i, len(per_site) - 1)]
         self.workdir = str(workdir)
@@ -276,9 +282,17 @@ class MeshEngine:
         rc = self.cache
         rc["num_folds"] = len(next(iter(self.site_caches.values()))["splits"])
         rc[Key.GLOBAL_TEST_SERIALIZABLE.value] = []
-        done_folds = {}
-        if rc.get("resume"):
-            done_folds = self._load_run_state().get("completed_folds", {})
+        # fold/epoch resume is honored only when a run-state record from an
+        # interrupted run exists — per-fold checkpoints left behind by a
+        # COMPLETED run (whose record _finish removed) never replay
+        self._resuming = bool(rc.get("resume")) and os.path.exists(
+            self._run_state_path()
+        )
+        done_folds = (
+            self._load_run_state().get("completed_folds", {})
+            if self._resuming else {}
+        )
+        self._write_run_state_marker()
         for fold in range(int(rc["num_folds"])):
             if str(fold) in done_folds:
                 rc[Key.GLOBAL_TEST_SERIALIZABLE.value].append(done_folds[str(fold)])
@@ -300,13 +314,22 @@ class MeshEngine:
         except (OSError, ValueError):
             return {}
 
-    def _record_fold_done(self, split_ix, payload):
+    def _write_run_state(self, run_state):
         import json
 
+        tmp = self._run_state_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(run_state, f)
+        os.replace(tmp, self._run_state_path())  # atomic: never truncated
+
+    def _write_run_state_marker(self):
+        if not os.path.exists(self._run_state_path()):
+            self._write_run_state({"completed_folds": {}})
+
+    def _record_fold_done(self, split_ix, payload):
         run_state = self._load_run_state()
         run_state.setdefault("completed_folds", {})[str(split_ix)] = payload
-        with open(self._run_state_path(), "w") as f:
-            json.dump(run_state, f)
+        self._write_run_state(run_state)
 
     def _epoch_autosave(self, trainer, fed, epoch):
         """Full mesh resume point at the epoch barrier: params/opt/rng +
@@ -328,12 +351,23 @@ class MeshEngine:
 
     def _try_fold_resume(self, trainer, fed):
         """Restart the current fold from its latest epoch-barrier autosave.
-        Returns the completed-epoch counter to continue from (0 = fresh)."""
+        Returns the completed-epoch counter to continue from (0 = fresh).
+        A corrupt/truncated autosave (crash mid-write) falls back to a
+        fresh fold rather than wedging the resume path."""
         rc = self.cache
         path = trainer.checkpoint_path(rc["latest_nn_state"])
-        if not (rc.get("resume") and os.path.exists(path)):
+        if not (getattr(self, "_resuming", False) and os.path.exists(path)):
             return 0
-        trainer.load_checkpoint(full_path=path)
+        try:
+            trainer.load_checkpoint(full_path=path)
+        except Exception as exc:  # noqa: BLE001 — any decode failure
+            # msgpack_restore raises before mutating the trainer, so the
+            # fresh seeded init from _run_fold is still intact
+            logger.warn(
+                f"MeshEngine: unreadable autosave {path} ({exc}); "
+                "restarting the fold fresh"
+            )
+            return 0
         extra = getattr(trainer, "last_checkpoint_extra", {})
         rc[Key.TRAIN_LOG.value] = [list(r) for r in extra.get("train_log", [])]
         rc[Key.VALIDATION_LOG.value] = [
@@ -542,6 +576,13 @@ class MeshEngine:
         """All folds done: reduce fold scores, write the CSV, zip results
         (≙ remote ``_send_global_scores``)."""
         trainer = self._trainer
+        if trainer is None:
+            # every fold was replayed from the run-state record (resume after
+            # a crash inside _finish): metric shells need no initialized nn
+            trainer = self.trainer_cls(
+                cache=self.cache, input={},
+                state={"outputDirectory": self.remote_out_dir}, data_handle=None,
+            )
         rc = self.cache
         pairs = rc[Key.GLOBAL_TEST_SERIALIZABLE.value]
         averages = trainer.new_averages().reduce_sites(

@@ -177,8 +177,12 @@ class NNTrainer:
                 jax.device_get(self.train_state.opt_state)
             )
         path = full_path or self.checkpoint_path(name)
-        with open(path, "wb") as f:
+        # temp + rename: a crash mid-write can never truncate the previous
+        # good checkpoint (these files are the crash-resume points)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
             f.write(flax.serialization.msgpack_serialize(payload))
+        os.replace(tmp, path)
         return path
 
     def load_checkpoint(self, name=None, full_path=None, load_optimizer=True):

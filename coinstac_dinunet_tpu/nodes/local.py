@@ -202,8 +202,10 @@ class COINNLocal:
         path = trainer.save_checkpoint(
             name=self.cache["latest_nn_state"], extra=extra
         )
-        with open(self._resume_pointer(), "w") as f:
+        tmp = self._resume_pointer() + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"checkpoint": path}, f)
+        os.replace(tmp, self._resume_pointer())  # atomic pointer update
 
     def _try_resume(self, trainer):
         """Fresh-cache COMPUTATION invocation with ``resume`` set: rebuild the
@@ -215,12 +217,18 @@ class COINNLocal:
         ptr = self._resume_pointer()
         if not os.path.exists(ptr):
             return False
-        with open(ptr) as f:
-            ckpt = json.load(f)["checkpoint"]
-        if not os.path.exists(ckpt):
+        try:
+            with open(ptr) as f:
+                ckpt = json.load(f)["checkpoint"]
+            if not os.path.exists(ckpt):
+                return False
+            trainer.init_nn()
+            trainer.load_checkpoint(full_path=ckpt)
+        except Exception as exc:  # noqa: BLE001 — corrupt resume point
+            logger.warn(
+                f"Unreadable resume point {ptr} ({exc}); starting fresh"
+            )
             return False
-        trainer.init_nn()
-        trainer.load_checkpoint(full_path=ckpt)
         extra = getattr(trainer, "last_checkpoint_extra", {})
         snapshot = dict(extra.get("site_cache", {}))
         snapshot.pop("resume", None)

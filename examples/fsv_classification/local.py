@@ -1,0 +1,27 @@
+"""Site-node entry point (≙ the reference example repos' ``local.py``).
+
+The COINSTAC engine invokes this script once per round with
+``{"cache": ..., "input": ..., "state": ...}`` on stdin and relays the
+printed ``{"output": ...}`` dict (plus any files dropped into
+``state['transferDirectory']``) to the aggregator.
+"""
+import json
+import sys
+
+from coinstac_dinunet_tpu import COINNLocal
+from coinstac_dinunet_tpu.models import FSVDataset, FSVTrainer
+
+
+def compute(payload):
+    node = COINNLocal(
+        cache=payload.get("cache", {}),
+        input=payload.get("input", {}),
+        state=payload.get("state", {}),
+        task_id="fsv_classification",
+    )
+    return node(trainer_cls=FSVTrainer, dataset_cls=FSVDataset)
+
+
+if __name__ == "__main__":
+    result = compute(json.loads(sys.stdin.read()))
+    print(json.dumps(result))

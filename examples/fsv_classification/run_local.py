@@ -1,0 +1,28 @@
+"""Single-site local run of the example computation (no engine) via
+``SiteRunner`` + this package's ``inputspec.json`` — the debug path the
+reference's ``site_runner.py`` provides."""
+import os
+import sys
+
+from coinstac_dinunet_tpu.engine import SiteRunner
+from coinstac_dinunet_tpu.models import FSVDataset, FSVTrainer
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main(workdir="./fsv_local_run"):
+    runner = SiteRunner(
+        workdir, task_id="fsv_classification", inputspec=HERE, site_index=0,
+        pretrain_args={"epochs": 4}, epochs=4,
+    )
+    # synthetic subject files (inputspec sets synthetic=True)
+    for i in range(48):
+        with open(os.path.join(runner.data_dir, f"subj_{i}"), "w") as f:
+            f.write("x")
+    runner.run(FSVTrainer, dataset_cls=FSVDataset)
+    print("train log rows:", len(runner.cache.get("train_log", [])))
+    print("validation log:", runner.cache.get("validation_log", [])[-1:])
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

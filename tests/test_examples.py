@@ -1,0 +1,61 @@
+"""The example computation package speaks the engine's stdin/stdout contract
+(≙ the reference's external example repos wiring local.py/remote.py)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "fsv_classification")
+
+
+def _run_node(script, payload):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLE, script)],
+        input=json.dumps(payload), capture_output=True, text=True,
+        timeout=300, env=env,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    return json.loads(res.stdout.strip().splitlines()[-1])
+
+
+def test_local_entry_point_init_runs(tmp_path):
+    base = tmp_path / "base"
+    data = base / "data"
+    out = tmp_path / "out"
+    xfer = tmp_path / "xfer"
+    for d in (data, out, xfer):
+        d.mkdir(parents=True)
+    for i in range(24):
+        (data / f"subj_{i}").write_text("x")
+    payload = {
+        "cache": {},
+        "input": {
+            "data_dir": "data", "input_size": 66, "num_classes": 2,
+            "batch_size": 8, "epochs": 2, "split_ratio": [0.7, 0.15, 0.15],
+            "synthetic": True,
+        },
+        "state": {
+            "baseDirectory": str(base), "outputDirectory": str(out),
+            "transferDirectory": str(xfer), "clientId": "local0",
+        },
+    }
+    result = _run_node("local.py", payload)
+    assert "output" in result
+    assert result["output"]["phase"] == "init_runs"
+    assert "shared_args" in result["output"]
+    assert result["output"]["data_size"]
+
+
+def test_compspec_and_inputspec_are_valid_json():
+    with open(os.path.join(EXAMPLE, "compspec.json")) as f:
+        spec = json.load(f)
+    assert spec["computation"]["command"] == ["python", "local.py"]
+    assert spec["computation"]["remote"]["command"] == ["python", "remote.py"]
+    with open(os.path.join(EXAMPLE, "inputspec.json")) as f:
+        ispec = json.load(f)
+    assert ispec[0]["input_size"]["value"] == 66

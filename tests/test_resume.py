@@ -219,6 +219,30 @@ def test_mesh_engine_resume_skips_completed_folds(tmp_path):
     np.testing.assert_allclose(a, b, atol=1e-6)
 
 
+def test_mesh_engine_completed_run_never_replays(tmp_path):
+    """After a run COMPLETES, a second run in the same workdir with
+    resume=True must train from scratch (the run-state record is gone; the
+    leftover per-fold checkpoints alone must not shortcut training)."""
+    from coinstac_dinunet_tpu.engine import MeshEngine
+
+    first = MeshEngine(tmp_path, n_sites=3, trainer_cls=XorTrainer,
+                       dataset_cls=XorDataset, **BASE)
+    _fill_sites(first)
+    first.run()
+    assert first.success
+    assert not os.path.exists(first._run_state_path())
+
+    second = MeshEngine(tmp_path, n_sites=3, trainer_cls=XorTrainer,
+                        dataset_cls=XorDataset, resume=True, **BASE)
+    _fill_sites(second)
+    second.run()
+    assert second.success
+    # full training actually happened again: one train-log row per
+    # validation barrier, not a restored-and-skipped fold
+    assert len(second.cache["train_log"]) == len(first.cache["train_log"])
+    assert second._trainer is not None
+
+
 def test_site_crash_resume_rankdad_is_exact(tmp_path):
     """rankDAD's capture plan is re-derived on first use after resume (a pure
     function of model + batch shape), so the resumed trajectory is exact."""
