@@ -211,6 +211,33 @@ def _bench_flagship_mesh(shape, batch, width, steps, warmup):
     return steps * batch * n_sites / dt / chips
 
 
+def _run_cpu_subprocess(code, n, tag, force_devices=None):
+    """Run a timing snippet in a pinned-CPU subprocess; returns round_s|None.
+    Failures surface the subprocess stderr tail on our stderr."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if force_devices:
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={force_devices}"
+        ).strip()
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = None
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code, str(n)], env=env, cwd=_REPO,
+            capture_output=True, text=True, timeout=600,
+        )
+        line = res.stdout.strip().splitlines()[-1]
+        return round(json.loads(line)["round_s"], 5)
+    except Exception as exc:
+        err = (res.stderr.strip()[-300:] if res is not None and res.stderr
+               else str(exc))
+        print(f"# {tag} n={n} failed: {err}", file=sys.stderr)
+        return None
+
+
 def _bench_round_scaling(fast):
     """Federated dSGD round wall-clock at 2..32 sites on a virtual CPU mesh
     (one subprocess per site count so the device count can be pinned)."""
@@ -244,30 +271,54 @@ for _ in range(steps):
 float(np.asarray(aux["loss"]))
 print(json.dumps({"round_s": (time.perf_counter() - t0) / steps}))
 """
-    out = {}
-    for n in site_counts:
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["XLA_FLAGS"] = (
-            env.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={n}"
-        ).strip()
-        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        res = None
-        try:
-            res = subprocess.run(
-                [sys.executable, "-c", code, str(n)], env=env, cwd=_REPO,
-                capture_output=True, text=True, timeout=600,
-            )
-            line = res.stdout.strip().splitlines()[-1]
-            out[str(n)] = round(json.loads(line)["round_s"], 5)
-        except Exception as exc:
-            err = (res.stderr.strip()[-300:] if res is not None and res.stderr
-                   else str(exc))
-            print(f"# round-scaling n={n} failed: {err}", file=sys.stderr)
-            out[str(n)] = None
-    return out
+    return {
+        str(n): _run_cpu_subprocess(code, n, "round-scaling", force_devices=n)
+        for n in site_counts
+    }
+
+
+def _bench_file_round(fast):
+    """Wall-clock of one federated dSGD round on the FILE/JSON transport
+    (sites invoked sequentially, gradients crossing as wire files — the
+    reference's architecture, minus the engine's own IPC overhead).  The
+    counterpart number to ``round_wallclock_s_cpu_mesh``: same model, same
+    site counts, CPU, so the two columns isolate the transport cost."""
+    site_counts = (2, 4) if fast else (2, 4, 8)
+    code = r"""
+import json, os, sys, time
+import numpy as np
+n = int(sys.argv[1])
+import jax
+jax.config.update("jax_platforms", "cpu")
+from coinstac_dinunet_tpu.engine import InProcessEngine
+from coinstac_dinunet_tpu.models import FSVTrainer, FSVDataset
+import tempfile
+wd = tempfile.mkdtemp()
+eng = InProcessEngine(
+    wd, n_sites=n, trainer_cls=FSVTrainer, dataset_cls=FSVDataset,
+    task_id="fsv", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+    batch_size=32, epochs=10000, learning_rate=1e-3, input_size=66,
+    synthetic=True, seed=0, patience=10000, autosave_epochs=0,
+    local_data_parallel=False,
+)
+for i, s in enumerate(eng.site_ids):
+    d = eng.site_data_dir(s)
+    for j in range(64):
+        open(os.path.join(d, f"s_{i*64+j}"), "w").write("x")
+# advance past INIT/NEXT_RUN into steady-state computation rounds
+for _ in range(6):
+    eng.step_round()
+steps = 10
+t0 = time.perf_counter()
+for _ in range(steps):
+    eng.step_round()
+dt = (time.perf_counter() - t0) / steps
+print(json.dumps({"round_s": dt}))
+"""
+    return {
+        str(n): _run_cpu_subprocess(code, n, "file-round")
+        for n in site_counts
+    }
 
 
 def _bench_torch_cpu(shape, batch, width, steps=3):
@@ -331,6 +382,7 @@ def main():
     base = _bench_torch_cpu(shape, batch, width, steps=2 if fast else 3)
     vs = round(ours / base, 3) if base else None
     scaling = _bench_round_scaling(fast)
+    file_rounds = _bench_file_round(fast)
 
     flagship = configs.get("vbm3d_cnn_8site", {})
     print(json.dumps({
@@ -348,6 +400,7 @@ def main():
         "batch_size": batch,
         "configs": configs,
         "round_wallclock_s_cpu_mesh": scaling,
+        "round_wallclock_s_cpu_file": file_rounds,
     }))
 
 

@@ -82,7 +82,7 @@ class InProcessEngine:
         self.site_spec = {}
         if inputspec is not None:
             per_site = load_inputspec(inputspec)
-            if 1 < len(per_site) < int(n_sites):
+            if len(per_site) != 1 and len(per_site) != int(n_sites):
                 raise ValueError(
                     f"inputspec has {len(per_site)} per-site entries but the "
                     f"engine was built with n_sites={n_sites}; only a "
@@ -317,13 +317,14 @@ class MeshEngine:
     def _write_run_state(self, run_state):
         import json
 
-        tmp = self._run_state_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(run_state, f)
-        os.replace(tmp, self._run_state_path())  # atomic: never truncated
+        utils.atomic_write(self._run_state_path(), json.dumps(run_state))
 
     def _write_run_state_marker(self):
-        if not os.path.exists(self._run_state_path()):
+        """Fresh (non-resuming) runs RESET the record: a stale crashed-run
+        record must never leak fold results into a later resume."""
+        if not getattr(self, "_resuming", False) or not os.path.exists(
+            self._run_state_path()
+        ):
             self._write_run_state({"completed_folds": {}})
 
     def _record_fold_done(self, split_ix, payload):

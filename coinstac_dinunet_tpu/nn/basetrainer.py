@@ -33,7 +33,7 @@ import optax
 from .. import config
 from ..config.keys import Mode
 from ..metrics import COINNAverages, Prf1a
-from ..utils import logger
+from ..utils import atomic_write, logger
 from ..utils.utils import performance_improved_, stop_training_
 
 CHECKPOINT_SOURCE = "coinstac-dinunet-tpu"
@@ -177,12 +177,9 @@ class NNTrainer:
                 jax.device_get(self.train_state.opt_state)
             )
         path = full_path or self.checkpoint_path(name)
-        # temp + rename: a crash mid-write can never truncate the previous
-        # good checkpoint (these files are the crash-resume points)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(flax.serialization.msgpack_serialize(payload))
-        os.replace(tmp, path)
+        # atomic: a crash mid-write can never truncate the previous good
+        # checkpoint (these files are the crash-resume points)
+        atomic_write(path, flax.serialization.msgpack_serialize(payload))
         return path
 
     def load_checkpoint(self, name=None, full_path=None, load_optimizer=True):

@@ -202,10 +202,7 @@ class COINNLocal:
         path = trainer.save_checkpoint(
             name=self.cache["latest_nn_state"], extra=extra
         )
-        tmp = self._resume_pointer() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"checkpoint": path}, f)
-        os.replace(tmp, self._resume_pointer())  # atomic pointer update
+        utils.atomic_write(self._resume_pointer(), json.dumps({"checkpoint": path}))
 
     def _try_resume(self, trainer):
         """Fresh-cache COMPUTATION invocation with ``resume`` set: rebuild the

@@ -11,7 +11,7 @@ from .reducer import COINNReducer  # noqa: F401
 from .powersgd import PowerSGDLearner, PowerSGDReducer  # noqa: F401
 from .rankdad import DADLearner, DADReducer  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
-from . import pipeline, sequence  # noqa: F401
+from . import hosts, pipeline, sequence  # noqa: F401
 
 __all__ = [
     "pipeline",

@@ -41,6 +41,17 @@ class FrozenDict(dict):
             self[k] = v
 
 
+def atomic_write(path, data):
+    """Write ``data`` (str or bytes) via temp file + ``os.replace`` so a
+    crash mid-write can never truncate an existing good file — used for
+    every crash-resume artifact (checkpoints, resume pointers, run state)."""
+    tmp = f"{path}.tmp"
+    mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+    with open(tmp, mode) as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
 def jsonable(obj):
     try:
         json.dumps(obj)

@@ -361,3 +361,14 @@ def test_guarded_mean_all_bad_gives_noop():
     means, ok = _guarded_mean(stacked)
     assert not np.asarray(ok).any()
     np.testing.assert_allclose(np.asarray(means[0]), np.zeros(3))
+
+
+def test_multihost_helpers_single_process():
+    """hosts.initialize_multihost is a no-op single-process; the
+    host-aligned mesh degrades to the plain site mesh."""
+    from coinstac_dinunet_tpu.parallel import hosts
+
+    assert hosts.initialize_multihost() is False
+    mesh = hosts.host_aligned_site_mesh(n_sites=4)
+    assert mesh.axis_names == ("site", "device")
+    assert mesh.devices.shape[0] == 4
