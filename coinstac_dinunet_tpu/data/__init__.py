@@ -3,6 +3,7 @@ from .data import (
     COINNDataLoader,
     COINNDataset,
     EmptyDataHandle,
+    device_prefetch,
     safe_collate,
 )
 from .datautils import (
@@ -18,6 +19,7 @@ __all__ = [
     "COINNDataLoader",
     "EmptyDataHandle",
     "safe_collate",
+    "device_prefetch",
     "create_k_fold_splits",
     "create_ratio_split",
     "split_place_holder",

@@ -161,6 +161,65 @@ class COINNDataLoader:
         return self._collate_static(samples, mask[sl])
 
 
+def device_prefetch(iterator, size=2, sharding=None):
+    """Overlap host-side batch assembly + host→device transfer with device
+    compute: a background thread stays ``size`` batches ahead, issuing
+    ``jax.device_put`` so the copy is in flight while the previous step
+    runs.  HBM-bandwidth hygiene for real (non-synthetic) input pipelines —
+    the training loop's dispatch never blocks on the loader.
+
+    ``sharding``: optional ``jax.sharding.Sharding`` applied to every leaf
+    (e.g. batch-axis sharding over a local data-parallel mesh) so batches
+    land pre-sharded instead of committed to one device and re-sharded at
+    dispatch.  An abandoned generator (consumer error/early break) stops
+    the producer promptly — no thread or device-buffer leak.
+    """
+    import queue
+    import threading
+
+    import jax
+
+    if int(size) <= 0:  # prefetch disabled: plain pass-through
+        yield from iterator
+        return
+    q = queue.Queue(maxsize=int(size))
+    stop = threading.Event()
+    _END = object()
+
+    def _put(item):
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _producer():
+        try:
+            for batch in iterator:
+                placed = (jax.device_put(batch, sharding) if sharding is not None
+                          else jax.device_put(batch))
+                if not _put(placed):
+                    return
+            _put(_END)
+        except BaseException as exc:  # noqa: BLE001 — re-raised by consumer
+            _put(exc)
+
+    t = threading.Thread(target=_producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
 class COINNDataHandle:
     """Owns per-mode datasets built from the current fold's split JSON and the
     loader configuration; provides cursor-based batch streaming that survives

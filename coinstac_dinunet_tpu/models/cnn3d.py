@@ -79,6 +79,8 @@ class _StemConv(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        import os
+
         f = self.features
         kernel = self.param(
             "kernel", nn.initializers.lecun_normal(), (3, 3, 3, 1, f),
@@ -86,7 +88,10 @@ class _StemConv(nn.Module):
         )
         k = jnp.asarray(kernel, self.dtype)
         b, d, h, w, _ = x.shape
-        if d % 2 or h % 2 or w % 2:
+        # COINN_NO_S2D: operational kill-switch to the plain-conv path
+        # (identical math) should a backend mis-handle the remapped kernel
+        no_s2d = os.environ.get("COINN_NO_S2D", "").lower() not in ("", "0", "false")
+        if no_s2d or d % 2 or h % 2 or w % 2:
             return lax.conv_general_dilated(
                 x, k, (2, 2, 2), "SAME",
                 dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),

@@ -676,14 +676,29 @@ class NNTrainer:
                     f"Resuming from epoch {start_epoch}", cache.get("verbose", True)
                 )
 
+        from ..data import device_prefetch
+
         for epoch in range(start_epoch, epochs + 1):
             ep_averages, ep_metrics = self.new_averages(), self.new_metrics()
             loader = self.data_handle.get_loader(
                 "train", dataset=train_dataset, shuffle=True,
                 seed=int(cache.get("seed", 0)), epoch=epoch, drop_last=False,
             )
+            # stay a couple of batches ahead: the host→device copy of batch
+            # i+1 overlaps the compiled step on batch i; with local DP the
+            # batch lands pre-sharded over the device mesh (no re-shard hop)
+            n_dp = self._dp_device_count(int(cache.get("batch_size", 16)))
+            shard = None
+            if n_dp > 1:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                shard = NamedSharding(self._dp_mesh(n_dp), PartitionSpec("device"))
+            batches = device_prefetch(
+                iter(loader), size=int(cache.get("prefetch_batches", 2)),
+                sharding=shard,
+            )
             batch_buf = []
-            for i, batch in enumerate(loader):
+            for i, batch in enumerate(batches):
                 batch_buf.append(batch)
                 if len(batch_buf) == local_iterations:
                     aux = self.training_iteration_local(batch_buf)

@@ -37,16 +37,15 @@ def initialize_multihost(coordinator_address=None, num_processes=None,
     """
     addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     nproc = num_processes or os.environ.get("JAX_NUM_PROCESSES")
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = os.environ["JAX_PROCESS_ID"]
     if addr is None and nproc is None and process_id is None:
         if os.environ.get("TPU_WORKER_HOSTNAMES", "").count(",") == 0:
             return False  # single process: nothing to initialize
     jax.distributed.initialize(
         coordinator_address=addr,
         num_processes=int(nproc) if nproc is not None else None,
-        process_id=int(process_id) if process_id is not None else (
-            int(os.environ["JAX_PROCESS_ID"])
-            if "JAX_PROCESS_ID" in os.environ else None
-        ),
+        process_id=int(process_id) if process_id is not None else None,
     )
     return True
 

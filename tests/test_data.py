@@ -170,3 +170,32 @@ def test_batch_at_mask_tracks_dropped_samples(tmp_path):
     assert b["inputs"].shape[0] == 4
     assert b["_mask"].shape == (4,)
     assert b["_mask"][0] == 0.0 and b["_mask"].sum() == 3
+
+
+def test_device_prefetch_preserves_batches():
+    """device_prefetch yields the same batches in the same order and
+    re-raises producer exceptions in the consumer."""
+    import jax.numpy as jnp
+
+    from coinstac_dinunet_tpu.data import device_prefetch
+
+    batches = [{"inputs": np.full((4, 2), i, np.float32)} for i in range(6)]
+    got = list(device_prefetch(iter(batches), size=2))
+    assert len(got) == 6
+    for i, b in enumerate(got):
+        np.testing.assert_array_equal(np.asarray(b["inputs"]), batches[i]["inputs"])
+
+    def bad():
+        yield batches[0]
+        raise RuntimeError("loader died")
+
+    it = device_prefetch(bad(), size=2)
+    next(it)
+    try:
+        next(it)
+        raise AssertionError("expected the producer error to re-raise")
+    except RuntimeError as exc:
+        assert "loader died" in str(exc)
+
+    # size<=0 = plain pass-through
+    assert len(list(device_prefetch(iter(batches), size=0))) == 6
