@@ -64,12 +64,12 @@ class _StemConv(nn.Module):
     """Stride-2 3³ conv on a 1-channel volume, executed as its block-2
     space-to-depth reparametrization (the MLPerf ResNet conv0 trick).
 
-    A cin=1 conv pathologically underfills the TPU MXU's 128-wide
-    contraction (measured 4.3 ms of the flagship's 5.5 ms forward at
-    batch 128 · 64³): XLA pads the size-1 channel dim onto the lanes, doing
-    >100× redundant work.  Reshaping 2×2×2 input blocks into 8 channels and
-    convolving with the equivalently remapped 2³×8 kernel computes the SAME
-    function (max |Δ| ≈ 3e-7 vs the plain conv) with a 64-deep contraction.
+    A cin=1 conv underfills the TPU MXU's 128-wide contraction (XLA pads
+    the size-1 channel dim onto the lanes); reshaping 2×2×2 input blocks
+    into 8 channels and convolving with the equivalently remapped 2³×8
+    kernel computes the SAME function (max |Δ| ≈ 3e-7 vs the plain conv)
+    with a 64-deep contraction — measured −1.1 ms on the flagship step
+    (batch 128 · 64³, v5e; see docs/PERF.md).
     The parameter keeps the canonical (3,3,3,1,F) shape; odd spatial dims
     fall back to the plain conv.
     """
@@ -160,7 +160,7 @@ class VBMTrainer(COINNTrainer):
         self.nn["vbm_net"] = VBM3DNet(
             num_classes=int(self.cache.get("num_classes", 2)),
             width=int(self.cache.get("model_width", 16)),
-            dtype=jnp.dtype(self.cache.get("compute_dtype", "bfloat16")),
+            dtype=jnp.dtype(self.cache.setdefault("compute_dtype", "bfloat16")),
         )
 
     def example_inputs(self):

