@@ -39,27 +39,6 @@ class _ConvBlock(nn.Module):
         return nn.relu(x)
 
 
-def _s2d_map():
-    """(27, 64) one-hot map from the 3³ kernel taps to the block-2
-    space-to-depth kernel positions.
-
-    SAME padding for k=3, s=2 pads (0, 1), so output o reads input taps
-    2o+t, t ∈ {0,1,2}; under block-2 space-to-depth that tap lives in block
-    o + t//2 at in-block offset t%2.  Taps map to ((t//2 per dim) kernel
-    position, (t%2 per dim) input channel); the (1,1)-per-dim positions
-    stay structurally zero.
-    """
-    T = np.zeros((27, 64), np.float32)
-    for td in range(3):
-        for th in range(3):
-            for tw in range(3):
-                t = (td * 3 + th) * 3 + tw
-                pos = ((td // 2) * 2 + th // 2) * 2 + tw // 2
-                cin = (td % 2) * 4 + (th % 2) * 2 + (tw % 2)
-                T[t, pos * 8 + cin] = 1.0
-    return T
-
-
 class _StemConv(nn.Module):
     """Stride-2 3³ conv on a 1-channel volume, executed as its block-2
     space-to-depth reparametrization (the MLPerf ResNet conv0 trick).
@@ -79,7 +58,7 @@ class _StemConv(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        import os
+        from ..ops.s2d import s2d_stride2_conv, use_s2d
 
         f = self.features
         kernel = self.param(
@@ -87,23 +66,12 @@ class _StemConv(nn.Module):
             jnp.float32,
         )
         k = jnp.asarray(kernel, self.dtype)
-        b, d, h, w, _ = x.shape
         # COINN_NO_S2D: operational kill-switch to the plain-conv path
         # (identical math) should a backend mis-handle the remapped kernel
-        no_s2d = os.environ.get("COINN_NO_S2D", "").lower() not in ("", "0", "false")
-        if no_s2d or d % 2 or h % 2 or w % 2:
-            return lax.conv_general_dilated(
-                x, k, (2, 2, 2), "SAME",
-                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
-            )
-        k2 = (
-            jnp.asarray(_s2d_map(), self.dtype).T @ k.reshape(27, f)
-        ).reshape(2, 2, 2, 8, f)
-        xs = x.reshape(b, d // 2, 2, h // 2, 2, w // 2, 2, 1)
-        xs = xs.transpose(0, 1, 3, 5, 2, 4, 6, 7)
-        xs = xs.reshape(b, d // 2, h // 2, w // 2, 8)
+        if use_s2d(x.shape[1:-1], (3, 3, 3)):
+            return s2d_stride2_conv(x, k)
         return lax.conv_general_dilated(
-            xs, k2, (1, 1, 1), ((0, 1), (0, 1), (0, 1)),
+            x, k, (2, 2, 2), "SAME",
             dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
         )
 

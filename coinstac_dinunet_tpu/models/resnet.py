@@ -38,6 +38,35 @@ class _ResBlock(nn.Module):
         return nn.relu(y + residual)
 
 
+class _Stem2D(nn.Module):
+    """7×7 stride-2 stem conv on a 3-channel image, run as its block-2
+    space-to-depth reparametrization when shapes allow (cin=3 underfills
+    the MXU contraction; the remapped 4×4×12 kernel computes the identical
+    function — :mod:`..ops.s2d`).  Parameter keeps the canonical
+    ``(7, 7, cin, F)`` shape; ``COINN_NO_S2D=1`` or odd dims fall back."""
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from jax import lax
+
+        from ..ops.s2d import s2d_stride2_conv, use_s2d
+
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (7, 7, cin, self.features), jnp.float32,
+        )
+        k = jnp.asarray(kernel, self.dtype)
+        if use_s2d(x.shape[1:-1], (7, 7)):
+            return s2d_stride2_conv(x, k)
+        return lax.conv_general_dilated(
+            x, k, (2, 2), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+        )
+
+
 class ResNet18(nn.Module):
     num_classes: int = 2
     width: int = 64
@@ -49,8 +78,9 @@ class ResNet18(nn.Module):
             x = x[..., None]
         x = jnp.asarray(x, self.dtype)
         w = self.width
-        x = nn.Conv(w, (7, 7), strides=(2, 2), padding="SAME", use_bias=False,
-                    dtype=self.dtype)(x)
+        # name="Conv_0" keeps the flax param path of the plain nn.Conv stem
+        # this replaces, so checkpoints from either version interchange
+        x = _Stem2D(w, dtype=self.dtype, name="Conv_0")(x)
         x = nn.GroupNorm(num_groups=8, dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -601,12 +601,15 @@ class NNTrainer:
         return batch
 
     def _stack_batches(self, batches):
-        """[k dict batches] -> dict of (k, B, ...) arrays for lax.scan."""
+        """[k dict batches] -> dict of (k, B, ...) arrays for lax.scan.
+
+        Casts each batch BEFORE stacking so host batches cross to the device
+        already in the compute dtype (half the transfer bytes)."""
+        cast = self._input_cast_dtype()
+        if cast is not None:
+            batches = [self._cast_batch_inputs(b, cast) for b in batches]
         keys = batches[0].keys()
-        stacked = {
-            k: jnp.stack([jnp.asarray(b[k]) for b in batches]) for k in keys
-        }
-        return self._cast_batch_inputs(stacked)
+        return {k: jnp.stack([jnp.asarray(b[k]) for b in batches]) for k in keys}
 
     def training_iteration_local(self, batches):
         """One communication round locally: grad-accumulate over the batch

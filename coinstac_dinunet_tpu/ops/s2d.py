@@ -1,0 +1,135 @@
+"""Space-to-depth reparametrization of stride-2 convolutions (N-D).
+
+The MLPerf ResNet conv0 trick, generalized: a stride-2 odd-kernel SAME conv
+on a few-channel input underfills the TPU MXU's 128-wide contraction (the
+channel dim pads onto the lanes).  Rewriting the input as 2^n-blocked
+channels and convolving with an equivalently remapped kernel computes the
+IDENTICAL function with a 2^n·cin-deep contraction.
+
+Derivation (per spatial dim, kernel k odd, stride 2, even input size):
+SAME padding is ``lo=(k-2)//2, hi=k-2-lo``; output position ``o`` reads
+input index ``2o + r`` for relative tap ``r = t - lo``.  Under block-2
+space-to-depth that index lives in block ``o + floor(r/2)`` at in-block
+offset ``r mod 2``, so tap ``t`` maps to kernel position
+``p = floor(r/2) - b_min`` over blocks and channel-slot ``r mod 2``; the
+remapped conv has kernel size ``K2 = b_max - b_min + 1``, stride 1, and
+explicit padding ``(-b_min, K2 - 1 + b_min)``.  The whole remap is a fixed
+one-hot matrix applied to the canonical kernel, so the PARAMETER keeps its
+canonical ``(k,)*n + (cin, f)`` shape — checkpoints and cross-framework
+comparisons are unaffected.
+
+Reference counterpart: none (the reference's torch models rely on cuDNN's
+implicit-GEMM conv; SURVEY §2 "native layer" note).
+"""
+import functools
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.lru_cache(maxsize=None)
+def _dim_spec(k):
+    """Per-dimension tap map for kernel size ``k`` (odd), stride 2, SAME.
+
+    Returns (K2, pad, taps) where ``taps[t] = (p, slot)``: canonical tap
+    ``t`` lands at remapped kernel position ``p`` reading block-channel
+    slot ``slot``; ``pad = (lo, hi)`` is the remapped conv's explicit
+    padding."""
+    if k % 2 == 0:
+        raise ValueError(f"space-to-depth remap expects an odd kernel, got {k}")
+    lo = (k - 2) // 2 if k > 1 else 0
+    rs = [t - lo for t in range(k)]
+    bmins = [r // 2 for r in rs]
+    b_min, b_max = min(bmins), max(bmins)
+    K2 = b_max - b_min + 1
+    taps = tuple((r // 2 - b_min, r % 2) for r in rs)
+    return K2, (-b_min, K2 - 1 + b_min), taps
+
+
+@functools.lru_cache(maxsize=None)
+def s2d_kernel_map(kernel_shape, cin):
+    """One-hot matrix mapping the canonical kernel to the remapped one.
+
+    ``kernel_shape``: the spatial dims (k,)*n.  Returns (T, K2_shape, pads)
+    with ``T`` of shape ``(prod(k)·cin, prod(K2)·2^n·cin)``; the remapped
+    kernel is ``(T.T @ kernel.reshape(-1, f)).reshape(*K2_shape, 2^n·cin,
+    f)`` and the blocked input's channel slot order is
+    ``(offset_dims..., cin)`` — matching :func:`space_to_depth_nd`."""
+    n = len(kernel_shape)
+    specs = [_dim_spec(k) for k in kernel_shape]
+    K2s = tuple(s[0] for s in specs)
+    pads = tuple(s[1] for s in specs)
+    T = np.zeros((int(np.prod(kernel_shape)) * cin,
+                  int(np.prod(K2s)) * (2 ** n) * cin), np.float32)
+    for t_flat in range(int(np.prod(kernel_shape))):
+        ts, rem = [], t_flat
+        for k in reversed(kernel_shape):
+            ts.append(rem % k)
+            rem //= k
+        ts.reverse()
+        ps = [specs[d][2][ts[d]][0] for d in range(n)]
+        slots = [specs[d][2][ts[d]][1] for d in range(n)]
+        p_flat = 0
+        for d in range(n):
+            p_flat = p_flat * K2s[d] + ps[d]
+        slot_flat = 0
+        for d in range(n):
+            slot_flat = slot_flat * 2 + slots[d]
+        for c in range(cin):
+            T[t_flat * cin + c,
+              (p_flat * (2 ** n) + slot_flat) * cin + c] = 1.0
+    return T, K2s, pads
+
+
+def space_to_depth_nd(x):
+    """(B, s1..sn, C) with even spatial dims -> (B, s1/2..sn/2, 2^n·C).
+
+    Channel order: (block-offset dims major, original channel minor) — the
+    order :func:`s2d_kernel_map` emits."""
+    n = x.ndim - 2
+    b, *spatial, c = x.shape
+    shape = [b]
+    for s in spatial:
+        shape += [s // 2, 2]
+    shape += [c]
+    xs = x.reshape(shape)
+    # (B, s1/2, 2, s2/2, 2, ..., C) -> (B, s1/2.., 2(offsets).., C)
+    perm = ([0] + [1 + 2 * d for d in range(n)]
+            + [2 + 2 * d for d in range(n)] + [1 + 2 * n])
+    xs = xs.transpose(perm)
+    return xs.reshape([b] + [s // 2 for s in spatial] + [(2 ** n) * c])
+
+
+_CONV_DIMS = {
+    1: ("NHC", "HIO", "NHC"),
+    2: ("NHWC", "HWIO", "NHWC"),
+    3: ("NDHWC", "DHWIO", "NDHWC"),
+}
+
+
+def s2d_stride2_conv(x, kernel):
+    """Stride-2 SAME conv with canonical ``kernel`` ((k,)*n, cin, f), run as
+    its block-2 space-to-depth reparametrization.  Requires even spatial
+    dims and odd k; callers gate and fall back to the plain conv otherwise."""
+    n = x.ndim - 2
+    *ks, cin, f = kernel.shape
+    T, K2s, pads = s2d_kernel_map(tuple(ks), cin)
+    k2 = (jnp.asarray(T, kernel.dtype).T @ kernel.reshape(-1, f))
+    k2 = k2.reshape(*K2s, (2 ** n) * cin, f)
+    xs = space_to_depth_nd(x)
+    return lax.conv_general_dilated(
+        xs, k2, (1,) * n, pads, dimension_numbers=_CONV_DIMS[n]
+    )
+
+
+def use_s2d(x_spatial_shape, kernel_spatial_shape):
+    """True when the s2d path applies: even input dims, odd kernel, and the
+    ``COINN_NO_S2D`` kill-switch not set."""
+    import os
+
+    no = os.environ.get("COINN_NO_S2D", "").lower() not in ("", "0", "false")
+    return (not no
+            and all(s % 2 == 0 for s in x_spatial_shape)
+            and all(k % 2 == 1 for k in kernel_spatial_shape))

@@ -1,0 +1,56 @@
+"""Shared timing harness for the exp_*.py TPU measurement scripts.
+
+Methodology (docs/PERF.md): the axon relay has a 60–130 ms fence round-trip
+and ~2.5 ms per-dispatch cost that PIPELINES under device-bound work, so
+honest timings are ≥60-step host loops with one scalar fence, min of ≥3
+repeats.  And beware XLA DCE: probes must consume what they claim to
+measure (touch every grad leaf in backward probes).
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def fence(out):
+    """Host-materialize a scalar — the only honest fence on the relay."""
+    return float(np.asarray(out).ravel()[0])
+
+
+def loop_time(fn, *args, steps=60, repeats=3, warmup=3):
+    """Pipelined host-loop timing: seconds per step, min over repeats."""
+    for _ in range(warmup):
+        out = fn(*args)
+    fence(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        fence(out)
+        best = min(best, (time.perf_counter() - t0) / steps)
+    return best
+
+
+def t_once(fn, *args, repeats=5):
+    """Single-dispatch timing (dominated by fence RTT — compare, don't trust
+    absolutes)."""
+    out = fn(*args)
+    fence(out)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        fence(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def touch_grads(loss, grads):
+    """Make a value-and-grad probe DCE-proof: fold every grad leaf into the
+    returned scalar (XLA deletes the backward of a probe that only returns
+    the loss)."""
+    s = sum(jnp.sum(jnp.asarray(v, jnp.float32))
+            for v in jax.tree_util.tree_leaves(grads))
+    return loss + s * 1e-20

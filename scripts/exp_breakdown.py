@@ -1,32 +1,18 @@
-"""Reliable breakdown of the flagship step: 60-step pipelined loops.
+"""Breakdown of the flagship step: fwd / bwd / optimizer / GN / input dtype.
 
-Dispatch pipelines under device-bound work (verified batch-linear), so these
-are true device times.
+60-step pipelined loops (see scripts/_bench_util.py); backward probes touch
+every grad leaf so XLA cannot DCE the backward pass.
 """
-import time
+import os
+import sys
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 import optax
 
-
-def fence(x):
-    return float(np.asarray(x).ravel()[0])
-
-
-def loop_time(fn, *args, steps=60, repeats=3):
-    for _ in range(3):
-        out = fn(*args)
-    fence(out)
-    best = float("inf")
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            out = fn(*args)
-        fence(out)
-        best = min(best, (time.perf_counter() - t0) / steps)
-    return best
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bench_util import loop_time, touch_grads  # noqa: E402
 
 
 def main():
@@ -35,6 +21,7 @@ def main():
     batch, dhw, width = 128, 64, 16
     rng = np.random.default_rng(0)
     x32 = jnp.asarray(rng.normal(size=(batch, dhw, dhw, dhw)).astype(np.float32))
+    xb = jnp.asarray(x32, jnp.bfloat16)
     y = jnp.asarray(rng.integers(0, 2, size=batch).astype(np.int32))
 
     net = VBM3DNet(num_classes=2, width=width)
@@ -43,14 +30,21 @@ def main():
 
     def loss_fn(p, x):
         logits = net.apply(p, x)
-        ls = optax.softmax_cross_entropy_with_integer_labels(logits, y)
-        return jnp.mean(ls)
+        return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, y))
 
-    t = loop_time(jax.jit(lambda p, x: loss_fn(p, x)), params, x32)
-    print(f"fwd+loss:        {t*1e3:6.2f} ms")
+    @jax.jit
+    def fb(p, x):
+        l, g = jax.value_and_grad(loss_fn)(p, x)
+        return touch_grads(l, g)
 
-    t = loop_time(jax.jit(lambda p, x: jax.value_and_grad(loss_fn)(p, x)[0]), params, x32)
-    print(f"fwd+bwd:         {t*1e3:6.2f} ms")
+    t = loop_time(jax.jit(loss_fn), params, x32)
+    print(f"fwd (fp32 in):   {t*1e3:6.2f} ms")
+    t = loop_time(jax.jit(loss_fn), params, xb)
+    print(f"fwd (bf16 in):   {t*1e3:6.2f} ms")
+    t = loop_time(fb, params, x32)
+    print(f"fwd+bwd fp32-in: {t*1e3:6.2f} ms")
+    t = loop_time(fb, params, xb)
+    print(f"fwd+bwd bf16-in: {t*1e3:6.2f} ms")
 
     opt = optax.adam(1e-3)
     ost = jax.jit(opt.init)(params)
@@ -59,21 +53,12 @@ def main():
     def full(p, o, x):
         l, g = jax.value_and_grad(loss_fn)(p, x)
         up, o2 = opt.update(g, o, p)
-        p2 = optax.apply_updates(p, up)
-        return l, p2, o2
+        return l, optax.apply_updates(p, up), o2
 
-    def full_host(p, o, x):
-        l, p, o = full(p, o, x)
-        return l
-
-    t = loop_time(lambda: full_host(params, ost, x32))
+    t = loop_time(lambda p, o, x: full(p, o, x)[0], params, ost, xb)
     print(f"fwd+bwd+adam:    {t*1e3:6.2f} ms")
 
-    # bwd wrt params only vs also wrt input (check DCE of input grad)
-    t = loop_time(jax.jit(lambda p, x: jax.value_and_grad(loss_fn, argnums=(0, 1))(p, x)[0]), params, x32)
-    print(f"fwd+bwd(+dinput):{t*1e3:6.2f} ms")
-
-    # GN cost: model variant without GroupNorm
+    # GN ablation (bwd kept alive)
     import flax.linen as nn
     from coinstac_dinunet_tpu.models.cnn3d import _StemConv
 
@@ -82,17 +67,15 @@ def main():
 
         @nn.compact
         def __call__(self, x):
-            if x.ndim == 4:
-                x = x[..., None]
+            x = x[..., None] if x.ndim == 4 else x
             x = jnp.asarray(x, jnp.bfloat16)
             w = self.width
-            x = _StemConv(w)(x)
-            x = nn.relu(x)
+            x = nn.relu(_StemConv(w)(x))
             for f, s in [(w, 1), (2 * w, 2), (2 * w, 1), (4 * w, 2),
                          (4 * w, 1), (8 * w, 2)]:
-                x = nn.Conv(f, (3, 3, 3), strides=(s,) * 3, padding="SAME",
-                            use_bias=False, dtype=jnp.bfloat16)(x)
-                x = nn.relu(x)
+                x = nn.relu(nn.Conv(f, (3, 3, 3), strides=(s,) * 3,
+                                    padding="SAME", use_bias=False,
+                                    dtype=jnp.bfloat16)(x))
             x = jnp.mean(x, axis=(1, 2, 3))
             return nn.Dense(2, dtype=jnp.float32)(jnp.asarray(x, jnp.float32))
 
@@ -100,17 +83,18 @@ def main():
     p2 = jax.jit(m2.init)(jax.random.PRNGKey(0), x32[:1])
 
     def loss2(p, x):
-        return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(m2.apply(p, x), y))
+        return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+            m2.apply(p, x), y))
 
-    t = loop_time(jax.jit(lambda p, x: loss2(p, x)), p2, x32)
+    @jax.jit
+    def fb2(p, x):
+        l, g = jax.value_and_grad(loss2)(p, x)
+        return touch_grads(l, g)
+
+    t = loop_time(jax.jit(loss2), p2, xb)
     print(f"noGN fwd:        {t*1e3:6.2f} ms")
-    t = loop_time(jax.jit(lambda p, x: jax.value_and_grad(loss2)(p, x)[0]), p2, x32)
+    t = loop_time(fb2, p2, xb)
     print(f"noGN fwd+bwd:    {t*1e3:6.2f} ms")
-
-    # bf16 input handed straight in (kill the fp32 cast)
-    xb = jnp.asarray(x32, jnp.bfloat16)
-    t = loop_time(jax.jit(lambda p, x: jax.value_and_grad(loss_fn)(p, x)[0]), params, xb)
-    print(f"fwd+bwd bf16-in: {t*1e3:6.2f} ms")
 
 
 if __name__ == "__main__":

@@ -131,3 +131,23 @@ def test_fsv_synthetic_learnable_signal(tmp_path):
         ep = [float(tr.training_iteration_local([b])["loss"]) for b in loader]
         losses.append(np.mean(ep))
     assert losses[-1] < losses[0]
+
+
+def test_resnet_s2d_stem_equals_plain_conv():
+    """ResNet's 2-D space-to-depth stem == the plain 7×7 stride-2 SAME conv
+    on even dims; odd dims take the identical-math fallback."""
+    from jax import lax
+
+    from coinstac_dinunet_tpu.models.resnet import _Stem2D
+
+    for shape in ((16, 20), (15, 20)):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, *shape, 3), jnp.float32)
+        stem = _Stem2D(features=8, dtype=jnp.float32)
+        params = stem.init(jax.random.PRNGKey(1), x)
+        got = stem.apply(params, x)
+        want = lax.conv_general_dilated(
+            x, params["params"]["kernel"], (2, 2), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        assert got.shape == want.shape
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=3e-5)

@@ -71,3 +71,43 @@ def test_power_iteration_jits_inside_outer_jit():
 
     Br, Cr = f(B, C, jax.random.PRNGKey(0))
     assert Br.shape == (2, 8) and Cr.shape == (2, 4)
+
+
+def test_s2d_conv_matches_plain_stride2_conv():
+    """The generic N-D space-to-depth remap computes EXACTLY the stride-2
+    SAME conv for 1-D/2-D/3-D, several odd kernels and channel counts."""
+    from jax import lax
+
+    from coinstac_dinunet_tpu.ops.s2d import _CONV_DIMS, s2d_stride2_conv
+
+    cases = [
+        (1, 3, 1, (16,)),
+        (1, 5, 2, (20,)),
+        (2, 7, 3, (16, 20)),   # the ResNet stem shape class
+        (2, 3, 4, (12, 12)),
+        (2, 1, 3, (8, 10)),    # k=1 edge: pure strided subsample
+        (3, 3, 1, (8, 10, 12)),
+        (3, 5, 2, (10, 8, 10)),
+    ]
+    for n, k, cin, spatial in cases:
+        key = jax.random.PRNGKey(k * 10 + n)
+        x = jax.random.normal(key, (2, *spatial, cin), jnp.float32)
+        kern = jax.random.normal(
+            jax.random.PRNGKey(1), (*(k,) * n, cin, 5), jnp.float32
+        ) * 0.2
+        got = s2d_stride2_conv(x, kern)
+        want = lax.conv_general_dilated(
+            x, kern, (2,) * n, "SAME", dimension_numbers=_CONV_DIMS[n]
+        )
+        assert got.shape == want.shape, (n, k, cin)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=3e-5,
+            err_msg=f"ndim={n} k={k} cin={cin}",
+        )
+
+
+def test_s2d_rejects_even_kernel():
+    from coinstac_dinunet_tpu.ops.s2d import s2d_kernel_map
+
+    with pytest.raises(ValueError):
+        s2d_kernel_map((4, 4), 3)
