@@ -16,7 +16,6 @@ import numpy as np
 
 import flax.linen as nn
 import jax.numpy as jnp
-from jax import lax
 
 from ..data import COINNDataset
 from ..metrics import classification_outputs
@@ -58,22 +57,13 @@ class _StemConv(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        from ..ops.s2d import s2d_stride2_conv, use_s2d
+        from ..ops.s2d import stride2_conv
 
-        f = self.features
         kernel = self.param(
-            "kernel", nn.initializers.lecun_normal(), (3, 3, 3, 1, f),
-            jnp.float32,
+            "kernel", nn.initializers.lecun_normal(),
+            (3, 3, 3, 1, self.features), jnp.float32,
         )
-        k = jnp.asarray(kernel, self.dtype)
-        # COINN_NO_S2D: operational kill-switch to the plain-conv path
-        # (identical math) should a backend mis-handle the remapped kernel
-        if use_s2d(x.shape[1:-1], (3, 3, 3)):
-            return s2d_stride2_conv(x, k)
-        return lax.conv_general_dilated(
-            x, k, (2, 2, 2), "SAME",
-            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
-        )
+        return stride2_conv(x, jnp.asarray(kernel, self.dtype))
 
 
 class VBM3DNet(nn.Module):

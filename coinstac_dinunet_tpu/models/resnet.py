@@ -50,21 +50,13 @@ class _Stem2D(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        from jax import lax
+        from ..ops.s2d import stride2_conv
 
-        from ..ops.s2d import s2d_stride2_conv, use_s2d
-
-        cin = x.shape[-1]
         kernel = self.param(
             "kernel", nn.initializers.lecun_normal(),
-            (7, 7, cin, self.features), jnp.float32,
+            (7, 7, x.shape[-1], self.features), jnp.float32,
         )
-        k = jnp.asarray(kernel, self.dtype)
-        if use_s2d(x.shape[1:-1], (7, 7)):
-            return s2d_stride2_conv(x, k)
-        return lax.conv_general_dilated(
-            x, k, (2, 2), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
-        )
+        return stride2_conv(x, jnp.asarray(kernel, self.dtype))
 
 
 class ResNet18(nn.Module):

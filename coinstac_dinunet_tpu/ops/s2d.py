@@ -133,3 +133,15 @@ def use_s2d(x_spatial_shape, kernel_spatial_shape):
     return (not no
             and all(s % 2 == 0 for s in x_spatial_shape)
             and all(k % 2 == 1 for k in kernel_spatial_shape))
+
+
+def stride2_conv(x, kernel):
+    """Stride-2 SAME conv that takes the space-to-depth fast path when
+    shapes allow (identical math either way) — the one-call form both CNN
+    stems use.  ``kernel``: canonical ((k,)*n, cin, f)."""
+    n = x.ndim - 2
+    if use_s2d(x.shape[1:-1], kernel.shape[:-2]):
+        return s2d_stride2_conv(x, kernel)
+    return lax.conv_general_dilated(
+        x, kernel, (2,) * n, "SAME", dimension_numbers=_CONV_DIMS[n]
+    )

@@ -280,3 +280,68 @@ def test_resume_without_checkpoint_starts_fresh(tmp_path):
     t = _trainer(tmp_path, epochs=2, resume=True)
     t.train_local()  # no autosave exists yet: must not raise
     assert len(t.cache["train_log"]) == 2
+
+
+def test_shared_compiled_bucket_across_instances(tmp_path):
+    import jax
+
+    from coinstac_dinunet_tpu.models import FSVTrainer
+    """Fresh trainer instances with the same config share one compiled-step
+    bucket (the COINSTAC contract rebuilds the trainer every invocation —
+    without sharing, every federated round re-traces); different
+    trace-relevant config gets its own bucket; results are identical to an
+    unshared trainer's."""
+    cache = {"input_size": 12, "batch_size": 4, "num_classes": 2, "seed": 0,
+             "learning_rate": 1e-2, "log_dir": str(tmp_path)}
+    t1 = FSVTrainer(cache=dict(cache), state={}, data_handle=None).init_nn()
+    t2 = FSVTrainer(cache=dict(cache), state={}, data_handle=None).init_nn()
+    assert t1._compiled is t2._compiled
+
+    # volatile keys (paths, logs, counters) don't split the bucket
+    t3 = FSVTrainer(cache=dict(cache, log_dir=str(tmp_path / "other"),
+                               train_log=[1, 2], epoch=7),
+                    state={}, data_handle=None).init_nn()
+    assert t3._compiled is t1._compiled
+
+    # trace-relevant config does
+    t4 = FSVTrainer(cache=dict(cache, learning_rate=5e-4),
+                    state={}, data_handle=None).init_nn()
+    assert t4._compiled is not t1._compiled
+    t5 = FSVTrainer(cache=dict(cache, share_compiled=False),
+                    state={}, data_handle=None).init_nn()
+    assert t5._compiled is not t1._compiled
+
+    rng = np.random.default_rng(0)
+    b = {"inputs": rng.normal(size=(4, 12)).astype(np.float32),
+         "labels": rng.integers(0, 2, size=4).astype(np.int32),
+         "_mask": np.ones(4, np.float32)}
+    # t1 populates the bucket; t2 must reuse it and produce the same update
+    s1, a1 = t1.train_step(t1.train_state, t1._stack_batches([b]))
+    assert len(t2._compiled) > 0  # ("train" or ("train_dp", n))
+    s2, a2 = t2.train_step(t2.train_state, t2._stack_batches([b]))
+    s5, a5 = t5.train_step(t5.train_state, t5._stack_batches([b]))
+    for x, y in zip(jax.tree_util.tree_leaves(s2.params),
+                    jax.tree_util.tree_leaves(s5.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_shared_bucket_splits_on_architecture(tmp_path):
+    """Trainers whose architecture differs through a key the volatile filter
+    drops (hidden_sizes) still get distinct buckets — the param-tree
+    fingerprint keys the architecture directly."""
+    from coinstac_dinunet_tpu.models import FSVTrainer
+
+    cache = {"input_size": 12, "batch_size": 4, "num_classes": 2, "seed": 0,
+             "learning_rate": 1e-2, "log_dir": str(tmp_path)}
+    t1 = FSVTrainer(cache=dict(cache, hidden_sizes=(16, 8)),
+                    state={}, data_handle=None).init_nn()
+    t2 = FSVTrainer(cache=dict(cache, hidden_sizes=(8,)),
+                    state={}, data_handle=None).init_nn()
+    assert t1._compiled is not t2._compiled
+
+    # dict-valued cache entries are part of the key too
+    t3 = FSVTrainer(cache=dict(cache, loss_weights={"ce": 1.0}),
+                    state={}, data_handle=None).init_nn()
+    t4 = FSVTrainer(cache=dict(cache, loss_weights={"ce": 2.0}),
+                    state={}, data_handle=None).init_nn()
+    assert t3._compiled is not t4._compiled
