@@ -283,7 +283,7 @@ def _bench_file_round(fast):
     reference's architecture, minus the engine's own IPC overhead).  The
     counterpart number to ``round_wallclock_s_cpu_mesh``: same model, same
     site counts, CPU, so the two columns isolate the transport cost."""
-    site_counts = (2, 4) if fast else (2, 4, 8)
+    site_counts = (2, 4) if fast else (2, 4, 8, 16, 32)
     code = r"""
 import json, os, sys, time
 import numpy as np

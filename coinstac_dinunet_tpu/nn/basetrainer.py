@@ -46,17 +46,20 @@ CHECKPOINT_SOURCE = "coinstac-dinunet-tpu"
 # :meth:`NNTrainer._shared_compiled_bucket` for the key contract.
 _SHARED_COMPILED = {}
 
-# cache keys whose values never influence a trace: paths/logs/counters/state
-# blobs.  Matched as exact underscore-separated segments of the key name
-# ("log_dir" → {"log","dir"} → excluded; "model_width" → {"model","width"}
-# → kept).  Architecture knobs this filter might drop (sizes/shapes) are
-# covered separately by the param-structure fingerprint in
-# :meth:`NNTrainer._shared_compiled_bucket`.
-_VOLATILE_KEY_SEGMENTS = frozenset((
-    "log", "logs", "dir", "dirs", "path", "paths", "fold", "folds",
-    "epoch", "epochs", "best", "resume", "cursor", "seed", "state",
-    "file", "files", "scores", "verbose", "patience",
-    "mode", "modes", "phase", "split", "splits", "id", "size", "sizes",
+# The framework's own round/fold-varying bookkeeping cache keys — exact
+# names, every one verified trace-irrelevant (host-side state machine,
+# logging, checkpoint names, per-fold seeds/paths).  Leading-underscore
+# keys (internal carried state) are excluded by rule.  User cache keys are
+# NEVER dropped: an unknown key that varies per round only churns the
+# bucket key (recompiles, never a wrong program), while silently dropping
+# a trace-relevant user key could share a stale trace.
+_VOLATILE_CACHE_KEYS = frozenset((
+    "best_nn_state", "best_val_epoch", "best_val_score", "latest_nn_state",
+    "cursor", "epoch", "fold", "folds", "mode", "data_size",
+    "splits", "split_ix", "split_dir", "split_file", "split_files",
+    "skipped_sites", "global_test_metrics", "log_dir", "log_header",
+    "resume", "profile_stats", "weights_file", "train_log",
+    "validation_log", "test_log", "seed", "verbose",
 ))
 
 
@@ -141,10 +144,13 @@ class NNTrainer:
           ``hidden_sizes`` can never share a bucket — a retrace inside a
           shared bucket re-binds the FIRST trainer's closed-over model, so
           shape-driven retracing must never cross architectures;
-        - volatile cache entries (paths, logs, counters, seeds, carried
-          state blobs) never influence a trace and are excluded so the key
-          stays stable across rounds; every other JSON-serializable value
-          (scalars, lists, nested dicts) is part of the key.
+        - the framework's own volatile cache entries (paths, logs,
+          counters, seeds, carried state blobs — the exact-name list
+          ``_VOLATILE_CACHE_KEYS`` plus leading-underscore keys) never
+          influence a trace and are excluded so the key stays stable
+          across rounds; every other JSON-serializable value (scalars,
+          lists, nested dicts — including any user-added key) is part of
+          the key.
 
         ``cache['share_compiled']=False`` opts out — required for a custom
         trainer whose ``iteration`` bakes in trace-relevant state that is
@@ -159,8 +165,8 @@ class NNTrainer:
         import json
 
         def keep(k, v):
-            if any(s in _VOLATILE_KEY_SEGMENTS
-                   for s in str(k).lower().split("_")):
+            k = str(k)
+            if k in _VOLATILE_CACHE_KEYS or k.startswith("_"):
                 return False
             try:
                 json.dumps(v)

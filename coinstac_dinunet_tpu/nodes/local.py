@@ -253,6 +253,9 @@ class COINNLocal:
     # -------------------------------------------------------------- main loop
     def compute(self, mp_pool=None, trainer_cls=None, dataset_cls=None,
                 datahandle_cls=COINNDataHandle, learner_cls=None, **kw):
+        # the real engine runs each invocation in a fresh process; an
+        # on-disk compile cache makes round 2+ skip the XLA compile
+        utils.maybe_enable_compilation_cache(self.cache)
         trainer = trainer_cls(
             cache=self.cache, input=self.input, state=self.state,
             data_handle=datahandle_cls(

@@ -237,6 +237,7 @@ class COINNRemote:
 
     # -------------------------------------------------------------- main loop
     def compute(self, mp_pool=None, trainer_cls=None, reducer_cls=None, **kw):
+        utils.maybe_enable_compilation_cache(self.cache)
         trainer = trainer_cls(
             cache=self.cache, input=self.input, state=self.state,
             data_handle=EmptyDataHandle(

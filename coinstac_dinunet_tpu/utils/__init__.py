@@ -118,3 +118,48 @@ def save_scores(cache, experiment_id="", file_keys=None, log_dir=None):
             for row in rows:
                 row = row if isinstance(row, (list, tuple)) else [row]
                 f.write(",".join(str(v) for v in clean_recursive(list(row))) + "\n")
+
+
+_COMPILATION_CACHE_DIR = None
+
+
+def maybe_enable_compilation_cache(cache):
+    """Enable jax's persistent (on-disk) compilation cache when the node
+    config asks for one (``cache['compilation_cache_dir']``).
+
+    The real COINSTAC engine invokes each node entry point as a FRESH
+    process every round, so the in-process compiled-step sharing
+    (``nn.basetrainer._SHARED_COMPILED``) never gets a second hit there;
+    pointing every invocation at one on-disk cache makes round 2+ skip the
+    XLA compile (tracing still runs).  Idempotent; failures degrade to a
+    warning because the cache is purely an optimization.
+    """
+    global _COMPILATION_CACHE_DIR
+    path = (cache or {}).get("compilation_cache_dir")
+    if not path:
+        return False
+    if _COMPILATION_CACHE_DIR is not None:
+        if os.path.abspath(str(path)) != _COMPILATION_CACHE_DIR:
+            from .logger import warn
+
+            warn(
+                f"compilation cache already enabled at {_COMPILATION_CACHE_DIR}; "
+                f"ignoring {path} (jax supports one cache dir per process)"
+            )
+        return True
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(path))
+        # cache every program, however small/fast — federated rounds re-run
+        # the same handful of programs thousands of times
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _COMPILATION_CACHE_DIR = os.path.abspath(str(path))
+        return True
+    except Exception as exc:  # noqa: BLE001 — optimization only
+        from .logger import warn
+
+        warn(f"compilation cache unavailable: {exc}")
+        return False

@@ -1,0 +1,25 @@
+"""Single-site local run of the VBM computation (no engine)."""
+import os
+import sys
+
+from coinstac_dinunet_tpu.engine import SiteRunner
+from coinstac_dinunet_tpu.models import SyntheticVBMDataset, VBMTrainer
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main(workdir="./vbm_local_run"):
+    runner = SiteRunner(
+        workdir, task_id="vbm_classification", inputspec=HERE, site_index=0,
+        pretrain_args={"epochs": 3}, epochs=3,
+    )
+    for i in range(32):
+        with open(os.path.join(runner.data_dir, f"subj_{i}"), "w") as f:
+            f.write("x")
+    runner.run(VBMTrainer, dataset_cls=SyntheticVBMDataset)
+    print("train log rows:", len(runner.cache.get("train_log", [])))
+    print("validation log:", runner.cache.get("validation_log", [])[-1:])
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

@@ -1,0 +1,28 @@
+"""4-site federated simulation of the VBM computation."""
+import os
+import sys
+
+from coinstac_dinunet_tpu.engine import InProcessEngine
+from coinstac_dinunet_tpu.models import SyntheticVBMDataset, VBMTrainer
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main(workdir="./vbm_sim_run", n_sites=4):
+    eng = InProcessEngine(
+        workdir, n_sites=int(n_sites), trainer_cls=VBMTrainer,
+        dataset_cls=SyntheticVBMDataset, inputspec=HERE,
+        task_id="vbm_classification", patience=20,
+    )
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(24):
+            with open(os.path.join(d, f"subj_{i * 24 + j}"), "w") as f:
+                f.write("x")
+    eng.run(max_rounds=2000)
+    print("success:", eng.success)
+    print("global test:", eng.remote_cache.get("global_test_metrics"))
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

@@ -1,30 +1,19 @@
 """Profile the flagship VBM 3-D CNN step: where does the time go?
 
-Every timed function reduces its output to a scalar inside jit and the timer
-materializes it with np.asarray — on the axon relay backend block_until_ready
-can ack before execution, so host materialization is the only honest fence.
+Uses the shared pipelined-loop harness (scripts/_bench_util.py); the stage
+sweep reports CUMULATIVE deltas, which cancel the relay's per-dispatch
+overhead.  For the honest fwd/bwd/optimizer split, run exp_breakdown.py.
 """
-import time
+import os
+import sys
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-
-def timeit(fn, *args, steps=20, warmup=3):
-    """fn must return something whose first leaf is small; we materialize it."""
-    def fence(out):
-        leaf = jax.tree_util.tree_leaves(out)[0]
-        return float(np.asarray(leaf).ravel()[0])
-
-    for _ in range(warmup):
-        out = fn(*args)
-    fence(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    fence(out)
-    return (time.perf_counter() - t0) / steps
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bench_util import loop_time  # noqa: E402
 
 
 def main():
@@ -39,35 +28,28 @@ def main():
     trainer = VBMTrainer(cache=cache, state={}, data_handle=None)
     trainer.init_nn()
     rng = np.random.default_rng(0)
-    batch_d = {
-        "inputs": jnp.asarray(rng.normal(size=(1, batch, *shape)).astype(np.float32)),
-        "labels": jnp.asarray(rng.integers(0, 2, size=(1, batch)).astype(np.int32)),
-        "_mask": jnp.ones((1, batch), jnp.float32),
-    }
+    batch_d = trainer._stack_batches([{
+        "inputs": rng.normal(size=(batch, *shape)).astype(np.float32),
+        "labels": rng.integers(0, 2, size=batch).astype(np.int32),
+        "_mask": np.ones(batch, np.float32),
+    }])
     flat = {k: v[0] for k, v in batch_d.items()}
 
     ts = trainer.train_state
-    t_full = timeit(lambda: trainer.train_step(ts, batch_d)[1]["loss"])
+    t_full = loop_time(lambda: trainer.train_step(ts, batch_d)[1]["loss"])
     print(f"train_step: {t_full*1e3:.2f} ms  -> {batch/t_full:.0f} samples/s")
 
     params = ts.params
     model = trainer.nn["vbm_net"]
 
     fwd = jax.jit(lambda p, x: jnp.sum(model.apply(p, x)))
-    t_fwd = timeit(fwd, params["vbm_net"], flat["inputs"])
+    t_fwd = loop_time(fwd, params["vbm_net"], flat["inputs"])
     print(f"forward:    {t_fwd*1e3:.2f} ms")
 
-    def loss_fn(p):
-        it = trainer.iteration(p, flat, None)
-        return it["loss"]
-    vg = jax.jit(lambda p: jax.value_and_grad(loss_fn)(p)[0])
-    t_bwd = timeit(vg, params)
-    print(f"fwd+bwd:    {t_bwd*1e3:.2f} ms")
-
+    # cumulative stage sweep — deltas between rows cancel constant overhead
     class Trunc(nn.Module):
         width: int
         stages: int
-        use_gn: bool = True
         dtype: jnp.dtype = jnp.bfloat16
 
         @nn.compact
@@ -78,11 +60,10 @@ def main():
             w = self.width
             plan = [(w, 2), (w, 1), (2 * w, 2), (2 * w, 1),
                     (4 * w, 2), (4 * w, 1), (8 * w, 2)]
-            for i, (f, s) in enumerate(plan[: self.stages]):
+            for f, s in plan[: self.stages]:
                 x = nn.Conv(f, (3, 3, 3), strides=(s,) * 3, padding="SAME",
                             use_bias=False, dtype=self.dtype)(x)
-                if self.use_gn:
-                    x = nn.GroupNorm(num_groups=min(8, f), dtype=self.dtype)(x)
+                x = nn.GroupNorm(num_groups=min(8, f), dtype=self.dtype)(x)
                 x = nn.relu(x)
             return jnp.sum(jnp.asarray(x, jnp.float32))
 
@@ -92,17 +73,9 @@ def main():
     for nstages in range(1, 8):
         m = Trunc(width=width, stages=nstages)
         p = jax.jit(m.init)(key, x[:1])
-        t = timeit(jax.jit(m.apply), p, x)
+        t = loop_time(jax.jit(m.apply), p, x, steps=30)
         print(f"fwd stages<={nstages}: {t*1e3:.2f} ms (+{(t-prev)*1e3:.2f})")
         prev = t
-
-    m = Trunc(width=width, stages=7, use_gn=False)
-    p = jax.jit(m.init)(key, x[:1])
-    t = timeit(jax.jit(m.apply), p, x)
-    print(f"fwd no-GN:  {t*1e3:.2f} ms")
-    g_nogn = jax.jit(lambda p: jax.value_and_grad(lambda q: m.apply(q, x))(p)[0])
-    t = timeit(g_nogn, p)
-    print(f"fwd+bwd no-GN: {t*1e3:.2f} ms")
 
     flops_fwd = 0
     d = np.array(shape)

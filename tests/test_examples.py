@@ -59,3 +59,34 @@ def test_compspec_and_inputspec_are_valid_json():
     with open(os.path.join(EXAMPLE, "inputspec.json")) as f:
         ispec = json.load(f)
     assert ispec[0]["input_size"]["value"] == 66
+
+
+VBM_EXAMPLE = os.path.join(REPO, "examples", "vbm_classification")
+
+
+def test_vbm_example_sim_reaches_success(tmp_path):
+    """The VBM example's 2-site simulation runs the full federated
+    lifecycle end-to-end (volumetric model, bf16, k-fold splits)."""
+    from coinstac_dinunet_tpu.engine import InProcessEngine
+    from coinstac_dinunet_tpu.models import SyntheticVBMDataset, VBMTrainer
+
+    eng = InProcessEngine(
+        str(tmp_path), n_sites=2, trainer_cls=VBMTrainer,
+        dataset_cls=SyntheticVBMDataset, inputspec=VBM_EXAMPLE,
+        task_id="vbm_classification", epochs=2, patience=10,
+    )
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(12):
+            open(os.path.join(d, f"subj_{i * 12 + j}"), "w").write("x")
+    eng.run(max_rounds=500)
+    assert eng.success
+
+
+def test_vbm_compspec_and_inputspec_are_valid_json():
+    with open(os.path.join(VBM_EXAMPLE, "compspec.json")) as f:
+        spec = json.load(f)
+    assert spec["computation"]["command"] == ["python", "local.py"]
+    with open(os.path.join(VBM_EXAMPLE, "inputspec.json")) as f:
+        ispec = json.load(f)
+    assert ispec[0]["model_width"]["value"] == 4

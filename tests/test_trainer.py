@@ -283,14 +283,15 @@ def test_resume_without_checkpoint_starts_fresh(tmp_path):
 
 
 def test_shared_compiled_bucket_across_instances(tmp_path):
-    import jax
-
-    from coinstac_dinunet_tpu.models import FSVTrainer
     """Fresh trainer instances with the same config share one compiled-step
     bucket (the COINSTAC contract rebuilds the trainer every invocation —
     without sharing, every federated round re-traces); different
     trace-relevant config gets its own bucket; results are identical to an
     unshared trainer's."""
+    import jax
+
+    from coinstac_dinunet_tpu.models import FSVTrainer
+
     cache = {"input_size": 12, "batch_size": 4, "num_classes": 2, "seed": 0,
              "learning_rate": 1e-2, "log_dir": str(tmp_path)}
     t1 = FSVTrainer(cache=dict(cache), state={}, data_handle=None).init_nn()

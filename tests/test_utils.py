@@ -153,3 +153,22 @@ def test_phase_timer_records_through_federated_run(tmp_path):
     assert stats.get("remote:round", {}).get("calls", 0) > 0
     site0 = eng.site_caches[eng.site_ids[0]].get("profile_stats", {})
     assert any(k.startswith("local:") for k in site0)
+
+
+def test_compilation_cache_flag(tmp_path, monkeypatch):
+    """compilation_cache_dir populates an on-disk jax compile cache (the
+    fresh-process-per-invocation deployment's analogue of the in-process
+    compiled-step sharing); absent flag is a no-op."""
+    import coinstac_dinunet_tpu.utils as U
+
+    monkeypatch.setattr(U, "_COMPILATION_CACHE_DIR", None)
+    assert U.maybe_enable_compilation_cache({}) is False
+    d = tmp_path / "xla_cache"
+    enabled = U.maybe_enable_compilation_cache({"compilation_cache_dir": str(d)})
+    if not enabled:  # jax build without persistent-cache support
+        return
+    import jax
+    import jax.numpy as jnp
+
+    jax.jit(lambda x: x * 2 + 1)(jnp.arange(7)).block_until_ready()
+    assert d.exists()
