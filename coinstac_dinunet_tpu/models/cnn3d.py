@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from ..data import COINNDataset
 from ..metrics import classification_outputs
 from ..trainer import COINNTrainer
-from ..utils import stable_file_id
+from ..utils import parse_shape, stable_file_id
 
 
 class _ConvBlock(nn.Module):
@@ -105,7 +105,7 @@ class SyntheticVBMDataset(COINNDataset):
 
     def __getitem__(self, ix):
         _, file = self.indices[ix]
-        shape = tuple(self.cache.get("input_shape", (32, 32, 32)))
+        shape = parse_shape(self.cache.get("input_shape"), (32, 32, 32))
         fid = stable_file_id(file)
         rng = np.random.default_rng(fid)
         y = fid % int(self.cache.get("num_classes", 2))
@@ -122,7 +122,7 @@ class VBMTrainer(COINNTrainer):
         )
 
     def example_inputs(self):
-        shape = tuple(self.cache.get("input_shape", (32, 32, 32)))
+        shape = parse_shape(self.cache.get("input_shape"), (32, 32, 32))
         return {"vbm_net": (jnp.zeros((1, *shape), jnp.float32),)}
 
     def iteration(self, params, batch, rng=None):

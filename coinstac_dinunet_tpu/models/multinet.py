@@ -9,6 +9,7 @@ import jax.numpy as jnp
 
 from ..metrics import classification_outputs
 from ..trainer import COINNTrainer
+from ..utils import parse_shape
 from .cnn3d import VBM3DNet
 
 
@@ -24,7 +25,7 @@ class MultiNetTrainer(COINNTrainer):
         self.nn["net_b"] = VBM3DNet(num_classes=num_classes, width=width, dtype=dtype)
 
     def example_inputs(self):
-        shape = tuple(self.cache.get("input_shape", (32, 32, 32)))
+        shape = parse_shape(self.cache.get("input_shape"), (32, 32, 32))
         x = jnp.zeros((1, *shape), jnp.float32)
         return {"net_a": (x,), "net_b": (x,)}
 

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from ..data import COINNDataset
 from ..metrics import classification_outputs
 from ..trainer import COINNTrainer
-from ..utils import stable_file_id
+from ..utils import parse_shape, stable_file_id
 
 
 class _ResBlock(nn.Module):
@@ -93,7 +93,7 @@ class SyntheticImageDataset(COINNDataset):
 
     def __getitem__(self, ix):
         _, file = self.indices[ix]
-        shape = tuple(self.cache.get("input_shape", (64, 64, 3)))
+        shape = parse_shape(self.cache.get("input_shape"), (64, 64, 3))
         fid = stable_file_id(file)
         rng = np.random.default_rng(fid)
         y = fid % int(self.cache.get("num_classes", 2))
@@ -110,7 +110,7 @@ class ResNetTrainer(COINNTrainer):
         )
 
     def example_inputs(self):
-        shape = tuple(self.cache.get("input_shape", (64, 64, 3)))
+        shape = parse_shape(self.cache.get("input_shape"), (64, 64, 3))
         return {"resnet": (jnp.zeros((1, *shape), jnp.float32),)}
 
     def iteration(self, params, batch, rng=None):

@@ -100,7 +100,9 @@ class NNTrainer:
         Default: zeros of ``cache['input_shape']`` (excluding batch dim) with
         batch size 1 for every model.  Override for multi-input models.
         """
-        shape = tuple(self.cache.get("input_shape", ()))
+        from ..utils import parse_shape
+
+        shape = parse_shape(self.cache.get("input_shape"), ())
         if not shape:
             raise NotImplementedError(
                 "Provide cache['input_shape'] or override example_inputs()"

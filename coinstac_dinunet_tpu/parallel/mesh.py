@@ -108,7 +108,35 @@ class MeshFederation:
     def stack_site_batches(self, per_site_batches):
         """[site → list of k micro-batches] → pytree with leading (site, k)
         axes, placed with the step's input sharding (site-sharded, batch dim
-        split over the device axis)."""
+        split over the device axis).
+
+        Multi-process runtime (a real pod, one process per host): every
+        process calls this with the FULL cross-site batch list and only its
+        addressable shards are materialized — ``device_put`` cannot target
+        non-addressable devices, so stacking stays on the HOST (no staging
+        of the whole global batch through one device's HBM) and placement
+        goes through ``make_array_from_callback``."""
+        if jax.process_count() > 1:
+            cast = self.trainer._input_cast_dtype()
+            per_site_host = []
+            for batches in per_site_batches:
+                bs = [self.trainer._cast_batch_inputs(b, cast) if cast
+                      else b for b in batches]
+                per_site_host.append({
+                    k: np.stack([np.asarray(b[k]) for b in bs])
+                    for k in bs[0]
+                })
+            glob_h = {
+                k: np.stack([s[k] for s in per_site_host])
+                for k in per_site_host[0]
+            }
+            out = {}
+            for k, host in glob_h.items():
+                sharding = NamedSharding(self.mesh, P("site", None, "device"))
+                out[k] = jax.make_array_from_callback(
+                    host.shape, sharding, lambda idx, a=host: a[idx]
+                )
+            return out
         stacked = [self.trainer._stack_batches(b) for b in per_site_batches]
         glob = {k: jnp.stack([s[k] for s in stacked]) for k in stacked[0]}
         shardings = {

@@ -151,11 +151,14 @@ def maybe_enable_compilation_cache(cache):
         import jax
 
         os.makedirs(path, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", str(path))
-        # cache every program, however small/fast — federated rounds re-run
-        # the same handful of programs thousands of times
+        # thresholds FIRST, dir LAST: if any update raises (option renamed
+        # in some jax version), the cache is never half-enabled — an active
+        # dir with an unset sentinel would defeat the one-dir-per-process
+        # guard above.  Cache every program, however small/fast — federated
+        # rounds re-run the same handful of programs thousands of times.
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_compilation_cache_dir", str(path))
         _COMPILATION_CACHE_DIR = os.path.abspath(str(path))
         return True
     except Exception as exc:  # noqa: BLE001 — optimization only
@@ -163,3 +166,17 @@ def maybe_enable_compilation_cache(cache):
 
         warn(f"compilation cache unavailable: {exc}")
         return False
+
+
+def parse_shape(value, default=()):
+    """Normalize a shape-like config value to a tuple of ints.
+
+    Accepts a list/tuple of numbers (inputspec JSON) or a comma-separated
+    string (compspec UI ``"64,64,64"`` — COINSTAC string inputs arrive
+    verbatim).
+    """
+    if value is None:
+        value = default
+    if isinstance(value, str):
+        value = [s for s in value.replace(" ", "").split(",") if s]
+    return tuple(int(v) for v in value)

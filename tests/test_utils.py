@@ -161,14 +161,49 @@ def test_compilation_cache_flag(tmp_path, monkeypatch):
     compiled-step sharing); absent flag is a no-op."""
     import coinstac_dinunet_tpu.utils as U
 
+    import jax
+
     monkeypatch.setattr(U, "_COMPILATION_CACHE_DIR", None)
     assert U.maybe_enable_compilation_cache({}) is False
+    prev = {
+        "jax_compilation_cache_dir": jax.config.jax_compilation_cache_dir,
+        "jax_persistent_cache_min_compile_time_secs":
+            jax.config.jax_persistent_cache_min_compile_time_secs,
+        "jax_persistent_cache_min_entry_size_bytes":
+            jax.config.jax_persistent_cache_min_entry_size_bytes,
+    }
     d = tmp_path / "xla_cache"
-    enabled = U.maybe_enable_compilation_cache({"compilation_cache_dir": str(d)})
-    if not enabled:  # jax build without persistent-cache support
-        return
-    import jax
-    import jax.numpy as jnp
+    try:
+        enabled = U.maybe_enable_compilation_cache(
+            {"compilation_cache_dir": str(d)}
+        )
+        if not enabled:  # jax build without persistent-cache support
+            return
+        # second call with a DIFFERENT dir: warns + reports enabled, does
+        # not re-point the cache
+        assert U.maybe_enable_compilation_cache(
+            {"compilation_cache_dir": str(tmp_path / "other")}
+        ) is True
+        assert jax.config.jax_compilation_cache_dir == str(d)
+        import jax.numpy as jnp
 
-    jax.jit(lambda x: x * 2 + 1)(jnp.arange(7)).block_until_ready()
-    assert d.exists()
+        jax.jit(lambda x: x * 2 + 1)(jnp.arange(7)).block_until_ready()
+        assert d.exists()
+    finally:
+        # the cache config is process-global jax state — restore it so the
+        # rest of the suite doesn't silently persist every XLA program
+        for k, v in prev.items():
+            jax.config.update(k, v)
+
+
+def test_parse_shape_accepts_lists_and_comma_strings():
+    """compspec UI string inputs ("64,64,64") and inputspec JSON lists both
+    normalize to int tuples — the engine path passes strings verbatim."""
+    from coinstac_dinunet_tpu.utils import parse_shape
+
+    assert parse_shape("64,64,64") == (64, 64, 64)
+    assert parse_shape(" 64, 64 ,64 ") == (64, 64, 64)
+    assert parse_shape([16, 16, 16]) == (16, 16, 16)
+    assert parse_shape((8.0, 8.0)) == (8, 8)
+    assert parse_shape(None, (32, 32, 32)) == (32, 32, 32)
+    assert parse_shape(None) == ()
