@@ -87,7 +87,41 @@ class NNTrainer:
         self.nn = {}  # name -> flax Module
         self.optimizer = {}  # name -> optax GradientTransformation
         self.train_state: TrainState = None
-        self._compiled = {}
+        self._own_compiled = {}  # per-instance fallback (sharing off/not yet bindable)
+        self._shared_bucket = None
+        self._share_opt_out = False  # set by the _compiled setter (overrides)
+
+    @property
+    def _compiled(self):
+        """Compiled-step cache — binds to the process-wide shared bucket
+        LAZILY, at first use after the param tree exists.  The node state
+        machine restores a carried train state AFTER a partial
+        ``init_nn(init_weights=False, init_optimizer=False)``, so binding
+        eagerly at init time would (and once did) silently fall back to an
+        unshared per-instance cache on the steady-state federated path and
+        re-compile every round."""
+        if self._shared_bucket is not None:
+            return self._shared_bucket
+        if self._share_opt_out or not self.cache.get("share_compiled", True):
+            return self._own_compiled
+        params = (self.train_state.params if self.train_state is not None
+                  else getattr(self, "_params", None))
+        if params is None:  # architecture not fingerprintable yet
+            return self._own_compiled
+        self._shared_bucket = self._shared_compiled_bucket(params)
+        return self._shared_bucket
+
+    @_compiled.setter
+    def _compiled(self, value):
+        """Replace the compiled cache (tests / instance-level overrides).
+        Assignment opts THIS INSTANCE out of bucket sharing — an
+        instance-level override (e.g. a monkeypatched ``iteration``) must
+        never trace into, or read from, the shared bucket.  The opt-out is
+        an instance attribute, not a cache write: the cache is the node's
+        persisted state and outlives this trainer."""
+        self._share_opt_out = True
+        self._own_compiled = dict(value)
+        self._shared_bucket = None
 
     # ------------------------------------------------------------------ hooks
     def _init_nn_model(self):
@@ -129,7 +163,7 @@ class NNTrainer:
         return COINNAverages(num_averages=int(self.cache.get("num_averages", 1)))
 
     # ------------------------------------------------------------ init / state
-    def _shared_compiled_bucket(self):
+    def _shared_compiled_bucket(self, params):
         """Process-wide bucket of compiled step functions for this trainer
         configuration — so the fresh trainer each engine invocation builds
         reuses the previous round's traces instead of recompiling.
@@ -162,8 +196,6 @@ class NNTrainer:
         Lifetime note: a bucket's compiled functions keep the trainer that
         traced them (and whatever it references) alive for the process —
         the cache is process-lifetime by design, like jax's own jit cache."""
-        if not self.cache.get("share_compiled", True):
-            return {}
         import json
 
         def keep(k, v):
@@ -176,10 +208,6 @@ class NNTrainer:
             except TypeError:
                 return False
 
-        params = (self.train_state.params if self.train_state is not None
-                  else getattr(self, "_params", None))
-        if params is None:  # architecture unknowable -> don't share
-            return {}
         fingerprint = tuple(
             (jax.tree_util.keystr(path), tuple(leaf.shape), str(leaf.dtype))
             for path, leaf in jax.tree_util.tree_leaves_with_path(params)
@@ -196,7 +224,10 @@ class NNTrainer:
         return _SHARED_COMPILED.setdefault(key, {})
 
     def init_nn(self, init_models=True, init_weights=True, init_optimizer=True):
-        self._compiled = {}
+        # drop any bucket binding: the config (learning rate, dtype, width)
+        # may have changed — the _compiled property re-binds on next use
+        self._own_compiled = {}
+        self._shared_bucket = None
         if init_models:
             self._init_nn_model()
         if init_weights:
@@ -204,12 +235,6 @@ class NNTrainer:
         if init_optimizer:
             self._init_optimizer()
             self._init_train_state()
-        # bind the compiled-function bucket for the (now fully resolved)
-        # config — after _init_nn_model so defaults it writes into the cache
-        # (e.g. compute_dtype) are part of the key: a changed learning rate /
-        # dtype / width lands in a fresh bucket, an unchanged config reuses
-        # earlier traces
-        self._compiled = self._shared_compiled_bucket()
         return self
 
     def _init_nn_weights(self):

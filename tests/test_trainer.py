@@ -346,3 +346,21 @@ def test_shared_bucket_splits_on_architecture(tmp_path):
     t4 = FSVTrainer(cache=dict(cache, loss_weights={"ce": 2.0}),
                     state={}, data_handle=None).init_nn()
     assert t3._compiled is not t4._compiled
+
+
+def test_shared_bucket_binds_after_partial_init_restore(tmp_path):
+    """The steady-state node path does a partial init_nn then assigns the
+    carried train state; the bucket must bind lazily at first use (binding
+    eagerly at init once silently disabled sharing on the hot federated
+    path and recompiled every round)."""
+    from coinstac_dinunet_tpu.models import FSVTrainer
+
+    cache = {"input_size": 12, "batch_size": 4, "num_classes": 2, "seed": 0,
+             "learning_rate": 1e-2, "log_dir": str(tmp_path)}
+    t1 = FSVTrainer(cache=dict(cache), state={}, data_handle=None).init_nn()
+    # the node's restore-from-cache sequence (nodes/local.py COMPUTATION)
+    t2 = FSVTrainer(cache=dict(cache), state={}, data_handle=None)
+    t2.init_nn(init_weights=False, init_optimizer=False)
+    t2._init_optimizer()
+    t2.train_state = t1.train_state
+    assert t2._compiled is t1._compiled
