@@ -396,8 +396,16 @@ def main():
         print(f"# torch baseline failed: {exc}", file=sys.stderr)
         base = None
     vs = round(ours / base, 3) if (ours and base) else None
-    scaling = _bench_round_scaling(fast)
-    file_rounds = _bench_file_round(fast)
+    try:
+        scaling = _bench_round_scaling(fast)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# round-scaling failed: {exc}", file=sys.stderr)
+        scaling = None
+    try:
+        file_rounds = _bench_file_round(fast)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# file-round failed: {exc}", file=sys.stderr)
+        file_rounds = None
 
     flagship = configs.get("vbm3d_cnn_8site", {})
     print(json.dumps({

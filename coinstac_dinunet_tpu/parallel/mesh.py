@@ -165,16 +165,49 @@ class MeshFederation:
             errors.append(jnp.zeros((self.n_sites, *m), jnp.float32))
             q = seeded_Q(seed, j, m[1], rank)
             qs.append(jnp.tile(q[None], (self.n_sites, 1, 1)))
-        self.comm_state = {"errors": errors, "qs": qs}
+        self.comm_state = self._place_site_sharded({"errors": errors, "qs": qs})
         return self.comm_state
+
+    def _place_site_sharded(self, tree):
+        """Place leading-``site``-axis state as a global sharded array.
+
+        Single-process: a no-op (the jitted step reshards on entry).
+        Multi-process: every process holds the identical host value
+        (deterministic zeros + seeded Qs) and materializes only its
+        addressable site rows — uncommitted local arrays cannot enter a
+        multi-controller jit under a site-sharded spec."""
+        if jax.process_count() <= 1:
+            return tree
+
+        def place(x):
+            host = np.asarray(jax.device_get(x))
+            s = NamedSharding(
+                self.mesh, P(*(["site"] + [None] * (host.ndim - 1)))
+            )
+            return jax.make_array_from_callback(
+                host.shape, s, lambda idx, a=host: a[idx]
+            )
+
+        return jax.tree_util.tree_map(place, tree)
 
     def serialize_comm_state(self):
         """Host-side snapshot of the carried engine state (PowerSGD EF
         memory + warm-started Qs, with their leading site axis) + the
         warm-up round counter — what a mesh-run resume point must carry."""
-        comm = jax.tree_util.tree_map(
-            lambda x: np.asarray(jax.device_get(x)), self.comm_state
-        )
+        def host_value(x):
+            arr = jnp.asarray(x)
+            if (jax.process_count() > 1
+                    and not getattr(arr, "is_fully_addressable", True)):
+                # site-sharded global array: reassemble the full value from
+                # every process's addressable rows (device_get would raise)
+                from jax.experimental import multihost_utils
+
+                return np.asarray(
+                    multihost_utils.process_allgather(arr, tiled=True)
+                )
+            return np.asarray(jax.device_get(arr))
+
+        comm = jax.tree_util.tree_map(host_value, self.comm_state)
         return {"comm": comm, "rounds_done": int(self.rounds_done)}
 
     def restore_comm_state(self, payload):
@@ -187,12 +220,12 @@ class MeshFederation:
                 rank=int(self.trainer.cache.get("matrix_approximation_rank", 1)),
                 seed=int(self.trainer.cache.get("seed", 0)),
             )
-            self.comm_state = {
+            self.comm_state = self._place_site_sharded({
                 "errors": [jnp.asarray(np.asarray(e), jnp.float32)
                            for e in _aslist(comm.get("errors"))],
                 "qs": [jnp.asarray(np.asarray(q), jnp.float32)
                        for q in _aslist(comm.get("qs"))],
-            }
+            })
 
     # ------------------------------------------------------- rankDAD plumbing
     def init_rankdad_plan(self, site_batch):

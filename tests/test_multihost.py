@@ -66,33 +66,42 @@ def _free_port():
     return port
 
 
-def test_two_process_site_mesh_psum():
+def _run_two_process_workers(worker_src, device_count):
+    """Spawn two workers on a fresh coordinator port with ``device_count``
+    forced local CPU devices each; returns each worker's "WORKER_OK <i> ..."
+    payload (asserting rc 0 and marker presence)."""
+    import re
+
     port = _free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", "")).strip()
     env["XLA_FLAGS"] = (
-        env.get("XLA_FLAGS", "").replace(
-            "--xla_force_host_platform_device_count=8", ""
-        ).strip()
-        + " --xla_force_host_platform_device_count=2"
+        flags + f" --xla_force_host_platform_device_count={device_count}"
     ).strip()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("PALLAS_AXON_POOL_IPS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", WORKER, str(i), "2", str(port)],
+            [sys.executable, "-c", worker_src, str(i), "2", str(port)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         )
         for i in range(2)
     ]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=240)
-        outs.append(out)
+    outs = [p.communicate(timeout=240)[0] for p in procs]
+    marks = []
     for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {i}:\n{out[-2000:]}"
-        assert f"WORKER_OK {i}" in out
+        assert p.returncode == 0, f"worker {i}:\n{out[-2500:]}"
+        lines = [l for l in out.splitlines() if l.startswith(f"WORKER_OK {i}")]
+        assert lines, out[-500:]
+        marks.append(lines[0].split(" ", 2)[2] if " " in lines[0][10:] else "")
+    return marks
+
+
+def test_two_process_site_mesh_psum():
+    _run_two_process_workers(WORKER, device_count=2)
 
 
 FED_WORKER = r"""
@@ -140,34 +149,55 @@ def test_two_process_mesh_federation_round():
     """A REAL cross-process federated round: 2 OS processes, 2 sites x 2
     devices, MeshFederation's compiled dSGD step with the gradient mean
     crossing the process boundary; losses must fall and stay in lockstep."""
-    port = _free_port()
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (
-        env.get("XLA_FLAGS", "").replace(
-            "--xla_force_host_platform_device_count=8", ""
-        ).strip()
-        + " --xla_force_host_platform_device_count=2"
-    ).strip()
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", FED_WORKER, str(i), "2", str(port)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=240)
-        outs.append(out)
-    marks = []
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {i}:\n{out[-2000:]}"
-        line = [l for l in out.splitlines() if l.startswith(f"WORKER_OK {i}")]
-        assert line, out[-500:]
-        marks.append(line[0].split(" ", 2)[2])
-    # both processes observed the identical losses and updated params
+    marks = _run_two_process_workers(FED_WORKER, device_count=2)
+    # both processes observed identical losses and updated params
+    assert marks[0] == marks[1], marks
+
+
+PSGD_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+from coinstac_dinunet_tpu.parallel import hosts
+
+hosts.initialize_multihost(f"127.0.0.1:{port}", n, pid)
+
+import numpy as np
+from coinstac_dinunet_tpu.models import FSVTrainer
+from coinstac_dinunet_tpu.parallel.mesh import MeshFederation
+
+cache = {"input_size": 10, "batch_size": 8, "num_classes": 2, "seed": 0,
+         "learning_rate": 1e-2, "compute_dtype": "float32",
+         "local_data_parallel": False, "share_compiled": False,
+         "matrix_approximation_rank": 1, "start_powerSGD_iter": 1}
+tr = FSVTrainer(cache=cache, state={}, data_handle=None)
+tr.init_nn()
+fed = MeshFederation(tr, n_sites=n, devices_per_site=1,
+                     agg_engine="powerSGD")
+rng = np.random.default_rng(0)
+per_site = [[{"inputs": rng.normal(size=(8, 10)).astype(np.float32),
+              "labels": rng.integers(0, 2, size=8).astype(np.int32),
+              "_mask": np.ones(8, np.float32)}] for _ in range(n)]
+losses = []
+for _ in range(4):  # round 1 = dSGD warm-up, then compressed rounds
+    aux = fed.train_step(per_site)
+    losses.append(float(np.asarray(jax.device_get(aux["loss"]))))
+assert all(np.isfinite(l) for l in losses), losses
+assert losses[-1] < losses[0], losses
+# the autosave path must reassemble the site-sharded EF state cross-process
+snap = fed.serialize_comm_state()
+e0 = np.asarray(snap["comm"]["errors"][0])
+assert e0.shape[0] == n, e0.shape
+print(f"WORKER_OK {pid} losses={['%.6f' % l for l in losses]} "
+      f"ef={float(np.abs(e0).sum()):.6f}", flush=True)
+"""
+
+
+def test_two_process_mesh_powersgd():
+    """PowerSGD on the mesh transport across two OS processes: the P/Q
+    collectives and site-sharded error-feedback state cross the process
+    boundary (warm-up round included)."""
+    marks = _run_two_process_workers(PSGD_WORKER, device_count=1)
     assert marks[0] == marks[1], marks
