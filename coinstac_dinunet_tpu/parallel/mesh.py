@@ -158,13 +158,17 @@ class MeshFederation:
 
         leaves = jax.tree_util.tree_leaves(self.trainer.train_state.params)
         self._hi_ix = tuple(i for i, l in enumerate(leaves) if l.ndim >= 2)
+        # build on the HOST: under a multi-process runtime the full global
+        # state must never transit one device's HBM (the same rationale as
+        # stack_site_batches); _place_site_sharded materializes only the
+        # addressable site rows
         errors, qs = [], []
         for j, i in enumerate(self._hi_ix):
             leaf = leaves[i]
             m = (leaf.shape[0], int(np.prod(leaf.shape[1:])))
-            errors.append(jnp.zeros((self.n_sites, *m), jnp.float32))
-            q = seeded_Q(seed, j, m[1], rank)
-            qs.append(jnp.tile(q[None], (self.n_sites, 1, 1)))
+            errors.append(np.zeros((self.n_sites, *m), np.float32))
+            q = np.asarray(seeded_Q(seed, j, m[1], rank))
+            qs.append(np.tile(q[None], (self.n_sites, 1, 1)))
         self.comm_state = self._place_site_sharded({"errors": errors, "qs": qs})
         return self.comm_state
 
@@ -221,9 +225,9 @@ class MeshFederation:
                 seed=int(self.trainer.cache.get("seed", 0)),
             )
             self.comm_state = self._place_site_sharded({
-                "errors": [jnp.asarray(np.asarray(e), jnp.float32)
+                "errors": [np.asarray(e, np.float32)
                            for e in _aslist(comm.get("errors"))],
-                "qs": [jnp.asarray(np.asarray(q), jnp.float32)
+                "qs": [np.asarray(q, np.float32)
                        for q in _aslist(comm.get("qs"))],
             })
 

@@ -104,7 +104,10 @@ def test_two_process_site_mesh_psum():
     _run_two_process_workers(WORKER, device_count=2)
 
 
-FED_WORKER = r"""
+# One worker template for every engine: only the cache/engine/mesh/extra
+# fragments vary.  _run_two_process_workers parses the WORKER_OK line, so
+# the output format lives in exactly one place.
+WORKER_TEMPLATE = r"""
 import os, sys
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -121,27 +124,46 @@ from coinstac_dinunet_tpu.parallel.mesh import MeshFederation
 cache = {"input_size": 10, "batch_size": 8, "num_classes": 2, "seed": 0,
          "learning_rate": 1e-2, "compute_dtype": "float32",
          "local_data_parallel": False, "share_compiled": False}
+cache.update(__CACHE_EXTRA__)
 tr = FSVTrainer(cache=cache, state={}, data_handle=None)
 tr.init_nn()  # same seed in every process -> identical replicas
-
-mesh = hosts.host_aligned_site_mesh(n_sites=n)
-fed = MeshFederation(tr, n_sites=n, devices=mesh.devices.ravel(),
-                     devices_per_site=mesh.devices.shape[1])
+__MESH_SETUP__
 rng = np.random.default_rng(0)  # identical global data in every process
 per_site = [[{"inputs": rng.normal(size=(8, 10)).astype(np.float32),
               "labels": rng.integers(0, 2, size=8).astype(np.int32),
               "_mask": np.ones(8, np.float32)}] for _ in range(n)]
 losses = []
-for _ in range(3):
+for _ in range(__ROUNDS__):
     aux = fed.train_step(per_site)
     losses.append(float(np.asarray(jax.device_get(aux["loss"]))))
 assert all(np.isfinite(l) for l in losses), losses
 assert losses[-1] < losses[0], losses  # the federated update learns
+extra = ""
+__EXTRA__
+print(f"WORKER_OK {pid} losses={['%.6f' % l for l in losses]}" + extra,
+      flush=True)
+"""
+
+
+def _worker(cache_extra="{}", mesh_setup=None, rounds=3, extra=""):
+    mesh_setup = mesh_setup or (
+        "fed = MeshFederation(tr, n_sites=n, devices_per_site=1)"
+    )
+    return (WORKER_TEMPLATE
+            .replace("__CACHE_EXTRA__", cache_extra)
+            .replace("__MESH_SETUP__", mesh_setup)
+            .replace("__ROUNDS__", str(rounds))
+            .replace("__EXTRA__", extra))
+
+
+FED_WORKER_SETUP = """mesh = hosts.host_aligned_site_mesh(n_sites=n)
+fed = MeshFederation(tr, n_sites=n, devices=mesh.devices.ravel(),
+                     devices_per_site=mesh.devices.shape[1])"""
+
+FED_EXTRA = """
 # params stay replicated: every process sees the same updated leaf
 leaf = jax.tree_util.tree_leaves(tr.train_state.params)[0]
-print(f"WORKER_OK {pid} loss0={losses[0]:.6f} lossN={losses[-1]:.6f} "
-      f"p0={float(np.asarray(leaf.addressable_shards[0].data).ravel()[0]):.8f}",
-      flush=True)
+extra = " p0=%.8f" % float(np.asarray(leaf.addressable_shards[0].data).ravel()[0])
 """
 
 
@@ -149,49 +171,19 @@ def test_two_process_mesh_federation_round():
     """A REAL cross-process federated round: 2 OS processes, 2 sites x 2
     devices, MeshFederation's compiled dSGD step with the gradient mean
     crossing the process boundary; losses must fall and stay in lockstep."""
-    marks = _run_two_process_workers(FED_WORKER, device_count=2)
-    # both processes observed identical losses and updated params
+    marks = _run_two_process_workers(
+        _worker(mesh_setup=FED_WORKER_SETUP, extra=FED_EXTRA),
+        device_count=2,
+    )
     assert marks[0] == marks[1], marks
 
 
-PSGD_WORKER = r"""
-import os, sys
-import jax
-jax.config.update("jax_platforms", "cpu")
-pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
-
-from coinstac_dinunet_tpu.parallel import hosts
-
-hosts.initialize_multihost(f"127.0.0.1:{port}", n, pid)
-
-import numpy as np
-from coinstac_dinunet_tpu.models import FSVTrainer
-from coinstac_dinunet_tpu.parallel.mesh import MeshFederation
-
-cache = {"input_size": 10, "batch_size": 8, "num_classes": 2, "seed": 0,
-         "learning_rate": 1e-2, "compute_dtype": "float32",
-         "local_data_parallel": False, "share_compiled": False,
-         "matrix_approximation_rank": 1, "start_powerSGD_iter": 1}
-tr = FSVTrainer(cache=cache, state={}, data_handle=None)
-tr.init_nn()
-fed = MeshFederation(tr, n_sites=n, devices_per_site=1,
-                     agg_engine="powerSGD")
-rng = np.random.default_rng(0)
-per_site = [[{"inputs": rng.normal(size=(8, 10)).astype(np.float32),
-              "labels": rng.integers(0, 2, size=8).astype(np.int32),
-              "_mask": np.ones(8, np.float32)}] for _ in range(n)]
-losses = []
-for _ in range(4):  # round 1 = dSGD warm-up, then compressed rounds
-    aux = fed.train_step(per_site)
-    losses.append(float(np.asarray(jax.device_get(aux["loss"]))))
-assert all(np.isfinite(l) for l in losses), losses
-assert losses[-1] < losses[0], losses
+PSGD_EXTRA = """
 # the autosave path must reassemble the site-sharded EF state cross-process
 snap = fed.serialize_comm_state()
 e0 = np.asarray(snap["comm"]["errors"][0])
 assert e0.shape[0] == n, e0.shape
-print(f"WORKER_OK {pid} losses={['%.6f' % l for l in losses]} "
-      f"ef={float(np.abs(e0).sum()):.6f}", flush=True)
+extra = " ef=%.6f" % float(np.abs(e0).sum())
 """
 
 
@@ -199,5 +191,26 @@ def test_two_process_mesh_powersgd():
     """PowerSGD on the mesh transport across two OS processes: the P/Q
     collectives and site-sharded error-feedback state cross the process
     boundary (warm-up round included)."""
-    marks = _run_two_process_workers(PSGD_WORKER, device_count=1)
+    marks = _run_two_process_workers(
+        _worker(
+            cache_extra='{"matrix_approximation_rank": 1, "start_powerSGD_iter": 1}',
+            mesh_setup='fed = MeshFederation(tr, n_sites=n, devices_per_site=1, agg_engine="powerSGD")',
+            rounds=4, extra=PSGD_EXTRA,
+        ),
+        device_count=1,
+    )
+    assert marks[0] == marks[1], marks
+
+
+def test_two_process_mesh_rankdad():
+    """rankDAD on the mesh transport across two OS processes: the
+    all_gather of per-site (grad, activation) factors crosses the process
+    boundary; losses fall and stay in lockstep."""
+    marks = _run_two_process_workers(
+        _worker(
+            cache_extra='{"dad_reduction_rank": 4, "dad_num_pow_iters": 5}',
+            mesh_setup='fed = MeshFederation(tr, n_sites=n, devices_per_site=1, agg_engine="rankDAD")',
+        ),
+        device_count=1,
+    )
     assert marks[0] == marks[1], marks
