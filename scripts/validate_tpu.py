@@ -1,0 +1,88 @@
+"""One-shot TPU validation of every round-3 perf lever.
+
+Run on real hardware: A/Bs the space-to-depth stems (3-D flagship and
+ResNet-18), the staging-time input cast, and reports the final flagship
+step (the bench headline).  Each variant runs in its own subprocess so
+env-gated trace decisions bind cleanly.  Prints one JSON line per
+measurement.
+"""
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STEP = r"""
+import json, sys, time
+import numpy as np
+model, batch = sys.argv[1], int(sys.argv[2])
+from coinstac_dinunet_tpu.models import ResNetTrainer, VBMTrainer
+if model == "vbm":
+    cache = {"input_shape": (64, 64, 64), "model_width": 16, "batch_size": batch}
+    cls, shape, ch = VBMTrainer, (64, 64, 64), None
+else:
+    cache = {"input_shape": (64, 64, 3), "model_width": 64, "batch_size": batch}
+    cls, shape, ch = ResNetTrainer, (64, 64), 3
+cache.update({"num_classes": 2, "seed": 0, "learning_rate": 1e-3,
+              "compute_dtype": "bfloat16", "local_data_parallel": False})
+if len(sys.argv) > 3 and sys.argv[3] == "nocast":
+    cache["cast_inputs"] = False
+t = cls(cache=cache, state={}, data_handle=None)
+t.init_nn()
+rng = np.random.default_rng(0)
+size = (batch, *shape) if ch is None else (batch, *shape, ch)
+b = {"inputs": rng.normal(size=size).astype(np.float32),
+     "labels": rng.integers(0, 2, size=batch).astype(np.int32),
+     "_mask": np.ones(batch, np.float32)}
+stacked = t._stack_batches([b])
+ts = t.train_state
+for _ in range(3):
+    ts, aux = t.train_step(ts, stacked)
+float(np.asarray(aux["loss"]))
+best, steps = 1e9, 60
+for _ in range(3):
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        ts, aux = t.train_step(ts, stacked)
+    float(np.asarray(aux["loss"]))
+    best = min(best, (time.perf_counter() - t0) / steps)
+print(json.dumps({"ms_per_step": round(best * 1e3, 3),
+                  "samples_per_sec": round(batch / best, 1)}))
+"""
+
+
+def run(tag, args, no_s2d=False):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if no_s2d:
+        env["COINN_NO_S2D"] = "1"
+    else:
+        env.pop("COINN_NO_S2D", None)
+    res = None
+    try:
+        res = subprocess.run([sys.executable, "-c", STEP, *args], env=env,
+                             capture_output=True, text=True, timeout=900)
+        out = json.loads(res.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # noqa: BLE001
+        err = {"measure": tag, "error": str(exc)[:200]}
+        if res is not None:
+            err["rc"] = res.returncode
+            err["stderr_tail"] = res.stderr[-500:]
+        print(json.dumps(err))
+        return
+    print(json.dumps({"measure": tag, **out}))
+
+
+def main():
+    # flagship: final config, then each lever toggled off
+    run("vbm_final", ["vbm", "128"])
+    run("vbm_no_s2d", ["vbm", "128"], no_s2d=True)
+    run("vbm_no_cast", ["vbm", "128", "nocast"])
+    # ResNet-18 (config 4): 2-D s2d stem on/off
+    run("resnet_final", ["resnet", "256"])
+    run("resnet_no_s2d", ["resnet", "256"], no_s2d=True)
+
+
+if __name__ == "__main__":
+    main()
