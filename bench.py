@@ -366,6 +366,25 @@ def _bench_torch_cpu(shape, batch, width, steps=3):
     return steps * batch / dt
 
 
+def _watchdog(seconds, what):
+    """Abort with a clear record instead of hanging forever: the relayed
+    TPU backend's device claim can block indefinitely when the pool is
+    wedged, which would otherwise eat the driver's whole timeout with no
+    diagnostic.  Returns an Event to set when the guarded phase is done."""
+    import threading
+
+    done = threading.Event()
+
+    def check():
+        if not done.wait(seconds):
+            print(f"# {what} did not finish within {seconds}s; aborting",
+                  file=sys.stderr, flush=True)
+            os._exit(3)
+
+    threading.Thread(target=check, daemon=True).start()
+    return done
+
+
 def main():
     fast = bool(os.environ.get("COINN_BENCH_FAST"))
     shape = (24, 24, 24) if fast else (64, 64, 64)
@@ -375,9 +394,11 @@ def main():
     width = 8 if fast else 16
     steps = 5 if fast else 60
 
+    guard = _watchdog(900, "backend init (jax.devices)")
     import jax
 
     n_dev = len(jax.devices())
+    guard.set()
     peak = _peak_flops()
     configs = _bench_configs(fast, peak)
     ours = None
