@@ -7,6 +7,7 @@ SUCCESS lifecycle, exchanging JSON control messages and wire files.  The same
 vocabulary drives the in-process simulator (:mod:`..engine`) and an external
 COINSTAC-style engine.
 """
+from ..config.keys import GatherMode
 
 
 def check(logic, k, v, inputs):
@@ -17,17 +18,21 @@ def check(logic, k, v, inputs):
     ) if inputs else False
 
 
-def gather(keys, dicts, mode="append"):
+def gather(keys, dicts, mode=GatherMode.APPEND):
     """Collect ``keys`` across a list of dicts (≙ ref ``_gather``,
-    ``remote.py:29-48``): 'append' keeps one entry per dict, 'extend'
-    flattens list values."""
+    ``remote.py:29-48``): APPEND keeps one entry per dict, EXTEND flattens
+    list values.  ``mode`` is a :class:`~..config.keys.GatherMode` (the
+    reference defines the enum but passes raw strings — ``config/keys.py:
+    47-49`` vs ``remote.py:30``, SURVEY §2 defects); plain strings still
+    work for wire compatibility."""
+    mode = GatherMode(mode)
     out = {k: [] for k in keys}
     for d in dicts:
         for k in keys:
             v = d.get(k)
             if v is None:
                 continue
-            if mode == "extend" and isinstance(v, list):
+            if mode is GatherMode.EXTEND and isinstance(v, list):
                 out[k].extend(v)
             else:
                 out[k].append(v)

@@ -21,7 +21,7 @@ import shutil
 import traceback
 
 from .. import config, utils
-from ..config.keys import AggEngine, Key, Mode, Phase
+from ..config.keys import AggEngine, GatherMode, Key, Mode, Phase
 from ..data import EmptyDataHandle
 from ..parallel import COINNReducer, DADReducer, PowerSGDReducer
 from ..utils.logger import lazy_debug
@@ -103,17 +103,17 @@ class COINNRemote:
 
     def _reduce_serialized(self, trainer, payloads):
         """Exact cross-site reduction of serialized {averages, metrics}."""
-        pairs = gather(["averages", "metrics"], payloads, "append")
+        pairs = gather(["averages", "metrics"], payloads, GatherMode.APPEND)
         averages = trainer.new_averages().reduce_sites(pairs["averages"])
         metrics = trainer.new_metrics().reduce_sites(pairs["metrics"])
         return averages, metrics
 
     def _accumulate_epoch_info(self, trainer):
         train = gather(
-            [Key.TRAIN_SERIALIZABLE.value], self.input.values(), "extend"
+            [Key.TRAIN_SERIALIZABLE.value], self.input.values(), GatherMode.EXTEND
         )[Key.TRAIN_SERIALIZABLE.value]
         val = gather(
-            [Key.VALIDATION_SERIALIZABLE.value], self.input.values(), "extend"
+            [Key.VALIDATION_SERIALIZABLE.value], self.input.values(), GatherMode.EXTEND
         )[Key.VALIDATION_SERIALIZABLE.value]
         t_avg, t_met = self._reduce_serialized(trainer, train)
         v_avg, v_met = self._reduce_serialized(trainer, val)
@@ -154,7 +154,7 @@ class COINNRemote:
     def _on_run_end(self, trainer):
         """Fold finished: reduce + persist its test scores (≙ ref ``:147-172``)."""
         test = gather(
-            [Key.TEST_SERIALIZABLE.value], self.input.values(), "extend"
+            [Key.TEST_SERIALIZABLE.value], self.input.values(), GatherMode.EXTEND
         )[Key.TEST_SERIALIZABLE.value]
         averages, metrics = self._reduce_serialized(trainer, test)
         self.cache[Key.TEST_METRICS.value].append(

@@ -201,3 +201,17 @@ def test_remote_reduces_counts_exactly(tmp_path):
     logs = json.load(open(os.path.join(
         eng.remote_state["outputDirectory"], "xor", "fold_0", "logs.json")))
     assert "validation_log" in logs
+
+
+def test_gather_modes():
+    """gather accepts GatherMode enums AND raw wire strings (the reference
+    defines the enum but never uses it — SURVEY §2 defects)."""
+    from coinstac_dinunet_tpu.config.keys import GatherMode
+    from coinstac_dinunet_tpu.nodes import gather
+
+    dicts = [{"a": [1, 2], "b": 5}, {"a": [3], "b": 6}, {"c": 7}]
+    g = gather(["a", "b"], dicts, GatherMode.APPEND)
+    assert g == {"a": [[1, 2], [3]], "b": [5, 6]}
+    g = gather(["a"], dicts, GatherMode.EXTEND)
+    assert g == {"a": [1, 2, 3]}
+    assert gather(["a"], dicts, "extend") == {"a": [1, 2, 3]}  # wire string
