@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import optax
 
 from .. import config
-from ..config.keys import Mode
+from ..config.keys import Key, Mode
 from ..metrics import COINNAverages, Prf1a
 from ..utils import atomic_write, logger
 from ..utils.utils import performance_improved_, stop_training_
@@ -45,6 +45,11 @@ CHECKPOINT_SOURCE = "coinstac-dinunet-tpu"
 # (~1–2 s/round on CPU vs ~10 ms of actual compute).  See
 # :meth:`NNTrainer._shared_compiled_bucket` for the key contract.
 _SHARED_COMPILED = {}
+
+# (class qualname, cache key) pairs already warned about as un-keyable —
+# the fresh-trainer-per-invocation contract would repeat the warning every
+# federated round otherwise.
+_UNKEYABLE_WARNED = set()
 
 # The framework's own round/fold-varying bookkeeping cache keys — exact
 # names, every one verified trace-irrelevant (host-side state machine,
@@ -60,6 +65,12 @@ _VOLATILE_CACHE_KEYS = frozenset((
     "skipped_sites", "global_test_metrics", "log_dir", "log_header",
     "resume", "profile_stats", "weights_file", "train_log",
     "validation_log", "test_log", "seed", "verbose",
+    # Key.* bookkeeping the nodes append per round/fold (metrics rollups,
+    # serialized score blobs, one-shot flags) — all host-side, never traced
+    Key.TEST_METRICS.value, Key.TRAIN_SERIALIZABLE.value,
+    Key.VALIDATION_SERIALIZABLE.value, Key.TEST_SERIALIZABLE.value,
+    Key.GLOBAL_TEST_SERIALIZABLE.value, Key.ARGS_CACHED.value,
+    Key.DATA_CURSOR.value,
 ))
 
 
@@ -79,6 +90,13 @@ def seeded_rng(seed):
 class NNTrainer:
     """Single-node training runtime over a dict of flax models."""
 
+    # Class-level default for the staging-time input cast (see
+    # :meth:`_input_cast_dtype`).  Every shipped model casts inputs to its
+    # compute dtype as its first op, so the staging cast is exact for them;
+    # a custom trainer whose model does float32 work on RAW inputs should
+    # set ``CAST_INPUTS = False`` (or pass ``cache['cast_inputs']=False``).
+    CAST_INPUTS = True
+
     def __init__(self, cache=None, input=None, state=None, data_handle=None, **kw):
         self.cache = cache if cache is not None else {}
         self.input = input if input is not None else {}
@@ -89,7 +107,8 @@ class NNTrainer:
         self.train_state: TrainState = None
         self._own_compiled = {}  # per-instance fallback (sharing off/not yet bindable)
         self._shared_bucket = None
-        self._share_opt_out = False  # set by the _compiled setter (overrides)
+        self._share_opt_out = False  # permanent: set by the _compiled setter
+        self._share_blocked_by_cache = False  # un-keyable cache value; init_nn re-evaluates
 
     @property
     def _compiled(self):
@@ -102,13 +121,18 @@ class NNTrainer:
         re-compile every round."""
         if self._shared_bucket is not None:
             return self._shared_bucket
-        if self._share_opt_out or not self.cache.get("share_compiled", True):
+        if (self._share_opt_out or self._share_blocked_by_cache
+                or not self.cache.get("share_compiled", True)):
             return self._own_compiled
         params = (self.train_state.params if self.train_state is not None
                   else getattr(self, "_params", None))
         if params is None:  # architecture not fingerprintable yet
             return self._own_compiled
-        self._shared_bucket = self._shared_compiled_bucket(params)
+        bucket = self._shared_compiled_bucket(params)
+        if bucket is None:  # un-keyable cache entry: sharing would be unsafe
+            self._share_blocked_by_cache = True
+            return self._own_compiled
+        self._shared_bucket = bucket
         return self._shared_bucket
 
     @_compiled.setter
@@ -198,36 +222,58 @@ class NNTrainer:
         the cache is process-lifetime by design, like jax's own jit cache."""
         import json
 
-        def keep(k, v):
+        cfg = {}
+        for k, v in self.cache.items():
             k = str(k)
             if k in _VOLATILE_CACHE_KEYS or k.startswith("_"):
-                return False
+                continue
             try:
-                json.dumps(v)
-                return True
-            except TypeError:
-                return False
+                # sort_keys here too: a dict value with mixed-type keys must
+                # fail NOW (→ sharing disabled), not at the final dumps below
+                json.dumps(v, sort_keys=True)
+            except (TypeError, ValueError):
+                # A non-volatile cache entry we cannot key on (e.g. a numpy
+                # array of loss weights a custom iteration() reads).  Sharing
+                # a compiled step across trainers that differ only in this
+                # value would silently reuse a stale trace — disable sharing
+                # for this trainer instead of silently dropping the key.
+                # Warn once per (class, key) per process: the fresh-trainer-
+                # per-round contract would otherwise repeat this every round.
+                warn_key = (type(self).__qualname__, k)
+                if warn_key not in _UNKEYABLE_WARNED:
+                    _UNKEYABLE_WARNED.add(warn_key)
+                    logger.warn(
+                        f"cache[{k!r}] is not JSON-serializable; compiled-"
+                        f"step sharing disabled for {type(self).__qualname__}"
+                        " (set cache['share_compiled']=False to silence, or "
+                        "store the value under a '_'-prefixed key if it is "
+                        "trace-irrelevant)"
+                    )
+                return None
+            cfg[k] = v
 
         fingerprint = tuple(
             (jax.tree_util.keystr(path), tuple(leaf.shape), str(leaf.dtype))
             for path, leaf in jax.tree_util.tree_leaves_with_path(params)
         )
-        cfg = {str(k): v for k, v in self.cache.items() if keep(k, v)}
         # operational env kill-switches are read at trace time too
         cfg["__env_no_s2d__"] = os.environ.get("COINN_NO_S2D", "")
         key = (
             type(self).__module__,
             type(self).__qualname__,
             fingerprint,
-            json.dumps(cfg, sort_keys=True, default=str),
+            json.dumps(cfg, sort_keys=True),
         )
         return _SHARED_COMPILED.setdefault(key, {})
 
     def init_nn(self, init_models=True, init_weights=True, init_optimizer=True):
         # drop any bucket binding: the config (learning rate, dtype, width)
-        # may have changed — the _compiled property re-binds on next use
+        # may have changed — the _compiled property re-binds on next use.
+        # The cache-driven sharing block is re-evaluated too (the offending
+        # value may be gone); only the setter's opt-out is permanent.
         self._own_compiled = {}
         self._shared_bucket = None
+        self._share_blocked_by_cache = False
         if init_models:
             self._init_nn_model()
         if init_weights:
@@ -700,8 +746,10 @@ class NNTrainer:
         step — the forward conv AND its kernel-gradient each re-read the
         batch (measured ~0.9 ms/step on the flagship at batch 128·64³).
         ``cache['cast_inputs']=False`` opts out for custom models that do
-        float32 math on raw inputs before casting."""
-        if not self.cache.get("cast_inputs", True):
+        float32 math on raw inputs before casting; a trainer class can also
+        set ``CAST_INPUTS = False`` to change its own default (the cache key,
+        when present, always wins)."""
+        if not self.cache.get("cast_inputs", type(self).CAST_INPUTS):
             return None
         dt = jnp.dtype(self.cache.get("compute_dtype", "float32"))
         return None if dt == jnp.float32 else dt

@@ -29,6 +29,8 @@ import os
 
 import numpy as np
 
+from ..utils import logger
+
 __all__ = [
     "Image",
     "get_rgb_scores",
@@ -84,7 +86,7 @@ class Image:
             self.dir, self.file = dir, file
             self.array = self._read(self.path, self.dtype)
         except Exception as e:  # noqa: BLE001 — parity: log-and-continue
-            print(f"### Error loading file {file}: {e}")
+            logger.error(f"loading image file {file}: {e}")
 
     def load_mask(self, mask_dir=None, fget_mask=lambda x: x):
         try:
@@ -92,7 +94,7 @@ class Image:
                 os.path.join(mask_dir, fget_mask(self.file)), self.dtype
             )
         except Exception as e:  # noqa: BLE001
-            print(f"### Failed to load mask: {e}")
+            logger.error(f"loading mask for {self.file}: {e}")
 
     def load_ground_truth(self, gt_dir=None, fget_ground_truth=lambda x: x):
         try:
@@ -100,7 +102,7 @@ class Image:
                 os.path.join(gt_dir, fget_ground_truth(self.file)), self.dtype
             )
         except Exception as e:  # noqa: BLE001
-            print(f"### Failed to load ground truth: {e}")
+            logger.error(f"loading ground truth for {self.file}: {e}")
 
     def get_array(self, dir="", getter=lambda x: x, file=None):
         return self._read(os.path.join(dir, getter(file or self.file)), self.dtype)
@@ -116,7 +118,7 @@ class Image:
             for c in range(min(self.array.shape[-1], 3)):
                 self.array[..., c] = _clahe(self.array[..., c], clip_limit, tile_shape)
         else:
-            print("### More than three channels")
+            logger.warn(f"apply_clahe skipped: unsupported {self.array.ndim}-D array")
 
     def __copy__(self):
         out = Image(dtype=_copy.deepcopy(self.dtype))

@@ -348,6 +348,48 @@ def test_shared_bucket_splits_on_architecture(tmp_path):
     assert t3._compiled is not t4._compiled
 
 
+def test_unserializable_cache_value_disables_sharing(tmp_path):
+    """A non-volatile cache entry the key cannot represent (numpy array, or
+    a dict whose sorted dump raises) must disable sharing for that trainer —
+    NOT be silently dropped from the key, which could share a stale trace
+    between trainers that differ only in that value."""
+    from coinstac_dinunet_tpu.models import FSVTrainer
+
+    cache = {"input_size": 12, "batch_size": 4, "num_classes": 2, "seed": 0,
+             "learning_rate": 1e-2, "log_dir": str(tmp_path)}
+    t1 = FSVTrainer(cache=dict(cache), state={}, data_handle=None).init_nn()
+
+    # numpy-array value: json.dumps raises TypeError
+    t2 = FSVTrainer(cache=dict(cache, loss_weights=np.array([1.0, 2.0])),
+                    state={}, data_handle=None).init_nn()
+    assert t2._compiled is not t1._compiled
+    assert t2._compiled is t2._own_compiled
+
+    # mixed-type dict keys: plain dumps passes but sort_keys raises —
+    # must be caught at key time, not crash at first _compiled access
+    t3 = FSVTrainer(cache=dict(cache, weird={1: "a", "b": 2}),
+                    state={}, data_handle=None).init_nn()
+    assert t3._compiled is t3._own_compiled
+
+    # underscore-prefixed keys stay exempt: sharing remains on
+    t4 = FSVTrainer(cache=dict(cache, _scratch=np.array([3.0])),
+                    state={}, data_handle=None).init_nn()
+    assert t4._compiled is t1._compiled
+
+    # removing the offending value + init_nn() re-evaluates: sharing returns
+    t3.cache.pop("weird")
+    t3.init_nn()
+    assert t3._compiled is t1._compiled
+
+    # the opted-out trainer still trains correctly through its own cache
+    rng = np.random.default_rng(0)
+    b = {"inputs": rng.normal(size=(4, 12)).astype(np.float32),
+         "labels": rng.integers(0, 2, size=4).astype(np.int32),
+         "_mask": np.ones(4, np.float32)}
+    s2, _ = t2.train_step(t2.train_state, t2._stack_batches([b]))
+    assert int(s2.step) == 1
+
+
 def test_shared_bucket_binds_after_partial_init_restore(tmp_path):
     """The steady-state node path does a partial init_nn then assigns the
     carried train state; the bucket must bind lazily at first use (binding
