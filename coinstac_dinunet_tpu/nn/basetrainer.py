@@ -283,21 +283,30 @@ class NNTrainer:
             self._init_train_state()
         return self
 
-    def _init_nn_weights(self):
-        """Seeded parameter init — the same seed at every site makes replicas
-        identical by construction (the federated weight-sync invariant, ref
-        SURVEY §3.3).  ``pretrained_path`` warm-start wins over fresh init."""
-        pretrained = self.cache.get("pretrained_path")
+    def _creation_ordered_params(self):
+        """Fresh seeded init of every model — the param tree with dicts in
+        CREATION order (kernel before bias, modules in call order).  Trees
+        that have been through a jitted step come back key-SORTED, so
+        anything that pairs params positionally against an external
+        definition order (torch checkpoint import) must use this tree."""
         seed = int(self.cache.get("seed", config.current_seed))
         rng = seeded_rng(seed)
-        self._params = {}
+        out = {}
         examples = self.example_inputs()
         for name, module in self.nn.items():
             rng, sub = jax.random.split(rng)
             args = examples[name]
             if not isinstance(args, (tuple, list)):
                 args = (args,)
-            self._params[name] = module.init(sub, *args)
+            out[name] = module.init(sub, *args)
+        return out
+
+    def _init_nn_weights(self):
+        """Seeded parameter init — the same seed at every site makes replicas
+        identical by construction (the federated weight-sync invariant, ref
+        SURVEY §3.3).  ``pretrained_path`` warm-start wins over fresh init."""
+        pretrained = self.cache.get("pretrained_path")
+        self._params = self._creation_ordered_params()
         if pretrained:
             self.load_checkpoint(full_path=pretrained, load_optimizer=False)
 
@@ -351,6 +360,10 @@ class NNTrainer:
 
     def load_checkpoint(self, name=None, full_path=None, load_optimizer=True):
         path = full_path or self.checkpoint_path(name)
+        from ..utils.torch_import import is_torch_file
+
+        if is_torch_file(path):
+            return self._load_torch_checkpoint(path)
         with open(path, "rb") as f:
             payload = flax.serialization.msgpack_restore(f.read())
         self.last_checkpoint_extra = dict(payload.get("extra", {}))
@@ -376,6 +389,62 @@ class NNTrainer:
             rng = jnp.asarray(np.asarray(payload["rng"]), jnp.uint32)
         self.train_state = self.train_state.replace(
             params=params, opt_state=opt_state, step=step, rng=rng
+        )
+        return self
+
+    def _load_torch_checkpoint(self, path):
+        """Warm-start from a reference-ecosystem torch checkpoint
+        (``weights.tar`` written by torch.save — ref
+        ``nn/basetrainer.py:76-99``).  Only model weights are imported:
+        torch optimizer moments do not map onto optax state pytrees, so
+        each IMPORTED model's optimizer (and the step counter) restarts
+        fresh — the standard warm-start semantics.  Models absent from the
+        checkpoint keep their current weights and optimizer state.
+        ``cache['torch_name_map']`` ({torch name: 'flax/param/path'})
+        overrides positional pairing for divergent definition orders."""
+        from ..utils.torch_import import convert_state_dict, load_torch_payload
+
+        self.last_checkpoint_extra = {}
+        name_map = self.cache.get("torch_name_map") or None
+        # Positional pairing needs the CREATION-ordered tree (params that
+        # have been through a jitted step come back with dict keys sorted,
+        # bias before kernel) — use init_nn's ``_params``, or rebuild one
+        # from the modules on the steady-state partial-init path.
+        template = getattr(self, "_params", None)
+        if template is None and self.nn:
+            template = self._creation_ordered_params()
+        if template is None:
+            raise RuntimeError(
+                "torch checkpoint import needs initialized models — call "
+                "init_nn() before load_checkpoint() on a torch file"
+            )
+        state_dicts, _torch_opt = load_torch_payload(path)
+        if set(state_dicts) == {None}:  # raw state_dict -> first model
+            state_dicts = {next(iter(template)): state_dicts[None]}
+        unknown = set(state_dicts) - set(template)
+        if unknown:
+            raise KeyError(
+                f"checkpoint models {sorted(unknown)} not in trainer models "
+                f"{list(template)}"
+            )
+        imported = {
+            n: convert_state_dict(template[n], sd, name_map=name_map)
+            for n, sd in state_dicts.items()
+        }
+        if self.train_state is None:
+            self._params = {**template, **imported}
+            return self
+        params = dict(self.train_state.params)
+        params.update(imported)
+        # a warm start, not a resume: optimizer moments accumulated for the
+        # REPLACED weights must not be applied to the imported ones; models
+        # the checkpoint does not touch keep theirs
+        opt_state = dict(self.train_state.opt_state)
+        for n in imported:
+            opt_state[n] = self.optimizer[n].init(imported[n])
+        self.train_state = self.train_state.replace(
+            params=params, opt_state=opt_state,
+            step=jnp.zeros((), jnp.int32),
         )
         return self
 

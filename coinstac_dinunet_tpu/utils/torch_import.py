@@ -1,0 +1,259 @@
+"""Torch checkpoint import — warm-start from the reference ecosystem.
+
+The reference loads non-coinstac torch checkpoints as a warm start
+(``/root/reference/coinstac_dinunet/nn/basetrainer.py:76-99``: a
+``source='coinstac'`` payload restores per-model ``state_dict``s, anything
+else is treated as a single raw ``state_dict`` for the first model).  A real
+migration from that ecosystem carries ``weights.tar`` files written by
+``torch.save`` — this module maps them onto flax param trees so
+``pretrained_path``/``load_checkpoint`` accept them directly.
+
+Layout conversion is structural, not name-based: torch modules register
+parameters in definition order and flax ``nn.compact`` modules create them
+in call order, so for an architecture-equivalent pair of models the two
+flattened parameter lists correspond positionally.  Each pair is converted
+by the standard layout transposes
+
+- ``nn.Linear.weight`` (out, in)        → ``Dense.kernel`` (in, out)
+- ``nn.ConvNd.weight`` (out, in, *k)    → ``Conv.kernel`` (*k, in, out)
+- ``nn.ConvTransposeNd.weight`` (in, out, *k) → ``ConvTranspose.kernel``
+- norm/bias vectors                     → copied as-is
+
+and validated against the flax leaf's shape — a mismatch anywhere aborts
+with both flattened inventories in the error, never a silently wrong load.
+An explicit ``name_map`` (torch name → flax ``/``-joined path) overrides
+the positional pairing for models whose definition orders diverge.
+"""
+import numpy as np
+
+__all__ = [
+    "load_torch_payload",
+    "convert_state_dict",
+    "import_torch_checkpoint",
+]
+
+
+def _torch():
+    try:
+        import torch  # noqa: PLC0415
+        return torch
+    except Exception:  # pragma: no cover - torch is baked into the image
+        return None
+
+
+def is_torch_file(path):
+    """Cheap magic-byte sniff: torch>=1.6 zip archives start ``PK``; legacy
+    torch pickles also begin with a pickle protocol marker ``\\x80``."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(2)
+    except OSError:
+        return False
+    return head[:2] == b"PK" or head[:1] == b"\x80"
+
+
+def load_torch_payload(path):
+    """``torch.load`` a checkpoint and normalize it to the reference's two
+    shapes: ``({model_name: state_dict}, optimizers_or_None)`` for a
+    ``source='coinstac'`` payload, or ``({None: state_dict}, None)`` for a
+    raw state dict (caller assigns it to its first model — exactly the
+    reference fallback, ``nn/basetrainer.py:95-99``)."""
+    import pickle
+
+    torch = _torch()
+    if torch is None:
+        raise RuntimeError("torch is required to import torch checkpoints")
+    try:
+        payload = torch.load(path, map_location="cpu", weights_only=True)
+    except pickle.UnpicklingError:
+        # ONLY the weights-only rejection (non-allowlisted globals in the
+        # user's own legacy checkpoint) falls back to full unpickling;
+        # corruption/IO errors propagate with their original cause
+        payload = torch.load(path, map_location="cpu", weights_only=False)
+    if isinstance(payload, dict) and str(payload.get("source", "")).lower() == "coinstac":
+        return dict(payload.get("models", {})), payload.get("optimizers")
+    return {None: payload}, None
+
+
+def _flatten_insertion_order(tree, prefix=()):
+    """[(path_tuple, leaf)] walking nested dicts in INSERTION order — the
+    order flax created the params in (``jax.tree_util`` sorts keys, which
+    breaks e.g. ``Conv_10`` < ``Conv_2``; creation order is call order)."""
+    items = []
+    if hasattr(tree, "items"):
+        for k, v in tree.items():
+            items.extend(_flatten_insertion_order(v, prefix + (str(k),)))
+    else:
+        items.append((prefix, tree))
+    return items
+
+
+def _unflatten(flat, template):
+    """Rebuild ``template``'s nesting with ``flat``'s arrays (same order)."""
+    it = iter(flat)
+
+    def rebuild(node):
+        if hasattr(node, "items"):
+            return {k: rebuild(v) for k, v in node.items()}
+        return next(it)
+
+    out = rebuild(template)
+    rest = list(it)
+    assert not rest, f"{len(rest)} unconsumed leaves"
+    return out
+
+
+def _convert_tensor(name, t, path, target_shape, conv_transpose=None):
+    """Torch tensor → numpy array of ``target_shape``.
+
+    The conversion is decided by the KIND of the flax leaf (its path), not
+    by trying shape-compatible transposes — a square Linear weight or an
+    equal-channel ConvTranspose would otherwise shape-match untransposed
+    and load silently wrong:
+
+    - ``kernel`` rank-2: Linear ``(out, in)`` → ``(in, out)`` — ALWAYS
+      transposed, square or not;
+    - ``kernel`` rank≥3: Conv ``(out, in, *k)`` → ``(*k, in, out)`` or
+      ConvTranspose ``(in, out, *k)`` → ``(*k, in, out)`` **with spatial
+      axes flipped** (torch's gradient-of-conv semantics vs flax's
+      ``transpose_kernel=False``).  When in≠out only one permutation fits
+      the target and is picked automatically;
+      in the ambiguous equal-channel case the flax path naming (an
+      auto-named ``ConvTranspose_N`` module) or an explicit
+      ``conv_transpose`` override decides — a setup()-named equal-channel
+      ConvTranspose NEEDS the override (see ``convert_state_dict``).
+    - everything else (``bias``/``scale``/``embedding``/``mean``/``var``):
+      copied as-is.
+
+    Returns None when the converted shape still mismatches.
+    """
+    a = np.asarray(t.detach().cpu().numpy() if hasattr(t, "detach") else t)
+    if path[-1] == "kernel":
+        if a.ndim == 2:
+            a = a.T
+        elif a.ndim >= 3:
+            spatial = tuple(range(2, a.ndim))
+            conv = np.transpose(a, spatial + (1, 0))     # Conv (out,in,*k)
+            # ConvT (in,out,*k): permute AND flip spatial axes — torch's
+            # gradient-of-conv kernel vs flax ConvTranspose's unflipped
+            # (transpose_kernel=False) convention
+            convT = np.flip(np.transpose(a, spatial + (0, 1)),
+                            axis=tuple(range(a.ndim - 2)))
+            fits = [tuple(x.shape) == tuple(target_shape) for x in (conv, convT)]
+            if fits == [True, False]:
+                a = conv
+            elif fits == [False, True]:
+                a = convT
+            else:  # ambiguous (in == out) or neither: decide by kind
+                if conv_transpose is None:
+                    conv_transpose = any("ConvTranspose" in p for p in path)
+                a = convT if conv_transpose else conv
+    if tuple(a.shape) != tuple(target_shape):
+        return None
+    return a
+
+
+def _is_running_stat(name):
+    return str(name).endswith(("running_mean", "running_var"))
+
+
+def convert_state_dict(flax_params, state_dict, name_map=None):
+    """Map a torch ``state_dict`` onto ``flax_params`` (one model's tree).
+
+    Positional pairing over insertion-order flattenings, PER COLLECTION:
+    torch interleaves BatchNorm ``running_mean``/``running_var`` with the
+    trainable entries, while flax groups them in a separate ``batch_stats``
+    collection — so running stats are paired against the ``batch_stats``
+    leaves and everything else against the remaining (``params``) leaves,
+    each stream in its own order.  Optional explicit ``name_map`` entries
+    are consumed first; each value is either a ``/``-joined flax path or a
+    dict ``{'path': ..., 'conv_transpose': True}`` — the flag forces the
+    ConvTranspose kernel permutation for setup()-named equal-channel
+    transpose convs the path alone cannot identify.  Returns a new tree of
+    ``flax_params``'s structure with every leaf replaced (dtype-cast to
+    the original leaf's dtype).
+    """
+    name_map = dict(name_map or {})
+    flax_flat = _flatten_insertion_order(flax_params)
+    torch_flat = [(k, v) for k, v in state_dict.items()
+                  if not str(k).endswith("num_batches_tracked")]
+
+    out = {path: None for path, _ in flax_flat}
+    shapes = {path: np.asarray(leaf).shape for path, leaf in flax_flat}
+    dtypes = {path: np.asarray(leaf).dtype for path, leaf in flax_flat}
+
+    def place(name, tensor, path, conv_transpose=None):
+        conv = _convert_tensor(name, tensor, path, shapes[path],
+                               conv_transpose=conv_transpose)
+        if conv is None:
+            raise ValueError(
+                f"cannot convert {name!r} {tuple(np.asarray(tensor).shape)} "
+                f"to {'/'.join(path)!r} {tuple(shapes[path])} — definition "
+                "orders may diverge; supply name_map={torch_name: 'flax/path'}"
+            )
+        out[path] = conv.astype(dtypes[path])
+
+    # explicit mappings first
+    remaining_torch = []
+    for name, tensor in torch_flat:
+        if name in name_map:
+            spec = name_map[name]
+            conv_transpose = None
+            if isinstance(spec, dict):
+                conv_transpose = spec.get("conv_transpose")
+                spec = spec["path"]
+            path = tuple(str(spec).split("/"))
+            if path not in out:
+                raise KeyError(
+                    f"name_map[{name!r}] -> {'/'.join(path)!r} is not a "
+                    f"param path; known: {['/'.join(p) for p in out]}"
+                )
+            place(name, tensor, path, conv_transpose)
+        else:
+            remaining_torch.append((name, tensor))
+
+    # pair per collection: running stats vs batch_stats, rest vs params
+    streams = (
+        ([x for x in remaining_torch if _is_running_stat(x[0])],
+         [p for p, _ in flax_flat if p[0] == "batch_stats" and out[p] is None]),
+        ([x for x in remaining_torch if not _is_running_stat(x[0])],
+         [p for p, _ in flax_flat if p[0] != "batch_stats" and out[p] is None]),
+    )
+    for torch_stream, flax_stream in streams:
+        if len(torch_stream) != len(flax_stream):
+            raise ValueError(
+                "torch checkpoint does not match the model: "
+                f"{len(torch_stream)} torch entries vs {len(flax_stream)} "
+                f"flax params.\n torch: {[n for n, _ in torch_stream]}\n "
+                f"flax: {['/'.join(p) for p in flax_stream]}"
+            )
+        for (name, tensor), path in zip(torch_stream, flax_stream):
+            place(name, tensor, path)
+
+    return _unflatten([out[p] for p, _ in flax_flat], flax_params)
+
+
+def import_torch_checkpoint(params, path, name_map=None):
+    """Load a torch checkpoint file onto a dict-of-models param tree.
+
+    ``params`` is ``{model_name: flax_variables}`` (the trainer's
+    ``train_state.params``).  A reference coinstac-format payload maps each
+    of its ``models`` entries by name; a raw state dict maps onto the FIRST
+    model (reference fallback semantics).  Returns a new params dict;
+    models absent from the checkpoint keep their current values.
+    """
+    state_dicts, _optimizers = load_torch_payload(path)
+    out = dict(params)
+    if set(state_dicts) == {None}:
+        first = next(iter(params))
+        out[first] = convert_state_dict(params[first], state_dicts[None],
+                                        name_map=name_map)
+        return out
+    for name, sd in state_dicts.items():
+        if name not in params:
+            raise KeyError(
+                f"checkpoint model {name!r} not in trainer models "
+                f"{list(params)}"
+            )
+        out[name] = convert_state_dict(params[name], sd, name_map=name_map)
+    return out

@@ -1,0 +1,392 @@
+"""Torch checkpoint import: warm-starting from the reference ecosystem.
+
+The reference accepts torch checkpoints in two shapes
+(``/root/reference/coinstac_dinunet/nn/basetrainer.py:76-99``): a
+``source='coinstac'`` payload of per-model state dicts, or a raw
+``state_dict`` loaded into the first model.  These tests build REAL torch
+modules, save their checkpoints with ``torch.save``, import them through the
+trainer, and check the flax forward pass reproduces the torch module's
+outputs — the strongest possible migration guarantee.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax
+import jax.numpy as jnp
+
+
+def _torch_mlp(hidden=(256, 128, 64), num_in=66, num_classes=2, seed=0):
+    torch.manual_seed(seed)
+    sizes = (num_in, *hidden)
+    layers = []
+    for a, b in zip(sizes, sizes[1:]):
+        layers += [torch.nn.Linear(a, b), torch.nn.ReLU()]
+    layers += [torch.nn.Linear(sizes[-1], num_classes)]
+    return torch.nn.Sequential(*layers)
+
+
+def _fsv_trainer(tmp_path, **extra):
+    from coinstac_dinunet_tpu.models import FSVTrainer
+
+    cache = {"input_size": 66, "batch_size": 4, "num_classes": 2, "seed": 0,
+             "learning_rate": 1e-2, "log_dir": str(tmp_path),
+             "share_compiled": False, **extra}
+    return FSVTrainer(cache=cache, state={}, data_handle=None)
+
+
+def test_coinstac_format_torch_checkpoint_roundtrip(tmp_path):
+    """A reference-format ``weights.tar`` ({'source': 'coinstac', 'models':
+    {name: state_dict}}) imports by model name, and the imported flax model
+    computes the SAME function as the torch source."""
+    net = _torch_mlp()
+    ckpt = tmp_path / "weights.tar"
+    torch.save({"source": "coinstac",
+                "models": {"fsv_net": net.state_dict()},
+                "optimizers": {}}, str(ckpt))
+
+    t = _fsv_trainer(tmp_path).init_nn()
+    t.load_checkpoint(full_path=str(ckpt))
+
+    x = np.random.default_rng(1).normal(size=(8, 66)).astype(np.float32)
+    got = np.asarray(t.nn["fsv_net"].apply(
+        t.train_state.params["fsv_net"], jnp.asarray(x)))
+    want = net(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_raw_state_dict_maps_to_first_model(tmp_path):
+    """A bare ``state_dict`` file (no 'source' tag) loads into the first
+    model — the reference's non-coinstac fallback."""
+    net = _torch_mlp(seed=3)
+    ckpt = tmp_path / "raw.tar"
+    torch.save(net.state_dict(), str(ckpt))
+
+    t = _fsv_trainer(tmp_path).init_nn()
+    before = np.asarray(jax.tree_util.tree_leaves(
+        t.train_state.params["fsv_net"])[0]).copy()
+    t.load_checkpoint(full_path=str(ckpt))
+
+    x = np.random.default_rng(2).normal(size=(4, 66)).astype(np.float32)
+    got = np.asarray(t.nn["fsv_net"].apply(
+        t.train_state.params["fsv_net"], jnp.asarray(x)))
+    want = net(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    after = np.asarray(jax.tree_util.tree_leaves(
+        t.train_state.params["fsv_net"])[0])
+    assert not np.array_equal(before, after)
+
+
+def test_pretrained_path_accepts_torch_file(tmp_path):
+    """``cache['pretrained_path']`` pointing at a torch file warm-starts
+    init_nn — the migration entry point (docs/MIGRATION.md)."""
+    net = _torch_mlp(seed=5)
+    ckpt = tmp_path / "weights.tar"
+    torch.save({"source": "coinstac",
+                "models": {"fsv_net": net.state_dict()}}, str(ckpt))
+
+    t = _fsv_trainer(tmp_path, pretrained_path=str(ckpt)).init_nn()
+    x = np.random.default_rng(4).normal(size=(4, 66)).astype(np.float32)
+    got = np.asarray(t.nn["fsv_net"].apply(
+        t.train_state.params["fsv_net"], jnp.asarray(x)))
+    want = net(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    # the warm-started trainer still trains
+    b = {"inputs": x, "labels": np.zeros(4, np.int32),
+         "_mask": np.ones(4, np.float32)}
+    s, _ = t.train_step(t.train_state, t._stack_batches([b]))
+    assert int(s.step) == 1
+
+
+def test_shape_mismatch_raises_with_inventory(tmp_path):
+    """A checkpoint from a different architecture must abort with both
+    flattened inventories — never a silently wrong or partial load."""
+    net = _torch_mlp(hidden=(32,), seed=0)  # wrong depth
+    ckpt = tmp_path / "bad.tar"
+    torch.save(net.state_dict(), str(ckpt))
+
+    t = _fsv_trainer(tmp_path).init_nn()
+    with pytest.raises(ValueError, match="torch"):
+        t.load_checkpoint(full_path=str(ckpt))
+
+
+def test_conv_layout_transpose():
+    """ConvNd weights (out,in,*k) convert to flax (*k,in,out) — checked on a
+    real torch Conv3d vs flax Conv over the same input."""
+    import flax.linen as fnn
+    from coinstac_dinunet_tpu.utils.torch_import import convert_state_dict
+
+    tconv = torch.nn.Conv3d(2, 5, kernel_size=3, padding=1, bias=True)
+    x = np.random.default_rng(0).normal(size=(1, 4, 4, 4, 2)).astype(np.float32)
+
+    fconv = fnn.Conv(5, (3, 3, 3), padding="SAME")
+    params = fconv.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    imported = convert_state_dict(params, tconv.state_dict())
+    got = np.asarray(fconv.apply(imported, jnp.asarray(x)))
+    # torch is NCDHW
+    want = tconv(torch.from_numpy(x.transpose(0, 4, 1, 2, 3)))
+    want = want.detach().numpy().transpose(0, 2, 3, 4, 1)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_square_linear_weight_is_transposed(tmp_path):
+    """A hidden->hidden layer of EQUAL size shape-matches untransposed; the
+    kind-driven conversion must still transpose it (regression: exact-shape
+    check used to win and load x@W instead of x@W.T)."""
+    net = _torch_mlp(hidden=(64, 64), seed=7)
+    ckpt = tmp_path / "square.tar"
+    torch.save({"source": "coinstac",
+                "models": {"fsv_net": net.state_dict()}}, str(ckpt))
+
+    t = _fsv_trainer(tmp_path, hidden_sizes=(64, 64)).init_nn()
+    t.load_checkpoint(full_path=str(ckpt))
+    x = np.random.default_rng(9).normal(size=(4, 66)).astype(np.float32)
+    got = np.asarray(t.nn["fsv_net"].apply(
+        t.train_state.params["fsv_net"], jnp.asarray(x)))
+    want = net(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_batchnorm_running_stats_pair_with_batch_stats_collection():
+    """Torch interleaves running_mean/running_var per module; flax groups
+    them under batch_stats.  Per-collection pairing must line both up."""
+    import flax.linen as fnn
+    from coinstac_dinunet_tpu.utils.torch_import import convert_state_dict
+
+    class TorchNet(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = torch.nn.Linear(6, 8)
+            self.bn1 = torch.nn.BatchNorm1d(8)
+            self.fc2 = torch.nn.Linear(8, 8)
+            self.bn2 = torch.nn.BatchNorm1d(8)
+
+        def forward(self, x):
+            return self.bn2(self.fc2(self.bn1(self.fc1(x))))
+
+    class FlaxNet(fnn.Module):
+        @fnn.compact
+        def __call__(self, x, train=False):
+            x = fnn.Dense(8)(x)
+            x = fnn.BatchNorm(use_running_average=not train)(x)
+            x = fnn.Dense(8)(x)
+            return fnn.BatchNorm(use_running_average=not train)(x)
+
+    torch.manual_seed(11)
+    tnet = TorchNet().eval()
+    # make running stats distinctive
+    with torch.no_grad():
+        tnet.bn1.running_mean += 1.5
+        tnet.bn2.running_var *= 3.0
+
+    fnet = FlaxNet()
+    variables = fnet.init(jax.random.PRNGKey(0), jnp.zeros((1, 6)))
+    imported = convert_state_dict(variables, tnet.state_dict())
+    np.testing.assert_allclose(
+        np.asarray(imported["batch_stats"]["BatchNorm_0"]["mean"]),
+        tnet.bn1.running_mean.numpy(), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(imported["batch_stats"]["BatchNorm_1"]["var"]),
+        tnet.bn2.running_var.numpy(), atol=1e-6)
+
+    x = np.random.default_rng(3).normal(size=(4, 6)).astype(np.float32)
+    got = np.asarray(fnet.apply(imported, jnp.asarray(x)))
+    want = tnet(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_torch_import_resets_optimizer_and_step(tmp_path):
+    """Importing onto an already-trained state is a WARM START: stale Adam
+    moments keyed to the replaced weights (and the step counter) must not
+    survive the import."""
+    t = _fsv_trainer(tmp_path).init_nn()
+    x = np.random.default_rng(0).normal(size=(4, 66)).astype(np.float32)
+    b = {"inputs": x, "labels": np.zeros(4, np.int32),
+         "_mask": np.ones(4, np.float32)}
+    for _ in range(3):
+        t.train_state, _ = t.train_step(t.train_state, t._stack_batches([b]))
+    assert int(t.train_state.step) == 3
+
+    net = _torch_mlp(seed=13)
+    ckpt = tmp_path / "warm.tar"
+    torch.save({"source": "coinstac",
+                "models": {"fsv_net": net.state_dict()}}, str(ckpt))
+    t.load_checkpoint(full_path=str(ckpt))
+    assert int(t.train_state.step) == 0
+    mu = jax.tree_util.tree_leaves(t.train_state.opt_state)
+    assert all(float(np.abs(np.asarray(m)).max()) == 0.0
+               for m in mu if hasattr(m, "shape") and np.asarray(m).ndim > 0)
+
+
+def test_partial_checkpoint_keeps_other_models_trained_state(tmp_path):
+    """A coinstac payload naming only SOME models must leave the others'
+    trained weights and optimizer state untouched (regression: the stale
+    init-time template used to overwrite them)."""
+    import flax.linen as fnn
+    from coinstac_dinunet_tpu.nn.basetrainer import NNTrainer
+
+    class TwoModelTrainer(NNTrainer):
+        def _init_nn_model(self):
+            self.nn["a"] = fnn.Dense(3)
+            self.nn["b"] = fnn.Dense(3)
+
+        def example_inputs(self):
+            x = jnp.zeros((1, 5), jnp.float32)
+            return {"a": (x,), "b": (x,)}
+
+        def iteration(self, params, batch, rng=None):
+            ya = self.nn["a"].apply(params["a"], batch["inputs"])
+            yb = self.nn["b"].apply(params["b"], batch["inputs"])
+            loss = jnp.mean((ya - 1.0) ** 2) + jnp.mean((yb - 1.0) ** 2)
+            return {"loss": loss}
+
+    t = TwoModelTrainer(cache={"seed": 0, "learning_rate": 1e-2,
+                               "log_dir": str(tmp_path),
+                               "share_compiled": False}).init_nn()
+    b = {"inputs": np.ones((4, 5), np.float32),
+         "_mask": np.ones(4, np.float32)}
+    for _ in range(3):
+        t.train_state, _ = t.train_step(t.train_state, t._stack_batches([b]))
+    trained_b = jax.device_get(t.train_state.params["b"])
+    opt_b = jax.device_get(t.train_state.opt_state["b"])
+
+    tnet = torch.nn.Linear(5, 3)
+    ckpt = tmp_path / "only_a.tar"
+    torch.save({"source": "coinstac",
+                "models": {"a": tnet.state_dict()}}, str(ckpt))
+    t.load_checkpoint(full_path=str(ckpt))
+
+    for x, y in zip(jax.tree_util.tree_leaves(trained_b),
+                    jax.tree_util.tree_leaves(
+                        jax.device_get(t.train_state.params["b"]))):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    for x, y in zip(jax.tree_util.tree_leaves(opt_b),
+                    jax.tree_util.tree_leaves(
+                        jax.device_get(t.train_state.opt_state["b"]))):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # model 'a' WAS imported
+    np.testing.assert_allclose(
+        np.asarray(t.train_state.params["a"]["params"]["kernel"]),
+        tnet.weight.detach().numpy().T, atol=1e-6)
+
+
+def test_steady_state_partial_init_import(tmp_path):
+    """The federated steady-state path (init_nn(init_weights=False,
+    init_optimizer=False) + carried train_state) has no ``_params``
+    template; the import must rebuild a creation-ordered one rather than
+    positionally pairing against the carried (key-sorted) tree."""
+    t1 = _fsv_trainer(tmp_path).init_nn()
+    x = np.random.default_rng(0).normal(size=(4, 66)).astype(np.float32)
+    b = {"inputs": x, "labels": np.zeros(4, np.int32),
+         "_mask": np.ones(4, np.float32)}
+    t1.train_state, _ = t1.train_step(t1.train_state, t1._stack_batches([b]))
+
+    t2 = _fsv_trainer(tmp_path)
+    t2.init_nn(init_weights=False, init_optimizer=False)
+    t2._init_optimizer()
+    t2.train_state = t1.train_state  # carried, key-sorted tree
+    assert getattr(t2, "_params", None) is None
+
+    net = _torch_mlp(seed=21)
+    ckpt = tmp_path / "steady.tar"
+    torch.save({"source": "coinstac",
+                "models": {"fsv_net": net.state_dict()}}, str(ckpt))
+    t2.load_checkpoint(full_path=str(ckpt))
+    got = np.asarray(t2.nn["fsv_net"].apply(
+        t2.train_state.params["fsv_net"], jnp.asarray(x)))
+    want = net(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_torch_load_before_init_raises_cleanly(tmp_path):
+    ckpt = tmp_path / "w.tar"
+    torch.save(_torch_mlp().state_dict(), str(ckpt))
+    t = _fsv_trainer(tmp_path)  # no init_nn
+    with pytest.raises(RuntimeError, match="init_nn"):
+        t.load_checkpoint(full_path=str(ckpt))
+
+
+def test_conv_transpose_autodetected_when_channels_differ():
+    """A setup()-named ConvTranspose (path carries no module-class hint)
+    with in≠out channels is detected by unique shape fit."""
+    import flax.linen as fnn
+    from coinstac_dinunet_tpu.utils.torch_import import convert_state_dict
+
+    class Up(fnn.Module):
+        def setup(self):
+            self.up = fnn.ConvTranspose(5, (2, 2), strides=(2, 2))
+
+        def __call__(self, x):
+            return self.up(x)
+
+    tconv = torch.nn.ConvTranspose2d(3, 5, kernel_size=2, stride=2)
+    x = np.random.default_rng(0).normal(size=(1, 4, 4, 3)).astype(np.float32)
+    m = Up()
+    params = m.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    imported = convert_state_dict(params, tconv.state_dict())
+    got = np.asarray(m.apply(imported, jnp.asarray(x)))
+    want = tconv(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    want = want.detach().numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_name_map_conv_transpose_override():
+    """Equal-channel setup()-named ConvTranspose is ambiguous by shape AND
+    path; the name_map dict form forces the right permutation."""
+    import flax.linen as fnn
+    from coinstac_dinunet_tpu.utils.torch_import import convert_state_dict
+
+    class Up(fnn.Module):
+        def setup(self):
+            self.up = fnn.ConvTranspose(3, (2, 2), strides=(2, 2))
+
+        def __call__(self, x):
+            return self.up(x)
+
+    tconv = torch.nn.ConvTranspose2d(3, 3, kernel_size=2, stride=2)
+    x = np.random.default_rng(0).normal(size=(1, 4, 4, 3)).astype(np.float32)
+    m = Up()
+    params = m.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    imported = convert_state_dict(
+        params, tconv.state_dict(),
+        name_map={"weight": {"path": "params/up/kernel",
+                             "conv_transpose": True}})
+    got = np.asarray(m.apply(imported, jnp.asarray(x)))
+    want = tconv(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    want = want.detach().numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_name_map_overrides_positional_pairing(tmp_path):
+    """Explicit name_map entries re-route torch entries whose definition
+    order diverges from the flax call order."""
+    from coinstac_dinunet_tpu.utils.torch_import import convert_state_dict
+    import flax.linen as fnn
+
+    class TwoDense(fnn.Module):
+        @fnn.compact
+        def __call__(self, x):
+            # constructed Dense(3) first -> it is Dense_0, though applied last
+            return fnn.Dense(3)(fnn.Dense(7)(x))
+
+    m = TwoDense()
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 5)))
+    # torch dict in application order: diverges from flax construction order
+    sd = {
+        "first.weight": torch.randn(7, 5), "first.bias": torch.randn(7),
+        "second.weight": torch.randn(3, 7), "second.bias": torch.randn(3),
+    }
+    name_map = {
+        "first.weight": "params/Dense_1/kernel",
+        "first.bias": "params/Dense_1/bias",
+        "second.weight": "params/Dense_0/kernel",
+        "second.bias": "params/Dense_0/bias",
+    }
+    imported = convert_state_dict(params, sd, name_map=name_map)
+    np.testing.assert_allclose(
+        np.asarray(imported["params"]["Dense_1"]["kernel"]),
+        sd["first.weight"].numpy().T)
+    np.testing.assert_allclose(
+        np.asarray(imported["params"]["Dense_0"]["kernel"]),
+        sd["second.weight"].numpy().T)
