@@ -191,6 +191,110 @@ class InProcessEngine:
         return self
 
 
+class SubprocessEngine(InProcessEngine):
+    """Protocol-faithful FRESH-PROCESS engine: every node invocation spawns
+    ``python <script>`` with ``{"cache", "input", "state"}`` on stdin and
+    reads ``{"output", "cache"}`` from stdout (the ``examples/*/local.py`` /
+    ``remote.py`` contract) — no Python state can leak between rounds, which
+    is what a real deployment whose engine containerizes each invocation
+    looks like.  The engine round-trips each node's JSON-able cache (what
+    the real engine persists); the live train state survives via
+    ``cache['persist_round_state']`` (per-round on-disk state,
+    ``nodes/local.py``) — without it, mid-run invocations fail loudly
+    instead of silently re-initializing.
+
+    ``first_input`` (per-site dict, or one dict broadcast to all) is merged
+    into the first invocation's input so node args resolve through the
+    3-tier pipeline exactly once (``ARGS_CACHED`` then rides the cache).
+    """
+
+    def __init__(self, workdir, n_sites, local_script, remote_script,
+                 first_input=None, env=None, timeout=600, **kw):
+        super().__init__(workdir, n_sites, **kw)
+        # the in-process arg channels never reach a subprocess node — a
+        # silently different configuration is worse than an error
+        if self.args or self.site_args or self.site_spec:
+            raise ValueError(
+                "SubprocessEngine nodes run in their own processes: engine "
+                "**args / site_args / inputspec are not shipped to them — "
+                "pass node args via first_input (merged into the first "
+                "invocation's input; the 3-tier arg pipeline caches them)"
+            )
+        self.local_script = str(local_script)
+        self.remote_script = str(remote_script)
+        self.env = env
+        self.timeout = timeout
+        if first_input is None:
+            first_input = {}
+        if not any(s in first_input for s in self.site_ids):
+            first_input = {s: dict(first_input) for s in self.site_ids}
+        self.first_input = first_input
+        self._first_done = set()
+
+    def _invoke(self, script, payload):
+        import json
+        import subprocess
+        import sys
+
+        res = subprocess.run(
+            [sys.executable, script],
+            input=json.dumps(utils.clean_recursive(payload)),
+            capture_output=True, text=True, env=self.env,
+            timeout=self.timeout,
+        )
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"{script} exited rc={res.returncode}\n--- stderr ---\n"
+                f"{res.stderr[-4000:]}"
+            )
+        # the node may print log lines; the LAST JSON line is the result
+        for line in reversed(res.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    continue
+        raise RuntimeError(
+            f"{script} produced no JSON result\n--- stdout ---\n"
+            f"{res.stdout[-2000:]}"
+        )
+
+    def step_round(self):
+        site_outs = {}
+        for s in self.site_ids:
+            inp = dict(self.site_inputs[s])
+            if s not in self._first_done:
+                inp.update(self.first_input.get(s, {}))
+                self._first_done.add(s)
+            res = self._invoke(self.local_script, {
+                "cache": self.site_caches[s], "input": inp,
+                "state": self.site_states[s],
+            })
+            self.site_caches[s] = res.get("cache", {})
+            site_outs[s] = res["output"]
+
+        res = self._invoke(self.remote_script, {
+            "cache": self.remote_cache, "input": site_outs,
+            "state": self.remote_state,
+        })
+        self.remote_cache = res.get("cache", {})
+        remote_out = res["output"]
+        self.success = bool(res.get("success"))
+        self.last_remote_out = remote_out
+
+        xfer = self.remote_state["transferDirectory"]
+        for f in os.listdir(xfer):
+            for s in self.site_ids:
+                shutil.copy(
+                    os.path.join(xfer, f),
+                    os.path.join(self.site_states[s]["baseDirectory"], f),
+                )
+        self.site_inputs = {s: dict(remote_out) for s in self.site_ids}
+        self.rounds += 1
+        return site_outs, remote_out
+
+
 class MeshEngine:
     """Full federated lifecycle with the mesh transport as the gradient plane.
 
@@ -209,20 +313,19 @@ class MeshEngine:
     best/early-stop decisions, same score artifacts.  What differs is the
     wire: gradients never leave the devices.
 
-    Engine-transport-only features (explicitly rejected here): pretrain
-    broadcast (needs per-site model states) and sparse test mode.  Metrics
-    that are not jit-safe (AUC) fall back to per-site host evaluation with
-    identical count/rank math.
+    Pretrain broadcast is supported with the file transport's semantics
+    (:meth:`_mesh_pretrain`): the max-train-data site trains locally for
+    ``pretrain_args['epochs']``, and its best weights seed the replicated
+    mesh state — exactly what the designated-site-pretrain + broadcast
+    sequence produces on the engine transport.  Engine-transport-only
+    feature (explicitly rejected here): sparse test mode.  Metrics that are
+    not jit-safe (AUC) fall back to per-site host evaluation with identical
+    count/rank math.
     """
 
     def __init__(self, workdir, n_sites, trainer_cls=COINNTrainer,
                  dataset_cls=None, datahandle_cls=COINNDataHandle,
                  devices=None, devices_per_site=None, site_args=None, **args):
-        if (args.get("pretrain_args") or {}).get("epochs"):
-            raise ValueError(
-                "pretrain broadcast requires the engine transport "
-                "(InProcessEngine); MeshEngine sites share one replicated state"
-            )
         if args.get("load_sparse"):
             raise ValueError("sparse test mode requires the engine transport")
         self.workdir = str(workdir)
@@ -411,10 +514,31 @@ class MeshEngine:
         )
         trainer.init_nn()
         self._trainer = trainer
-        fed = MeshFederation(
-            trainer, self.n_sites, agg_engine=str(rc.get("agg_engine", "dSGD")),
-            devices=self.devices, devices_per_site=self.devices_per_site,
-        )
+        self._mesh_pretrain(trainer, handles)
+        sp = int(rc.get("sequence_parallel", 1) or 1)
+        if sp > 1:
+            # intra-site axis shards the SEQUENCE (ring attention) instead
+            # of the batch — the trainer must implement iteration_sharded
+            if self.devices_per_site not in (None, sp):
+                raise ValueError(
+                    f"devices_per_site={self.devices_per_site} conflicts "
+                    f"with sequence_parallel={sp}: the intra-site axis is "
+                    "the sequence axis (sp ranks per site); drop one of the "
+                    "two settings"
+                )
+            from .parallel.seq_mesh import SeqMeshFederation
+
+            fed = SeqMeshFederation(
+                trainer, self.n_sites, sp=sp,
+                agg_engine=str(rc.get("agg_engine", "dSGD")),
+                devices=self.devices,
+            )
+        else:
+            fed = MeshFederation(
+                trainer, self.n_sites,
+                agg_engine=str(rc.get("agg_engine", "dSGD")),
+                devices=self.devices, devices_per_site=self.devices_per_site,
+            )
         self._last_fed = fed
 
         bs = int(rc.get("batch_size", 16))
@@ -509,6 +633,78 @@ class MeshEngine:
         )
         utils.save_scores(rc, log_dir=log_dir, file_keys=[Key.TEST_METRICS.value])
         utils.save_cache(rc, {"outputDirectory": log_dir})
+
+    # --------------------------------------------------------------- pretrain
+    def _mesh_pretrain(self, trainer, handles):
+        """Designated-site pretrain with the engine transport's semantics
+        (ref ``distrib/nodes/local.py:152-170``, ``remote.py:205-215``):
+        the max-train-data site trains locally for
+        ``pretrain_args['epochs']`` (its best weights land in a transfer
+        dir, exactly like ``COINNTrainer._save_if_better``), then the
+        replicated mesh state is rebuilt from a FRESH init + those weights
+        (params/step/rng from the checkpoint, fresh optimizer) — the same
+        state every file-transport site holds after the PRE_COMPUTATION
+        broadcast load."""
+        rc = self.cache
+        p_args = dict(rc.get("pretrain_args") or {})
+        if int(p_args.get("epochs", 0) or 0) <= 0:
+            return False
+        sizes = {s: len(handles[s].get_train_dataset()) for s in self.site_ids}
+        designated = max(sizes, key=sizes.get)
+        xfer = os.path.join(self.workdir, "pretrain_xfer")
+        os.makedirs(xfer, exist_ok=True)
+
+        # overlay pretrain_args; shield the fold's logs/early-stop state,
+        # the resume flag (a fold resume must never short-circuit pretrain
+        # or let it re-load a federated autosave), and the checkpoint names
+        # (train_local's _on_train_end autosaves unconditionally — writing
+        # the FOLD's latest ckpt here would corrupt crash resume with
+        # pretrain-site history and wipe the 'fed' engine state)
+        shield = set(p_args) | {
+            "pretrain", "weights_file", "autosave_epochs", "resume",
+            "latest_nn_state", "best_nn_state",
+            Key.TRAIN_LOG.value, Key.VALIDATION_LOG.value,
+            "best_val_epoch", "best_val_score", "epoch", "cursor",
+        }
+        saved = {k: rc.get(k) for k in shield}
+        rc.update(p_args)
+        rc.update(pretrain=True, weights_file=None, autosave_epochs=0,
+                  resume=False,
+                  latest_nn_state=f"pretrain.latest.{rc['task_id']}.ckpt",
+                  best_nn_state=f"pretrain.best.{rc['task_id']}.ckpt")
+        rc[Key.TRAIN_LOG.value] = []
+        rc[Key.VALIDATION_LOG.value] = []
+        rc.update(best_val_epoch=0, best_val_score=None)
+        old_state, old_handle = trainer.state, trainer.data_handle
+        trainer.state = dict(old_state, transferDirectory=xfer)
+        trainer.data_handle = handles[designated]
+        try:
+            trainer.train_local(
+                handles[designated].get_train_dataset(),
+                handles[designated].get_validation_dataset(),
+            )
+        finally:
+            trainer.state, trainer.data_handle = old_state, old_handle
+            wfile = rc.get("weights_file")
+            for k, v in saved.items():
+                if v is None:
+                    # absent before pretrain (or legitimately None): remove
+                    # rather than leave a None that defeats `.get(k, default)`
+                    rc.pop(k, None)
+                else:
+                    rc[k] = v
+        # broadcast-equivalent adoption: every site = fresh init + weights
+        trainer.init_nn()
+        if wfile and os.path.exists(os.path.join(xfer, wfile)):
+            trainer.load_checkpoint(
+                full_path=os.path.join(xfer, wfile), load_optimizer=False
+            )
+        logger.info(
+            f"MeshEngine: pretrain at {designated} "
+            f"({'adopted ' + wfile if wfile else 'no improvement'})",
+            rc.get("verbose", True),
+        )
+        return True
 
     # ------------------------------------------------------------- evaluation
     def _mesh_eval(self, fed, handles, which):

@@ -27,6 +27,7 @@ class _ConvBlock(nn.Module):
     features: int
     stride: int = 1
     dtype: jnp.dtype = jnp.bfloat16
+    fused_gn: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -34,7 +35,17 @@ class _ConvBlock(nn.Module):
             self.features, (3, 3, 3), strides=(self.stride,) * 3,
             padding="SAME", use_bias=False, dtype=self.dtype,
         )(x)
-        x = nn.GroupNorm(num_groups=min(8, self.features), dtype=self.dtype)(x)
+        groups = min(8, self.features)
+        if self.fused_gn:
+            # fused GN+ReLU with the closed-form backward (docs/PERF.md GN
+            # lever); name pins the param path to the nn.GroupNorm layout
+            from ..ops.groupnorm import fused_group_norm_module
+
+            return fused_group_norm_module()(
+                num_groups=groups, use_relu=True, dtype=self.dtype,
+                name="GroupNorm_0",
+            )(x)
+        x = nn.GroupNorm(num_groups=groups, dtype=self.dtype)(x)
         return nn.relu(x)
 
 
@@ -67,14 +78,26 @@ class _StemConv(nn.Module):
 
 
 class VBM3DNet(nn.Module):
-    """Volumetric CNN: stem + 4 strided stages + GAP head."""
+    """Volumetric CNN: stem + 4 strided stages + GAP head.
+
+    ``width`` sets the channel progression (w, 2w, 4w, 8w).  The default 16
+    is the benchmark flagship; ``width=32`` fills the MXU's 128 output
+    lanes from stage 2 on (higher MFU at more FLOPs/sample — report both,
+    docs/PERF.md).  ``fused_gn`` routes every norm through the fused
+    GroupNorm(+ReLU) with the closed-form backward (exact; kill switch
+    ``cache['fused_groupnorm']=False`` / env ``COINN_NO_FUSED_GN``).
+    """
 
     num_classes: int = 2
     width: int = 16
     dtype: jnp.dtype = jnp.bfloat16
+    fused_gn: bool = True
 
     @nn.compact
     def __call__(self, x, train=False, rng=None):
+        import os
+
+        fused = self.fused_gn and not os.environ.get("COINN_NO_FUSED_GN")
         # x: (B, D, H, W) or (B, D, H, W, 1)
         if x.ndim == 4:
             x = x[..., None]
@@ -82,14 +105,22 @@ class VBM3DNet(nn.Module):
         w = self.width
         # stem: space-to-depth stride-2 conv (see _StemConv) + GN + relu
         x = _StemConv(w, dtype=self.dtype)(x)  # /2
-        x = nn.GroupNorm(num_groups=min(8, w), dtype=self.dtype)(x)
-        x = nn.relu(x)
-        x = _ConvBlock(w, dtype=self.dtype)(x)
-        x = _ConvBlock(2 * w, stride=2, dtype=self.dtype)(x)  # /4
-        x = _ConvBlock(2 * w, dtype=self.dtype)(x)
-        x = _ConvBlock(4 * w, stride=2, dtype=self.dtype)(x)  # /8
-        x = _ConvBlock(4 * w, dtype=self.dtype)(x)
-        x = _ConvBlock(8 * w, stride=2, dtype=self.dtype)(x)  # /16
+        if fused:
+            from ..ops.groupnorm import fused_group_norm_module
+
+            x = fused_group_norm_module()(
+                num_groups=min(8, w), use_relu=True, dtype=self.dtype,
+                name="GroupNorm_0",
+            )(x)
+        else:
+            x = nn.GroupNorm(num_groups=min(8, w), dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = _ConvBlock(w, dtype=self.dtype, fused_gn=fused)(x)
+        x = _ConvBlock(2 * w, stride=2, dtype=self.dtype, fused_gn=fused)(x)  # /4
+        x = _ConvBlock(2 * w, dtype=self.dtype, fused_gn=fused)(x)
+        x = _ConvBlock(4 * w, stride=2, dtype=self.dtype, fused_gn=fused)(x)  # /8
+        x = _ConvBlock(4 * w, dtype=self.dtype, fused_gn=fused)(x)
+        x = _ConvBlock(8 * w, stride=2, dtype=self.dtype, fused_gn=fused)(x)  # /16
         x = jnp.mean(x, axis=(1, 2, 3))  # global average pool
         x = jnp.asarray(x, jnp.float32)
         if train and rng is not None:
@@ -119,6 +150,7 @@ class VBMTrainer(COINNTrainer):
             num_classes=int(self.cache.get("num_classes", 2)),
             width=int(self.cache.get("model_width", 16)),
             dtype=jnp.dtype(self.cache.setdefault("compute_dtype", "bfloat16")),
+            fused_gn=bool(self.cache.get("fused_groupnorm", True)),
         )
 
     def example_inputs(self):

@@ -16,6 +16,7 @@ import numpy as np
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 from ..data import COINNDataset
 from ..metrics import classification_outputs
@@ -25,12 +26,19 @@ from ..utils import stable_file_id
 
 
 class MultiHeadSelfAttention(nn.Module):
-    """Self-attention over (B, T, D) through the fused flash kernel."""
+    """Self-attention over (B, T, D) through the fused flash kernel.
+
+    ``sp_axis`` switches to exact global ring attention over that mesh axis
+    (the module then sees only this rank's sequence block and MUST be traced
+    inside a ``shard_map`` binding the axis — see ``parallel/seq_mesh.py``).
+    Parameters are identical either way, so one checkpoint serves both.
+    """
 
     num_heads: int
     causal: bool = False
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = None  # None → platform default (pallas on TPU)
+    sp_axis: str = None  # sequence-parallel mesh axis (ring attention)
 
     @nn.compact
     def __call__(self, x):
@@ -40,9 +48,18 @@ class MultiHeadSelfAttention(nn.Module):
         qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype)(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         split = lambda a: a.reshape(b, t, self.num_heads, hd).transpose(0, 2, 1, 3)
-        out = flash_attention(
-            split(q), split(k), split(v), causal=self.causal, impl=self.attn_impl
-        )
+        if self.sp_axis:
+            from ..parallel.ring_attention import ring_attention
+
+            out = ring_attention(
+                split(q), split(k), split(v), axis_name=self.sp_axis,
+                causal=self.causal, impl=self.attn_impl,
+            )
+        else:
+            out = flash_attention(
+                split(q), split(k), split(v), causal=self.causal,
+                impl=self.attn_impl,
+            )
         out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
         return nn.Dense(d, use_bias=False, dtype=self.dtype)(out)
 
@@ -53,13 +70,15 @@ class TransformerBlock(nn.Module):
     causal: bool = False
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = None
+    sp_axis: str = None
 
     @nn.compact
     def __call__(self, x):
         d = x.shape[-1]
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MultiHeadSelfAttention(
-            self.num_heads, self.causal, self.dtype, self.attn_impl
+            self.num_heads, self.causal, self.dtype, self.attn_impl,
+            self.sp_axis,
         )(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype)(h)
@@ -68,7 +87,14 @@ class TransformerBlock(nn.Module):
 
 
 class SeqClassifier(nn.Module):
-    """Encoder over continuous feature sequences → mean-pool → classes."""
+    """Encoder over continuous feature sequences → mean-pool → classes.
+
+    With ``sp_axis`` set the module computes the SAME function on a
+    sequence-sharded input (this rank's ``(B, T/sp, F)`` block, inside a
+    ``shard_map``): attention rings over the axis, the positional table is
+    sliced at this rank's global offset, and the mean-pool reduces over the
+    axis.  Parameter shapes are independent of ``sp_axis``.
+    """
 
     num_classes: int = 2
     d_model: int = 128
@@ -78,24 +104,45 @@ class SeqClassifier(nn.Module):
     causal: bool = False
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = None
+    sp_axis: str = None
 
     @nn.compact
     def __call__(self, x):
-        # x: (B, T, F) continuous features (e.g. ROI timeseries)
+        # x: (B, T, F) continuous features (e.g. ROI timeseries); under
+        # sequence parallelism T is this rank's block of the global sequence
         x = jnp.asarray(x, self.dtype)
         b, t, _ = x.shape
         x = nn.Dense(self.d_model, dtype=self.dtype)(x)
         pos = self.param(
             "pos_embed", nn.initializers.normal(0.02), (self.max_len, self.d_model)
         )
-        x = x + pos[:t][None].astype(self.dtype)
+        if self.sp_axis:
+            # axis_size and t are static: fail at trace time like the
+            # unsharded path's pos[:t] shape error would — dynamic_slice
+            # would otherwise CLAMP the out-of-range offset and silently
+            # reuse block-0 positions
+            t_global = t * lax.axis_size(self.sp_axis)
+            if t_global > self.max_len:
+                raise ValueError(
+                    f"global sequence length {t_global} exceeds max_len "
+                    f"{self.max_len}"
+                )
+            offset = lax.axis_index(self.sp_axis) * t
+            pslice = lax.dynamic_slice_in_dim(pos, offset, t, axis=0)
+            x = x + pslice[None].astype(self.dtype)
+        else:
+            x = x + pos[:t][None].astype(self.dtype)
         for _ in range(self.num_layers):
             x = TransformerBlock(
                 self.num_heads, causal=self.causal, dtype=self.dtype,
-                attn_impl=self.attn_impl,
+                attn_impl=self.attn_impl, sp_axis=self.sp_axis,
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
-        pooled = jnp.mean(x, axis=1)
+        if self.sp_axis:
+            t_global = t * lax.axis_size(self.sp_axis)
+            pooled = lax.psum(jnp.sum(x, axis=1), self.sp_axis) / t_global
+        else:
+            pooled = jnp.mean(x, axis=1)
         return nn.Dense(self.num_classes, dtype=jnp.float32)(pooled)
 
 
@@ -122,10 +169,17 @@ class SyntheticSeqDataset(COINNDataset):
 
 
 class SeqTrainer(COINNTrainer):
-    """Trainer wiring for the sequence family (same contract as FSVTrainer)."""
+    """Trainer wiring for the sequence family (same contract as FSVTrainer).
 
-    def _init_nn_model(self):
-        self.nn["seq_net"] = SeqClassifier(
+    Implements ``iteration_sharded``, so the federated mesh transport can
+    shard each site's sequences over an ``sp`` axis (ring attention inside
+    ``MeshFederation``'s compiled round — ``cache['sequence_parallel']``,
+    ``parallel/seq_mesh.py``) with the full trainer stack: optax update,
+    metrics, checkpoints — one checkpoint format across sp values.
+    """
+
+    def _build_model(self, sp_axis=None):
+        return SeqClassifier(
             num_classes=int(self.cache.get("num_classes", 2)),
             d_model=int(self.cache.get("d_model", 128)),
             num_heads=int(self.cache.get("num_heads", 4)),
@@ -134,6 +188,19 @@ class SeqTrainer(COINNTrainer):
             causal=bool(self.cache.get("causal", False)),
             dtype=jnp.dtype(self.cache.setdefault("compute_dtype", "float32")),
             attn_impl=self.cache.get("attn_impl"),
+            sp_axis=sp_axis,
+        )
+
+    def _init_nn_model(self):
+        self.nn["seq_net"] = self._build_model()
+
+    def iteration_sharded(self, params, batch, rng=None, sp_axis=None):
+        if sp_axis is None:
+            return self.iteration(params, batch, rng)
+        model = self._build_model(sp_axis=sp_axis)
+        logits = model.apply(params["seq_net"], batch["inputs"])
+        return classification_outputs(
+            logits, batch["labels"], mask=batch.get("_mask")
         )
 
     def example_inputs(self):

@@ -174,6 +174,23 @@ class NNTrainer:
         for :class:`COINNAverages`), anything else is carried through."""
         raise NotImplementedError
 
+    def iteration_sharded(self, params, batch, rng=None, sp_axis=None):
+        """Sequence-parallel-aware iteration (hook for the ``(site, sp)``
+        mesh, :class:`~..parallel.seq_mesh.SeqMeshFederation`).
+
+        Called inside ``shard_map`` with ``batch['inputs']``'s sequence axis
+        sharded over mesh axis ``sp_axis``; the model must attend globally
+        (ring attention), offset positional state by its sequence block, and
+        reduce any pooling over the axis.  Default: plain ``iteration`` when
+        ``sp_axis`` is None, otherwise refuse — silently attending only to
+        the local block would change the math, not just the layout."""
+        if sp_axis is None:
+            return self.iteration(params, batch, rng)
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement sequence parallelism; "
+            "override iteration_sharded() to run with sequence_parallel > 1"
+        )
+
     def _init_optimizer(self):
         """Default: one Adam per model at ``cache['learning_rate']``."""
         lr = float(self.cache.get("learning_rate", 1e-3))
@@ -258,6 +275,7 @@ class NNTrainer:
         )
         # operational env kill-switches are read at trace time too
         cfg["__env_no_s2d__"] = os.environ.get("COINN_NO_S2D", "")
+        cfg["__env_no_fused_gn__"] = os.environ.get("COINN_NO_FUSED_GN", "")
         key = (
             type(self).__module__,
             type(self).__qualname__,
@@ -402,7 +420,7 @@ class NNTrainer:
         checkpoint keep their current weights and optimizer state.
         ``cache['torch_name_map']`` ({torch name: 'flax/param/path'})
         overrides positional pairing for divergent definition orders."""
-        from ..utils.torch_import import convert_state_dict, load_torch_payload
+        from ..utils.torch_import import convert_torch_checkpoint
 
         self.last_checkpoint_extra = {}
         name_map = self.cache.get("torch_name_map") or None
@@ -418,19 +436,7 @@ class NNTrainer:
                 "torch checkpoint import needs initialized models — call "
                 "init_nn() before load_checkpoint() on a torch file"
             )
-        state_dicts, _torch_opt = load_torch_payload(path)
-        if set(state_dicts) == {None}:  # raw state_dict -> first model
-            state_dicts = {next(iter(template)): state_dicts[None]}
-        unknown = set(state_dicts) - set(template)
-        if unknown:
-            raise KeyError(
-                f"checkpoint models {sorted(unknown)} not in trainer models "
-                f"{list(template)}"
-            )
-        imported = {
-            n: convert_state_dict(template[n], sd, name_map=name_map)
-            for n, sd in state_dicts.items()
-        }
+        imported = convert_torch_checkpoint(template, path, name_map=name_map)
         if self.train_state is None:
             self._params = {**template, **imported}
             return self
@@ -689,18 +695,21 @@ class NNTrainer:
         return fn(ts, stacked_batches)
 
     def _grads_uncompiled(self, ts, stacked, metrics_shell, averages_shell,
-                          grad_reduce=None):
+                          grad_reduce=None, iteration_fn=None):
         """``grad_reduce(g, batch) -> g``: optional per-micro-batch gradient
         reduction applied INSIDE the scan — the hook data-parallel wrappers
         use to mask-weight-average shard gradients over a device axis so a
         padded batch split unevenly across devices still yields exactly the
-        full-batch masked-mean gradient (see ``parallel/mesh.py``)."""
+        full-batch masked-mean gradient (see ``parallel/mesh.py``).
+        ``iteration_fn`` overrides ``self.iteration`` (the sequence-parallel
+        mesh passes the sp-aware variant)."""
         # non-jit-safe metrics (AUC) can't accumulate on device — carry the
         # per-microbatch scores out of the scan so the host can feed them
         collect_host = not getattr(metrics_shell, "jit_safe", True)
+        it_fn = iteration_fn if iteration_fn is not None else self.iteration
 
         def loss_fn(params, batch, rng):
-            it = self.iteration(params, batch, rng)
+            it = it_fn(params, batch, rng)
             return it["loss"], it
 
         def body(carry, batch):

@@ -170,6 +170,86 @@ class COINNLocal:
             out["phase"] = Phase.PRE_COMPUTATION.value
         return out
 
+    # ----------------------------------------- fresh-process round survival
+    # The reference assumes a PERSISTENT node process (live nn/optimizer in
+    # cache, ref ``trainer.py:18-20``) — an engine that spawns a fresh
+    # process per invocation would silently re-init mid-run there.  Here:
+    # with ``cache['persist_round_state']`` every invocation's live state
+    # (train state + the compression engine's mid-protocol fields, e.g.
+    # PowerSGD's Ms/Phats between the P-sync and Q-sync invocations) writes
+    # to disk and transparently restores next invocation; without it, a
+    # mid-run invocation that lost the live state FAILS LOUDLY instead of
+    # silently re-initializing (see ``compute``).
+    def _round_state_path(self):
+        return os.path.join(
+            self.state.get("outputDirectory", "."), ".round_state.ckpt"
+        )
+
+    def _persist_round_state(self, trainer):
+        if not self.cache.get("persist_round_state"):
+            return
+        if trainer.train_state is None:
+            return
+        extra = {}
+        psgd = self.cache.get("_powersgd_state")
+        if psgd is not None:
+            extra["powersgd"] = psgd.serialize(full=True)
+        # the epoch-level train-score accumulators span many rounds (popped
+        # at the epoch barrier) — raw-count payloads, exact across restarts
+        ep_a = self.cache.get("_ep_averages")
+        ep_m = self.cache.get("_ep_metrics")
+        if ep_a is not None:
+            extra["ep_averages"] = ep_a.serialize()
+        if ep_m is not None:
+            extra["ep_metrics"] = ep_m.serialize()
+        trainer.save_checkpoint(
+            full_path=self._round_state_path(), extra=extra
+        )
+
+    def _restore_round_state(self, trainer):
+        """Rebuild the live train state (and mid-protocol engine state) from
+        the previous invocation's round file.  Returns True on success."""
+        from .. import parallel
+        from ..utils import tensorutils
+
+        path = self._round_state_path()
+        if not (self.cache.get("persist_round_state") and os.path.exists(path)):
+            return False
+        try:
+            trainer.init_nn(init_weights=False, init_optimizer=False)
+            trainer._init_optimizer()
+            trainer._init_train_state()
+            trainer.load_checkpoint(full_path=path)
+        except Exception as exc:  # noqa: BLE001 — corrupt round file
+            logger.warn(f"Unreadable round state {path} ({exc})")
+            return False
+        extra = getattr(trainer, "last_checkpoint_extra", {})
+        if "powersgd" in extra:
+            self.cache["_powersgd_state"] = (
+                parallel.powersgd._PowerSGDState.deserialize(extra["powersgd"])
+            )
+        if "ep_averages" in extra:
+            shell = trainer.new_averages()
+            self.cache["_ep_averages"] = type(shell).deserialize(
+                tensorutils.aslist(extra["ep_averages"])
+            )
+        if "ep_metrics" in extra:
+            shell = trainer.new_metrics()
+            self.cache["_ep_metrics"] = type(shell).deserialize(
+                tensorutils.aslist(extra["ep_metrics"])
+            )
+        self.cache["_train_state"] = trainer.train_state
+        return True
+
+    def _midrun_state_lost(self):
+        """True when this invocation is mid-run but the live state is gone —
+        the silent-reinit hazard a fresh-process engine hits."""
+        return (
+            int(self.cache.get("epoch", 0) or 0) > 0
+            or int(self.cache.get("cursor", 0) or 0) > 0
+            or bool(self.cache.get(Key.TRAIN_SERIALIZABLE.value))
+        )
+
     # ------------------------------------------------------- mid-run resume
     def _resume_pointer(self):
         return os.path.join(
@@ -298,8 +378,23 @@ class COINNLocal:
                 trainer.init_nn(init_weights=False, init_optimizer=False)
                 trainer._init_optimizer()
                 trainer.train_state = self.cache["_train_state"]
+            elif self._restore_round_state(trainer):
+                pass  # fresh-process engine: rebuilt from the round file
             elif self.cache.get("resume") and self._try_resume(trainer):
                 pass  # rebuilt from the epoch-barrier autosave
+            elif self._midrun_state_lost():
+                # a fresh-process engine without persist_round_state would
+                # silently re-initialize mid-run here — refuse instead
+                raise RuntimeError(
+                    "mid-run invocation (epoch="
+                    f"{self.cache.get('epoch')}, cursor="
+                    f"{self.cache.get('cursor')}) but the live train state "
+                    "is gone — this engine runs each invocation in a fresh "
+                    "process.  Set cache['persist_round_state']=true (per-"
+                    "round on-disk state, DEPLOY.md §3) or run the node in "
+                    "a persistent process; cache['resume']=true recovers "
+                    "from the last epoch-barrier autosave only."
+                )
             else:
                 trainer.init_nn()
 
@@ -357,9 +452,11 @@ class COINNLocal:
                         shutil.copy(src, dst)
                         break
 
-        # persist the live train state across engine invocations (in cache)
+        # persist the live train state across engine invocations (in cache
+        # for a persistent process; on disk for a fresh-process engine)
         if trainer.train_state is not None:
             self.cache["_train_state"] = trainer.train_state
+        self._persist_round_state(trainer)
         return self.out
 
     def __call__(self, *a, **kw):
@@ -371,7 +468,16 @@ class COINNLocal:
                 f"local:{self.input.get('phase', Phase.INIT_RUNS.value)}"
             ):
                 self.compute(*a, **kw)
-            return {"output": self.out}
+            # "cache" carries the JSON-able node cache back to engines that
+            # round-trip it between fresh-process invocations (the live
+            # ``_``-prefixed pytrees stay process-local by design)
+            return {
+                "output": self.out,
+                "cache": utils.clean_recursive({
+                    k: v for k, v in dict(self.cache).items()
+                    if not str(k).startswith("_")
+                }),
+            }
         except Exception:
             traceback.print_exc()
             raise RuntimeError(f"Local node failed with partial out: {self.out}")

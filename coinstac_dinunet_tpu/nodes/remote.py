@@ -292,6 +292,11 @@ class COINNRemote:
             return {
                 "output": self.out,
                 "success": check(all, "phase", Phase.SUCCESS.value, self.input),
+                # JSON-able cache for fresh-process engines (see COINNLocal)
+                "cache": utils.clean_recursive({
+                    k: v for k, v in dict(self.cache).items()
+                    if not str(k).startswith("_")
+                }),
             }
         except Exception:
             traceback.print_exc()

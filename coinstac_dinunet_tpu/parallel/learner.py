@@ -15,6 +15,8 @@ gradients that came back.  TPU-first differences:
 """
 import os
 
+import numpy as np
+
 from .. import config
 from ..config.keys import Key, Mode
 from ..utils import tensorutils
@@ -88,6 +90,14 @@ class COINNLearner:
         ts = self.trainer.train_state
         grads, aux = self.trainer.compute_grads(ts, stacked)
         self.trainer.train_state = ts.replace(rng=aux["rng"])
+        aux = dict(aux)
+        # participation: did this round carry ANY unmasked sample?  Ships as
+        # ``grad_weight`` so the reducer can exclude fully-padded lockstep
+        # rounds from the average (mesh-transport parity)
+        mask = stacked.get("_mask")
+        aux["participation"] = (
+            float(np.asarray(mask).sum()) if mask is not None else 1.0
+        )
         return grads, out, aux
 
     def to_reduce(self):
@@ -99,6 +109,7 @@ class COINNLearner:
         self._save_wire(config.grads_file, flat)
         out["grads_file"] = config.grads_file
         out["reduce"] = True
+        out["grad_weight"] = 1.0 if aux.get("participation", 1.0) > 0 else 0.0
         self._track_train_scores(aux)
         return out
 

@@ -94,6 +94,7 @@ class MeshFederation:
                     "(ref rankdad/__init__.py:48-49)"
                 )
         self.comm_state = {}  # site-sharded engine state (PowerSGD EF memory)
+        self._sample_batch_keys = None  # batch keys (per-key spec subclasses)
         self._hi_ix = None  # static: flat-leaf indices compressed by PowerSGD
         self._dad = None  # rankDAD capture plan (layer keys, leaf map, shapes)
         self._step = None
@@ -346,11 +347,51 @@ class MeshFederation:
         return step
 
     # ---------------------------------------------------------- compiled step
+    # ---- intra-site axis hooks -------------------------------------------
+    # The compiled round's scaffold (site collectives, PowerSGD exchange,
+    # donate/jit/shard_map wrapper) is shared between intra-site DATA
+    # parallelism (this class: batch shards over ``device``) and intra-site
+    # SEQUENCE parallelism (:class:`~.seq_mesh.SeqMeshFederation`: sequences
+    # shard over ``sp``).  Subclasses override only these hooks.
+
+    def _iteration_fn(self):
+        """Iteration override passed into the trainer's grad scan (None =
+        the trainer's plain ``iteration``)."""
+        return None
+
+    def _intra_grad_reduce(self):
+        """Per-micro-batch gradient reduction over the intra-site axis."""
+        # mask-weighted mean over the batch shards (exact masked-mean even
+        # when the padded tail splits unevenly across devices)
+        return self.trainer.make_grad_reduce("device")
+
+    def _site_weight(self, stacked):
+        """1 iff this site's round carried any unmasked sample."""
+        mask = stacked.get("_mask")
+        if mask is None:
+            return jnp.float32(1)
+        n_site = jax.lax.psum(
+            jnp.sum(jnp.asarray(mask, jnp.float32)), "device"
+        )
+        return (n_site > 0).astype(jnp.float32)
+
+    def _aux_axes(self):
+        """Mesh axes the aux outputs (metrics/averages/loss) reduce over —
+        every axis whose shards carry DISTINCT samples."""
+        return ("site", "device")
+
+    def _train_batch_specs(self):
+        """in_specs entry for the stacked (site, k, B, ...) batch pytree."""
+        return P("site", None, "device")
+
     def _build_step(self, engine=None):
         trainer = self.trainer
         metrics_shell, averages_shell = trainer._metrics_shell()
         engine = engine or self.agg_engine
         hi_ix = self._hi_ix
+        iteration_fn = self._iteration_fn()
+        intra_grad_reduce = self._intra_grad_reduce()
+        aux_axes = self._aux_axes()
 
         def _site_mean(x, w, wsum):
             """Participation-weighted mean over the site axis: a site whose
@@ -369,8 +410,8 @@ class MeshFederation:
             new_err, new_q, out = [], [], list(leaves)
             for j, i in enumerate(hi_ix):
                 leaf = leaves[i]
-                # grads are already device-reduced inside the scan
-                # (_device_grad_reduce), so only the site axis remains
+                # grads are already intra-site-reduced inside the scan
+                # (intra_grad_reduce), so only the site axis remains
                 m2 = leaf.reshape(leaf.shape[0], -1).astype(jnp.float32)
                 # comm leaves keep their (sharded, now size-1) site axis
                 M = m2 + comm["errors"][j][0]
@@ -388,10 +429,6 @@ class MeshFederation:
             grads = jax.tree_util.tree_unflatten(treedef, out)
             return grads, {"errors": new_err, "qs": new_q}
 
-        # mask-weighted mean over the intra-site device shards (shared with
-        # the trainer's local DataParallel path)
-        _device_grad_reduce = trainer.make_grad_reduce("device")
-
         def site_step(ts, stacked, comm):
             # drop the sharded (now size-1) site axis from the batch view
             stacked = jax.tree_util.tree_map(lambda x: x[0], stacked)
@@ -400,23 +437,14 @@ class MeshFederation:
             ts = ts.replace(rng=jax.random.fold_in(orig_rng, jax.lax.axis_index("site")))
             grads, aux = trainer._grads_uncompiled(
                 ts, stacked, metrics_shell, averages_shell,
-                grad_reduce=_device_grad_reduce,
+                grad_reduce=intra_grad_reduce, iteration_fn=iteration_fn,
             )
-            # site participation weight: 1 iff this site's round carried any
-            # unmasked sample (over every micro-batch and device shard)
-            mask = stacked.get("_mask")
-            if mask is not None:
-                n_site = jax.lax.psum(
-                    jnp.sum(jnp.asarray(mask, jnp.float32)), "device"
-                )
-                w = (n_site > 0).astype(jnp.float32)
-            else:
-                w = jnp.float32(1)
+            w = self._site_weight(stacked)
             wsum = jnp.maximum(jax.lax.psum(w, "site"), 1.0)
             if engine == "powerSGD":
                 grads, comm = _powersgd_exchange(grads, comm, w, wsum)
             else:
-                # device axis already reduced inside the scan
+                # intra-site axis already reduced inside the scan
                 grads = jax.tree_util.tree_map(
                     lambda g: _site_mean(g, w, wsum), grads
                 )
@@ -426,24 +454,24 @@ class MeshFederation:
             ts = ts.replace(rng=jax.random.split(orig_rng)[0])
             aux = dict(aux)
             if aux.get("metrics") is not None:
-                aux["metrics"] = jax.lax.psum(aux["metrics"], ("site", "device"))
+                aux["metrics"] = jax.lax.psum(aux["metrics"], aux_axes)
             if "host_scores" in aux:
                 # per-site score streams (non-jit-safe metrics, e.g. AUC):
                 # gather along the micro-batch axis so the replicated output
                 # carries every site's samples for host accumulation
                 aux["host_scores"] = jax.tree_util.tree_map(
                     lambda x: jax.lax.all_gather(
-                        x, ("site", "device"), axis=0, tiled=True
+                        x, aux_axes, axis=0, tiled=True
                     ),
                     aux["host_scores"],
                 )
-            aux["averages"] = jax.lax.psum(aux["averages"], ("site", "device"))
-            aux["loss"] = jax.lax.pmean(aux["loss"], ("site", "device"))
+            aux["averages"] = jax.lax.psum(aux["averages"], aux_axes)
+            aux["loss"] = jax.lax.pmean(aux["loss"], aux_axes)
             aux["rng"] = ts.rng
             return ts, aux, comm
 
         comm_spec = jax.tree_util.tree_map(lambda _: P("site"), self.comm_state)
-        batch_spec = P("site", None, "device")
+        batch_spec = self._train_batch_specs()
         mesh = self.mesh
 
         # donate train state + engine comm state (both replaced every round);
@@ -474,6 +502,11 @@ class MeshFederation:
         PowerSGD honors ``start_powerSGD_iter``: the first N rounds run the
         plain-dSGD step (error feedback untouched), matching the file
         transport and ref ``powersgd/__init__.py:61-64,130-134``."""
+        # batch key set first: per-key-spec subclasses build specs from it
+        if isinstance(site_batches, (list, tuple)):
+            self._sample_batch_keys = tuple(site_batches[0][0].keys())
+        else:
+            self._sample_batch_keys = tuple(site_batches.keys())
         if self._step is None:
             if self.agg_engine == "powerSGD" and not self.comm_state:
                 self.init_powersgd_state(
@@ -514,20 +547,27 @@ class MeshFederation:
         return aux
 
     # ------------------------------------------------------------- evaluation
+    def _eval_batch_specs(self):
+        """in_specs entry for the (site, B, ...) eval batch pytree."""
+        return P("site", "device")
+
     def _build_eval(self):
         trainer = self.trainer
         metrics_shell, averages_shell = trainer._metrics_shell()
         mesh = self.mesh
+        iteration_fn = self._iteration_fn() or trainer.iteration
+        aux_axes = self._aux_axes()
+        eval_spec = self._eval_batch_specs()
 
         def site_eval(ts, batch):
             batch = jax.tree_util.tree_map(lambda x: x[0], batch)
-            it = trainer.iteration(ts.params, batch, None)
+            it = iteration_fn(ts.params, batch, None)
             m_state, a_state = trainer._step_outputs(
                 it, batch, metrics_shell, averages_shell
             )
             if m_state is not None:
-                m_state = jax.lax.psum(m_state, ("site", "device"))
-            a_state = jax.lax.psum(a_state, ("site", "device"))
+                m_state = jax.lax.psum(m_state, aux_axes)
+            a_state = jax.lax.psum(a_state, aux_axes)
             return m_state, a_state
 
         @jax.jit
@@ -535,7 +575,7 @@ class MeshFederation:
             return jax.shard_map(
                 site_eval,
                 mesh=mesh,
-                in_specs=(P(), P("site", "device")),
+                in_specs=(P(), eval_spec),
                 out_specs=(P(), P()),
                 check_vma=False,
             )(ts, batch)
@@ -544,17 +584,23 @@ class MeshFederation:
 
     def eval_step(self, site_batches):
         """Globally-reduced evaluation over one batch per site."""
-        if self._eval is None:
-            self._eval = self._build_eval()
         if isinstance(site_batches, (list, tuple)):
+            self._sample_batch_keys = tuple(site_batches[0].keys())
             glob = {
                 k: jnp.stack([jnp.asarray(b[k]) for b in site_batches])
                 for k in site_batches[0]
             }
         else:
+            self._sample_batch_keys = tuple(site_batches.keys())
             glob = site_batches
+        if self._eval is None:
+            self._eval = self._build_eval()
+        spec = self._eval_batch_specs()
         shardings = {
-            k: NamedSharding(self.mesh, P("site", "device")) for k in glob
+            k: NamedSharding(
+                self.mesh, spec[k] if isinstance(spec, dict) else spec
+            )
+            for k in glob
         }
         glob = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s), glob, shardings

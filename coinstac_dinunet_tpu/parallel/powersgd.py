@@ -102,41 +102,70 @@ class _PowerSGDState:
         self.rank1 = None  # raw low-rank leaves riding along
         self.shapes = None  # original high-rank leaf shapes
         self.hi = self.lo = None  # leaf index split
+        self.weight = 1.0  # this round's participation (P-phase → Q-phase)
 
     # ---- checkpointable carried state (epoch-barrier resume) -------------
     # Ms/Phats/rank1/shapes/hi/lo are transient within one two-round wire
     # protocol and are rebuilt every round; only the error-feedback memory,
-    # the warm-started Qs and the warm-up counter carry across rounds —
+    # the warm-started Qs and the warm-up counter carry across ROUNDS —
     # losing them silently degrades convergence (VERDICT r2 weak #2).
-    def serialize(self):
-        return {
+    # ``full=True`` additionally captures the mid-protocol fields, so a
+    # FRESH-PROCESS engine can restart between the P-sync and Q-sync
+    # invocations of one round (``persist_round_state``, DEPLOY.md §3).
+    def serialize(self, full=False):
+        out = {
             "iteration": int(self.iteration),
+            "weight": float(self.weight),
             "errors": ([np.asarray(e, np.float32) for e in self.errors]
                        if self.errors is not None else []),
             "Qs": ([np.asarray(q, np.float32) for q in self.Qs]
                    if self.Qs is not None else []),
         }
+        if full:
+            if self.Ms is not None:
+                out["Ms"] = [np.asarray(m, np.float32) for m in self.Ms]
+            if self.Phats is not None:
+                out["Phats"] = [np.asarray(p, np.float32) for p in self.Phats]
+            if self.rank1 is not None:
+                out["rank1"] = [np.asarray(r) for r in self.rank1]
+            if self.shapes is not None:
+                out["shapes"] = [list(s) for s in self.shapes]
+            if self.hi is not None:
+                out["hi"] = [int(i) for i in self.hi]
+                out["lo"] = [int(i) for i in self.lo]
+        return out
 
     @classmethod
     def deserialize(cls, payload):
         st = cls()
         st.iteration = int(payload.get("iteration", 0))
+        st.weight = float(payload.get("weight", 1.0))
         errors = [jnp.asarray(np.asarray(e), jnp.float32)
                   for e in _aslist(payload.get("errors"))]
         qs = [jnp.asarray(np.asarray(q), jnp.float32)
               for q in _aslist(payload.get("Qs"))]
         st.errors = errors or None
         st.Qs = qs or None
+        if payload.get("Ms") is not None:
+            st.Ms = [jnp.asarray(np.asarray(m), jnp.float32)
+                     for m in _aslist(payload["Ms"])]
+        if payload.get("Phats") is not None:
+            st.Phats = [jnp.asarray(np.asarray(p), jnp.float32)
+                        for p in _aslist(payload["Phats"])]
+        if payload.get("rank1") is not None:
+            st.rank1 = [np.asarray(r) for r in _aslist(payload["rank1"])]
+        if payload.get("shapes") is not None:
+            st.shapes = [tuple(int(d) for d in s)
+                         for s in _aslist(payload["shapes"])]
+        if payload.get("hi") is not None:
+            st.hi = [int(i) for i in _aslist(payload["hi"])]
+            st.lo = [int(i) for i in _aslist(payload["lo"])]
         return st
 
 
-def _aslist(x):
-    """msgpack may restore a list as a dict {\"0\": ..., \"1\": ...}."""
-    if x is None:
-        return []
-    if isinstance(x, dict):
-        return [x[k] for k in sorted(x, key=lambda s: int(s))]
-    return list(x)
+# canonical home: utils.tensorutils.aslist (kept under the old name for the
+# mesh/module-internal imports)
+_aslist = tensorutils.aslist
 
 
 class PowerSGDLearner(COINNLearner):
@@ -179,6 +208,9 @@ class PowerSGDLearner(COINNLearner):
         self._track_train_scores(aux)
         flat = [jnp.asarray(g) for g in jax.tree_util.tree_leaves(grads)]
         st = self.psgd
+        # participation rides BOTH wire rounds of this protocol round (the
+        # Q-sync happens in a later engine invocation)
+        st.weight = 1.0 if aux.get("participation", 1.0) > 0 else 0.0
         st.hi, st.lo = _split_leaves(flat)
         st.rank1 = [np.asarray(flat[i], config.wire_dtype(self.precision_bits)) for i in st.lo]
         Ms = [_as_matrix(flat[i]) for i in st.hi]
@@ -194,6 +226,7 @@ class PowerSGDLearner(COINNLearner):
         out["powerSGD_P_file"] = config.powersgd_P_file
         out["powerSGD_phase"] = PHASE_P_SYNC
         out["reduce"] = True
+        out["grad_weight"] = st.weight
         return out
 
     def _phase_Q(self):
@@ -212,6 +245,7 @@ class PowerSGDLearner(COINNLearner):
         out["rank1_file"] = rank1_file
         out["powerSGD_phase"] = PHASE_Q_SYNC
         out["reduce"] = True
+        out["grad_weight"] = st.weight
         return out
 
     def step(self):

@@ -260,6 +260,10 @@ class DADLearner(COINNLearner):
         out["dad_data_file"] = config.dad_data_file
         out["dad_rest_file"] = dad_rest_file
         out["reduce"] = True
+        mask = batch.get("_mask")
+        out["grad_weight"] = (
+            1.0 if mask is None or float(np.sum(np.asarray(mask))) > 0 else 0.0
+        )
         self._track_dad_scores(batch, loss, it)
         return out
 
@@ -313,19 +317,22 @@ class DADReducer(COINNReducer):
 
     def reduce(self):
         site_payloads = self._load("dad_data_file")
-        n_sites = len(site_payloads)
         n_layers = len(site_payloads[0]) // 2
         wire = config.wire_dtype(self.precision_bits)
         out_payload = []
         key = jax.random.PRNGKey(int(self.cache.get("seed", 0)) + 29)
-        # mean semantics across sites: scale the grad side by 1/n_sites so
-        # concat-and-multiply averages site contributions (dSGD parity)
-        scale = 1.0 / float(n_sites)
+        # mean semantics across sites: scale the grad side by w_i/Σw so
+        # concat-and-multiply averages PARTICIPATING site contributions
+        # (dSGD parity incl. fully-padded lockstep rounds — mesh
+        # ``_site_weight`` semantics)
+        w = np.asarray(self._site_weights(), np.float32)
+        scales = w / max(float(w.sum()), 1.0)
         for li in range(n_layers):
             # concat along the rank axis = summed per-site approximations —
             # the exact-concat semantics of ref ``rankdad/__init__.py:70-98``
             B = jnp.concatenate(
-                [jnp.asarray(sp[2 * li], jnp.float32) * scale for sp in site_payloads], 0
+                [jnp.asarray(sp[2 * li], jnp.float32) * s
+                 for sp, s in zip(site_payloads, scales)], 0
             )
             C = jnp.concatenate(
                 [jnp.asarray(sp[2 * li + 1], jnp.float32) for sp in site_payloads], 0

@@ -20,26 +20,32 @@ from ..utils import logger, tensorutils
 
 
 @jax.jit
-def _stacked_mean(leaves):
-    """leaves: list of (n_sites, ...) arrays → list of site-mean arrays."""
-    return [jnp.mean(x, axis=0) for x in leaves]
+def _stacked_mean(leaves, w0):
+    """leaves: list of (n_sites, ...) arrays → participation-weighted site
+    means.  ``w0``: (n_sites,) weights (0 = the site's round carried no
+    unmasked sample — it contributes nothing AND leaves the denominator,
+    matching the mesh transport's ``_site_weight`` exactly)."""
+    denom = jnp.maximum(jnp.sum(w0), 1.0)
+    return [jnp.tensordot(w0, x, axes=(0, 0)) / denom for x in leaves]
 
 
 @jax.jit
-def _guarded_mean(leaves):
-    """Failure-detecting mean: sites whose payload contains any non-finite
-    value are excluded from every leaf's average (weight 0).
+def _guarded_mean(leaves, w0):
+    """Failure-detecting participation-weighted mean: sites whose payload
+    contains any non-finite value are excluded from every leaf's average
+    (weight 0), on top of the ``w0`` participation weights.
 
     Returns ``(means, site_ok)`` where ``site_ok`` is the (n_sites,) bool
-    vector of healthy sites.  If no site is healthy the mean is all-zeros —
-    a zero gradient instead of NaN weights (note: stateful optimizers still
-    apply momentum-driven movement on a zero gradient).  One compiled call;
-    the reference has no failure detection at all (SURVEY §5).
+    vector of finite-healthy sites (participation is NOT a failure).  If no
+    site contributes the mean is all-zeros — a zero gradient instead of NaN
+    weights (note: stateful optimizers still apply momentum-driven movement
+    on a zero gradient).  One compiled call; the reference has no failure
+    detection at all (SURVEY §5).
     """
     ok = jnp.ones((leaves[0].shape[0],), jnp.bool_)
     for x in leaves:
         ok = ok & jnp.isfinite(x).all(axis=tuple(range(1, x.ndim)))
-    w = ok.astype(jnp.float32)
+    w = ok.astype(jnp.float32) * w0
     denom = jnp.maximum(jnp.sum(w), 1.0)
     means = [
         jnp.tensordot(w, jnp.nan_to_num(x, nan=0.0, posinf=0.0, neginf=0.0),
@@ -90,10 +96,25 @@ class COINNReducer:
         )
         return fname
 
+    def _site_weights(self):
+        """(n_sites,) participation weights from the sites' ``grad_weight``
+        outputs (1.0 when absent — older payloads): a site whose lockstep
+        round was entirely padding ships zero gradients, and including them
+        at weight 1 would dilute the round by the participation fraction —
+        the mesh transport has always excluded such sites (``_site_weight``);
+        this keeps the two transports byte-equivalent on unequal site sizes."""
+        sites = sorted(self.input.keys())
+        return jnp.asarray(
+            [float(self.input[s].get("grad_weight", 1.0)) for s in sites],
+            jnp.float32,
+        )
+
     # ---------------------------------------------------------------- reduce
-    def _average(self, site_leaves):
-        """Stack each leaf across sites and mean on-device in one compiled
-        call (≙ ref ``reducer.py:25-32`` stack→GPU→mean).
+    def _average(self, site_leaves, weights=None):
+        """Stack each leaf across sites and participation-weighted-mean
+        on-device in one compiled call (≙ ref ``reducer.py:25-32``
+        stack→GPU→mean, plus the weighting the reference's no-mask padding
+        sidesteps).
 
         With ``cache['guard_nonfinite']`` (default on) sites shipping NaN/Inf
         gradients — a diverged or corrupted node — are detected on-device and
@@ -102,13 +123,15 @@ class COINNReducer:
         n_leaves = len(site_leaves[0])
         if n_leaves == 0:  # e.g. rankDAD's "rest" payload with no 1-D params
             return []
+        if weights is None:
+            weights = self._site_weights()
         stacked = [
             jnp.stack([jnp.asarray(site[i], dtype=jnp.float32) for site in site_leaves])
             for i in range(n_leaves)
         ]
         wire = config.wire_dtype(self.precision_bits)
         if self.cache.get("guard_nonfinite", True):
-            means, ok = _guarded_mean(stacked)
+            means, ok = _guarded_mean(stacked, weights)
             ok = np.asarray(ok)
             self.cache["_reduce_round"] = int(self.cache.get("_reduce_round", 0)) + 1
             if not ok.all():
@@ -125,7 +148,7 @@ class COINNReducer:
                     True,
                 )
             return [np.asarray(x, dtype=wire) for x in means]
-        return [np.asarray(x, dtype=wire) for x in _stacked_mean(stacked)]
+        return [np.asarray(x, dtype=wire) for x in _stacked_mean(stacked, weights)]
 
     def reduce(self):
         """Average all sites' gradients → ship ``avg_grads`` + signal update

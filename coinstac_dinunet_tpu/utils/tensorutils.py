@@ -170,6 +170,16 @@ def save_wire(path, arrays, salt="", cache=None, precision_bits=None):
     )
 
 
+def aslist(x):
+    """Normalize a sequence restored by msgpack: lists may come back as
+    index-keyed dicts ``{"0": ..., "1": ...}``."""
+    if x is None:
+        return []
+    if isinstance(x, dict):
+        return [x[k] for k in sorted(x, key=lambda s: int(s))]
+    return list(x)
+
+
 def caste_ndarray(x, precision_bits=None):
     """Cast to the wire dtype (float{precision_bits})."""
     return np.asarray(x).astype(config.wire_dtype(precision_bits))

