@@ -276,6 +276,7 @@ class NNTrainer:
         # operational env kill-switches are read at trace time too
         cfg["__env_no_s2d__"] = os.environ.get("COINN_NO_S2D", "")
         cfg["__env_no_fused_gn__"] = os.environ.get("COINN_NO_FUSED_GN", "")
+        cfg["__env_flash_xla_bwd__"] = os.environ.get("COINN_FLASH_XLA_BWD", "")
         key = (
             type(self).__module__,
             type(self).__qualname__,

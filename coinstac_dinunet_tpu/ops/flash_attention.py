@@ -35,6 +35,7 @@ Design:
 """
 import functools
 import math
+import os
 
 import numpy as np
 
@@ -222,12 +223,197 @@ def _flash_fwd(q, k, v, aux, scale, causal, impl, block_q, block_k):
     return (out, lse), (q, k, v, aux, out, lse)
 
 
+_LSE_PAD = 1.0e30  # padded q rows: exp(s - pad) == 0, so they contribute nothing
+
+
+def _dq_kernel(aux_ref, q_ref, k_ref, v_ref, lse_ref, delta_ref, glse_ref,
+               gout_ref, dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    """dq tile: q-block fixed, key blocks stream through the sequential
+    innermost grid axis with the accumulator in VMEM scratch — the same
+    streaming structure as the forward, applied to the flash backward
+    identity ``ds = p · (dp − Δ + g_lse)``."""
+    iq = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    qf = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+    kf = k_ref[0].astype(jnp.float32)                  # (bk, d)
+    vf = v_ref[0].astype(jnp.float32)
+    g_out = gout_ref[0].astype(jnp.float32)            # (bq, d)
+    lse = lse_ref[0]                                   # (bq, 1) f32
+    lse = jnp.where(lse <= _MASKED_LSE, 0.0, lse)
+    delta = delta_ref[0]                               # (bq, 1)
+    g_lse = glse_ref[0]                                # (bq, 1)
+    scalars = (aux_ref[0, 0], aux_ref[0, 1], aux_ref[0, 2])
+
+    s = lax.dot_general(
+        qf, kf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                                  # (bq, bk)
+    valid = _valid_mask(s.shape, scalars, 0, 1, iq, j, block_q, block_k, causal)
+    p = jnp.where(valid, jnp.exp(jnp.where(valid, s, _NEG) - lse), 0.0)
+    dp = lax.dot_general(
+        g_out, vf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta + g_lse)
+    dq_acc[:] = dq_acc[:] + lax.dot_general(
+        ds, kf, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+
+    @pl.when(j == nk - 1)
+    def _final():
+        dq_ref[0] = dq_acc[:]
+
+
+def _dkv_kernel(aux_ref, k_ref, v_ref, q_ref, gout_ref, lse_ref, delta_ref,
+                glse_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                *, scale, causal, block_q, block_k):
+    """dk/dv tile: key-block fixed, q blocks stream sequentially.  Works on
+    the TRANSPOSED score tile ``sᵀ = k qᵀ`` so both accumulators keep the
+    (block_k, d) layout.  Padded q rows contribute exactly zero: their
+    ``g_out``/Δ/``g_lse`` pad with zeros and their lse pads with +1e30
+    (``p = exp(s − 1e30) = 0``) — no explicit row mask needed."""
+    ik = pl.program_id(1)
+    j = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    kf = k_ref[0].astype(jnp.float32)                  # (bk, d)
+    vf = v_ref[0].astype(jnp.float32)
+    qf = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+    g_out = gout_ref[0].astype(jnp.float32)            # (bq, d)
+    lse = lse_ref[0]                                   # (bq, 1)
+    lse = jnp.where(lse <= _MASKED_LSE, 0.0, lse)
+    delta = delta_ref[0]
+    g_lse = glse_ref[0]
+    scalars = (aux_ref[0, 0], aux_ref[0, 1], aux_ref[0, 2])
+
+    s_t = lax.dot_general(
+        kf, qf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                                  # (bk, bq)
+    # q rows live on axis 1, key cols on axis 0 of the transposed tile
+    valid = _valid_mask(s_t.shape, scalars, 1, 0, j, ik, block_q, block_k, causal)
+    p_t = jnp.where(
+        valid, jnp.exp(jnp.where(valid, s_t, _NEG) - lse[:, 0][None, :]), 0.0
+    )
+    dv_acc[:] = dv_acc[:] + lax.dot_general(
+        p_t, g_out, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dp_t = lax.dot_general(
+        vf, g_out, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )                                                  # (bk, bq)
+    ds_t = p_t * (dp_t - delta[:, 0][None, :] + g_lse[:, 0][None, :])
+    dk_acc[:] = dk_acc[:] + lax.dot_general(
+        ds_t, qf, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(j == nq - 1)
+    def _final():
+        dk_ref[0] = dk_acc[:]
+        dv_ref[0] = dv_acc[:]
+
+
+def _flash_bwd_pallas(q, k, v, aux, out, lse, g_out, g_lse, scale, causal,
+                      block_q, block_k, interpret):
+    """Both backward kernels; returns (dq, dk, dv) in f32."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    delta = jnp.sum(g_out * out.astype(jnp.float32), axis=-1)  # (bh, tq)
+
+    qp = _pad_to(q, 1, block_q)
+    gop = _pad_to(g_out, 1, block_q)
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    tqp, tkp = qp.shape[1], kp.shape[1]
+    pad_rows = tqp - tq
+
+    def col(x, pad_value):
+        x = jnp.pad(x, ((0, 0), (0, pad_rows)), constant_values=pad_value)
+        return x[..., None].astype(jnp.float32)  # (bh, tqp, 1)
+
+    lse_c = col(lse, _LSE_PAD)
+    delta_c = col(delta, 0.0)
+    glse_c = col(g_lse, 0.0)
+    aux2 = aux.reshape(1, 3)
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec_j = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    cspec_i = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    smem = pl.BlockSpec((1, 3), lambda b, i, j: (0, 0), memory_space=pltpu.SMEM)
+    seq = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, tqp // block_q, tkp // block_k),
+        in_specs=[smem, qspec, kspec_j, kspec_j, cspec_i, cspec_i, cspec_i,
+                  qspec],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tqp, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=seq,
+        interpret=interpret,
+    )(aux2, qp, kp, vp, lse_c, delta_c, glse_c, gop)
+
+    kspec_i = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    qspec_j = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    cspec_j = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, tkp // block_k, tqp // block_q),
+        in_specs=[smem, kspec_i, kspec_i, qspec_j, qspec_j, cspec_j, cspec_j,
+                  cspec_j],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tkp, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tkp, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=seq,
+        interpret=interpret,
+    )(aux2, kp, vp, qp, gop, lse_c, delta_c, glse_c)
+
+    return dq[:, :tq], dk[:, :tk], dv[:, :tk]
+
+
 def _flash_bwd(scale, causal, impl, block_q, block_k, res, g):
-    """Blockwise backward: scan over key blocks, never materializing the
-    (Tq, Tk) score matrix — peak extra memory is O(Tq · block_k) per batch
-    row.  Standard flash identities from the saved (out, lse) residuals,
-    including the lse cotangent the ring merge produces."""
+    """Blockwise backward, never materializing the (Tq, Tk) score matrix.
+
+    ``impl='pallas'``: the two-kernel flash backward above (dq with keys
+    streaming; dk/dv on the transposed tile with queries streaming) —
+    on-chip accumulators, one (block, d) tile resident per stream step.
+    Otherwise: an XLA ``lax.scan`` over key blocks computing the same
+    identities — peak extra memory O(Tq · block_k) per batch row.  Both
+    consume the saved ``(out, lse)`` residuals, including the lse cotangent
+    the ring merge produces."""
     q, k, v, aux, out, lse = res
+    if (impl in ("pallas", "pallas_interpret") and _HAVE_PALLAS
+            and not os.environ.get("COINN_FLASH_XLA_BWD")):
+        dq, dk, dv = _flash_bwd_pallas(
+            q, k, v, aux, out, lse,
+            g[0].astype(jnp.float32), g[1].astype(jnp.float32),
+            scale, causal, block_q, block_k,
+            interpret=(impl == "pallas_interpret"),
+        )
+        aux_ct = np.zeros(aux.shape, dtype=jax.dtypes.float0)
+        return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+                aux_ct)
     g_out = g[0].astype(jnp.float32)
     g_lse = g[1].astype(jnp.float32)  # ring merge differentiates through lse
     qf = q.astype(jnp.float32) * scale

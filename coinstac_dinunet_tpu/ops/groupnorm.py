@@ -23,7 +23,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["group_norm", "FusedGroupNorm"]
+__all__ = ["group_norm", "fused_group_norm_module"]
 
 
 def _stats(x32, groups):
@@ -112,14 +112,6 @@ def group_norm(x, scale, bias, groups, eps=1e-6, relu=False):
     if x.shape[-1] % groups:
         raise ValueError(f"channels {x.shape[-1]} not divisible by {groups}")
     return _gn(x, scale, bias, int(groups), float(eps), bool(relu))
-
-
-class FusedGroupNorm:
-    """flax-module wrapper with ``nn.GroupNorm``-compatible params.
-
-    Declared lazily (flax import stays off the module path for non-flax
-    users); use :func:`fused_group_norm_module`.
-    """
 
 
 def fused_group_norm_module():

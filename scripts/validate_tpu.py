@@ -58,16 +58,53 @@ print(json.dumps({"ms_per_step": round(best * 1e3, 3),
 """
 
 
-def run(tag, args, no_s2d=False):
+ATTN = r"""
+import json, sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+t = int(sys.argv[1]); causal = len(sys.argv) > 2 and sys.argv[2] == "causal"
+from coinstac_dinunet_tpu.ops import flash_attention
+b, h, d = 1, 8, 128
+rng = np.random.default_rng(0)
+mk = lambda: jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.bfloat16)
+q, k, v = mk(), mk(), mk()
+
+@jax.jit
+def grads(q, k, v):
+    return jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=causal, impl="pallas")
+            .astype(jnp.float32) ** 2
+        ), argnums=(0, 1, 2),
+    )(q, k, v)
+
+g = grads(q, k, v)
+jax.block_until_ready(g)
+best, steps = 1e9, 20
+for _ in range(3):
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        g = grads(q, k, v)
+    jax.block_until_ready(g)
+    best = min(best, (time.perf_counter() - t0) / steps)
+print(json.dumps({"ms_per_fwdbwd": round(best * 1e3, 3)}))
+"""
+
+
+def run(tag, args, no_s2d=False, script=STEP, xla_bwd=False):
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     if no_s2d:
         env["COINN_NO_S2D"] = "1"
     else:
         env.pop("COINN_NO_S2D", None)
+    if xla_bwd:
+        env["COINN_FLASH_XLA_BWD"] = "1"
+    else:
+        env.pop("COINN_FLASH_XLA_BWD", None)
     res = None
     try:
-        res = subprocess.run([sys.executable, "-c", STEP, *args], env=env,
+        res = subprocess.run([sys.executable, "-c", script, *args], env=env,
                              capture_output=True, text=True, timeout=900)
         out = json.loads(res.stdout.strip().splitlines()[-1])
     except Exception as exc:  # noqa: BLE001
@@ -93,6 +130,11 @@ def main():
     # ResNet-18 (config 4): 2-D s2d stem on/off
     run("resnet_final", ["resnet", "256"])
     run("resnet_no_s2d", ["resnet", "256"], no_s2d=True)
+    # flash-attention backward at long context: Pallas two-kernel bwd vs
+    # the XLA-scan recompute (COINN_FLASH_XLA_BWD kill switch)
+    for t in ("8192", "16384"):
+        run(f"flash_bwd_pallas_t{t}", [t, "causal"], script=ATTN)
+        run(f"flash_bwd_xla_t{t}", [t, "causal"], script=ATTN, xla_bwd=True)
 
 
 if __name__ == "__main__":
