@@ -317,17 +317,17 @@ class MeshEngine:
     (:meth:`_mesh_pretrain`): the max-train-data site trains locally for
     ``pretrain_args['epochs']``, and its best weights seed the replicated
     mesh state — exactly what the designated-site-pretrain + broadcast
-    sequence produces on the engine transport.  Engine-transport-only
-    feature (explicitly rejected here): sparse test mode.  Metrics that are
-    not jit-safe (AUC) fall back to per-site host evaluation with identical
-    count/rank math.
+    sequence produces on the engine transport.  Sparse test mode
+    (``load_sparse`` — one dataset per test subject, per-subject
+    ``save_predictions``) runs the fold test per-site on the host with the
+    same exact count merge, like the engine transport's
+    ``test_distributed``.  Metrics that are not jit-safe (AUC) fall back
+    to per-site host evaluation with identical count/rank math.
     """
 
     def __init__(self, workdir, n_sites, trainer_cls=COINNTrainer,
                  dataset_cls=None, datahandle_cls=COINNDataHandle,
                  devices=None, devices_per_site=None, site_args=None, **args):
-        if args.get("load_sparse"):
-            raise ValueError("sparse test mode requires the engine transport")
         self.workdir = str(workdir)
         self.n_sites = int(n_sites)
         self.trainer_cls = trainer_cls
@@ -711,6 +711,11 @@ class MeshEngine:
         """Globally-reduced evaluation: per-site loaders padded to lockstep
         length, one psum-reduced compiled step per batch index."""
         trainer = self._trainer
+        if which == "test" and bool(self.cache.get("load_sparse")):
+            # sparse test: one dataset per subject so save_predictions can
+            # dump per-subject outputs — host path, exact count merge (≙
+            # the engine transport's test_distributed)
+            return self._host_test_sparse(handles)
         if not trainer.new_metrics().jit_safe:
             return self._host_eval(handles, which)
         bs = int(self.cache.get("batch_size", 16))
@@ -750,22 +755,40 @@ class MeshEngine:
             averages.update(a_state)
         return averages, metrics
 
-    def _host_eval(self, handles, which):
+    def _host_test_sparse(self, handles):
+        """Fold test over per-subject datasets (``load_sparse``), per site
+        on the host, with per-subject ``save_predictions`` when asked."""
+        return self._host_eval(
+            handles, "test",
+            datasets_fn=lambda h: h.get_test_dataset(load_sparse=True),
+            save_pred=bool(self.cache.get("save_predictions")),
+        )
+
+    def _host_eval(self, handles, which, datasets_fn=None, save_pred=False):
         """Per-site host-side evaluation with exact cross-site accumulation —
-        the fallback for metrics whose state is not jit-safe (AUC)."""
+        the fallback for metrics whose state is not jit-safe (AUC) and the
+        sparse-test path.  ``datasets_fn(handle)`` overrides the default
+        dataset lookup (may return a LIST of datasets)."""
         trainer = self._trainer
         metrics, averages = trainer.new_metrics(), trainer.new_averages()
         mode = Mode.VALIDATION if which == "validation" else Mode.TEST
-        for s in self.site_ids:
-            trainer.data_handle = handles[s]
-            ds = (handles[s].get_validation_dataset() if which == "validation"
-                  else handles[s].get_test_dataset())
-            if not len(ds):
-                continue
-            a, m = trainer.evaluation(mode, [ds])
-            metrics.accumulate(m)
-            averages.accumulate(a)
-        trainer.data_handle = None
+        if datasets_fn is None:
+            datasets_fn = (
+                (lambda h: h.get_validation_dataset())
+                if which == "validation" else (lambda h: h.get_test_dataset())
+            )
+        try:
+            for s in self.site_ids:
+                trainer.data_handle = handles[s]
+                ds = datasets_fn(handles[s])
+                ds = ds if isinstance(ds, list) else [ds]
+                if not any(len(d) for d in ds):
+                    continue
+                a, m = trainer.evaluation(mode, ds, save_pred=save_pred)
+                metrics.accumulate(m)
+                averages.accumulate(a)
+        finally:
+            trainer.data_handle = None
         return averages, metrics
 
     # ---------------------------------------------------------------- wrap-up

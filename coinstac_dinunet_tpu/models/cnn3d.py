@@ -31,22 +31,16 @@ class _ConvBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from ..ops.groupnorm import norm_relu
+
         x = nn.Conv(
             self.features, (3, 3, 3), strides=(self.stride,) * 3,
             padding="SAME", use_bias=False, dtype=self.dtype,
         )(x)
-        groups = min(8, self.features)
-        if self.fused_gn:
-            # fused GN+ReLU with the closed-form backward (docs/PERF.md GN
-            # lever); name pins the param path to the nn.GroupNorm layout
-            from ..ops.groupnorm import fused_group_norm_module
-
-            return fused_group_norm_module()(
-                num_groups=groups, use_relu=True, dtype=self.dtype,
-                name="GroupNorm_0",
-            )(x)
-        x = nn.GroupNorm(num_groups=groups, dtype=self.dtype)(x)
-        return nn.relu(x)
+        # fused GN+ReLU with the closed-form backward (docs/PERF.md GN
+        # lever); the shared dispatch pins the nn.GroupNorm param path
+        return norm_relu(x, self.features, self.dtype, self.fused_gn, True,
+                         "GroupNorm_0")
 
 
 class _StemConv(nn.Module):
@@ -104,17 +98,10 @@ class VBM3DNet(nn.Module):
         x = jnp.asarray(x, self.dtype)
         w = self.width
         # stem: space-to-depth stride-2 conv (see _StemConv) + GN + relu
-        x = _StemConv(w, dtype=self.dtype)(x)  # /2
-        if fused:
-            from ..ops.groupnorm import fused_group_norm_module
+        from ..ops.groupnorm import norm_relu
 
-            x = fused_group_norm_module()(
-                num_groups=min(8, w), use_relu=True, dtype=self.dtype,
-                name="GroupNorm_0",
-            )(x)
-        else:
-            x = nn.GroupNorm(num_groups=min(8, w), dtype=self.dtype)(x)
-            x = nn.relu(x)
+        x = _StemConv(w, dtype=self.dtype)(x)  # /2
+        x = norm_relu(x, w, self.dtype, fused, True, "GroupNorm_0")
         x = _ConvBlock(w, dtype=self.dtype, fused_gn=fused)(x)
         x = _ConvBlock(2 * w, stride=2, dtype=self.dtype, fused_gn=fused)(x)  # /4
         x = _ConvBlock(2 * w, dtype=self.dtype, fused_gn=fused)(x)

@@ -1,8 +1,13 @@
 """ResNet-18 image classifier (benchmark config 4).
 
 TPU-first flax implementation: NHWC, GroupNorm (pure apply — no federated
-batch-stat drift), bfloat16 compute, 3×3 MXU-friendly convs.
+batch-stat drift), bfloat16 compute, 3×3 MXU-friendly convs.  Every norm
+routes through the fused GroupNorm with the closed-form backward
+(``ops/groupnorm.py``; same kill switches as the flagship), with param
+paths pinned to the plain ``nn.GroupNorm`` layout.
 """
+import os
+
 import numpy as np
 
 import flax.linen as nn
@@ -14,27 +19,31 @@ from ..trainer import COINNTrainer
 from ..utils import parse_shape, stable_file_id
 
 
+from ..ops.groupnorm import norm_relu as _norm  # shared fused/plain dispatch
+
+
 class _ResBlock(nn.Module):
     features: int
     stride: int = 1
     dtype: jnp.dtype = jnp.bfloat16
+    fused_gn: bool = True
 
     @nn.compact
     def __call__(self, x):
         residual = x
         y = nn.Conv(self.features, (3, 3), strides=(self.stride,) * 2,
                     padding="SAME", use_bias=False, dtype=self.dtype)(x)
-        y = nn.GroupNorm(num_groups=min(8, self.features), dtype=self.dtype)(y)
-        y = nn.relu(y)
+        y = _norm(y, self.features, self.dtype, self.fused_gn, True,
+                  "GroupNorm_0")
         y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
                     dtype=self.dtype)(y)
-        y = nn.GroupNorm(num_groups=min(8, self.features), dtype=self.dtype)(y)
+        y = _norm(y, self.features, self.dtype, self.fused_gn, False,
+                  "GroupNorm_1")
         if residual.shape != y.shape:
             residual = nn.Conv(self.features, (1, 1), strides=(self.stride,) * 2,
                                use_bias=False, dtype=self.dtype)(x)
-            residual = nn.GroupNorm(
-                num_groups=min(8, self.features), dtype=self.dtype
-            )(residual)
+            residual = _norm(residual, self.features, self.dtype,
+                             self.fused_gn, False, "GroupNorm_2")
         return nn.relu(y + residual)
 
 
@@ -63,9 +72,11 @@ class ResNet18(nn.Module):
     num_classes: int = 2
     width: int = 64
     dtype: jnp.dtype = jnp.bfloat16
+    fused_gn: bool = True
 
     @nn.compact
     def __call__(self, x, train=False, rng=None):
+        fused = self.fused_gn and not os.environ.get("COINN_NO_FUSED_GN")
         if x.ndim == 3:
             x = x[..., None]
         x = jnp.asarray(x, self.dtype)
@@ -73,15 +84,15 @@ class ResNet18(nn.Module):
         # name="Conv_0" keeps the flax param path of the plain nn.Conv stem
         # this replaces, so checkpoints from either version interchange
         x = _Stem2D(w, dtype=self.dtype, name="Conv_0")(x)
-        x = nn.GroupNorm(num_groups=8, dtype=self.dtype)(x)
-        x = nn.relu(x)
+        x = _norm(x, w, self.dtype, fused, True, "GroupNorm_0")
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for i, (feat, blocks) in enumerate(
             [(w, 2), (2 * w, 2), (4 * w, 2), (8 * w, 2)]
         ):
             for b in range(blocks):
                 stride = 2 if (i > 0 and b == 0) else 1
-                x = _ResBlock(feat, stride=stride, dtype=self.dtype)(x)
+                x = _ResBlock(feat, stride=stride, dtype=self.dtype,
+                              fused_gn=fused)(x)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=jnp.float32)(
             jnp.asarray(x, jnp.float32)
@@ -107,6 +118,7 @@ class ResNetTrainer(COINNTrainer):
             num_classes=int(self.cache.get("num_classes", 2)),
             width=int(self.cache.get("model_width", 64)),
             dtype=jnp.dtype(self.cache.setdefault("compute_dtype", "bfloat16")),
+            fused_gn=bool(self.cache.get("fused_groupnorm", True)),
         )
 
     def example_inputs(self):

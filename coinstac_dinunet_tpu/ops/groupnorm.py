@@ -23,7 +23,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["group_norm", "fused_group_norm_module"]
+__all__ = ["group_norm", "fused_group_norm_module", "norm_relu"]
 
 
 def _stats(x32, groups):
@@ -112,6 +112,23 @@ def group_norm(x, scale, bias, groups, eps=1e-6, relu=False):
     if x.shape[-1] % groups:
         raise ValueError(f"channels {x.shape[-1]} not divisible by {groups}")
     return _gn(x, scale, bias, int(groups), float(eps), bool(relu))
+
+
+def norm_relu(x, features, dtype, fused, relu, name):
+    """GroupNorm(+optional relu) dispatch shared by the CNN families: the
+    fused closed-form-backward op when ``fused``, plain ``nn.GroupNorm``
+    (+relu) otherwise — either way with ``min(8, features)`` groups and the
+    param path pinned to the plain layout via ``name``.  Must be called
+    inside a flax ``@nn.compact`` ``__call__``."""
+    import flax.linen as nn
+
+    groups = min(8, features)
+    if fused:
+        return fused_group_norm_module()(
+            num_groups=groups, use_relu=relu, dtype=dtype, name=name
+        )(x)
+    y = nn.GroupNorm(num_groups=groups, dtype=dtype, name=name)(x)
+    return nn.relu(y) if relu else y
 
 
 def fused_group_norm_module():

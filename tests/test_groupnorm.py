@@ -121,6 +121,37 @@ def test_vbm_fused_gn_param_tree_and_function():
                                    atol=2e-3, rtol=2e-3)
 
 
+def test_resnet_fused_gn_param_tree_and_function():
+    """ResNet-18's fused-GN routing keeps the exact param tree of the
+    unfused model and computes the same function (all three GN sites:
+    post-conv+relu, pre-residual, residual projection)."""
+    from coinstac_dinunet_tpu.models import ResNet18
+
+    x = jnp.asarray(_rand((2, 16, 16, 3), 11))
+    m_fused = ResNet18(width=8, dtype=jnp.float32, fused_gn=True)
+    m_plain = ResNet18(width=8, dtype=jnp.float32, fused_gn=False)
+    p_plain = m_plain.init(jax.random.PRNGKey(0), x)
+    p_fused = m_fused.init(jax.random.PRNGKey(0), x)
+    paths_f = [jax.tree_util.keystr(p) for p, _ in
+               jax.tree_util.tree_leaves_with_path(p_fused)]
+    paths_p = [jax.tree_util.keystr(p) for p, _ in
+               jax.tree_util.tree_leaves_with_path(p_plain)]
+    assert paths_f == paths_p
+    y_f = np.asarray(m_fused.apply(p_plain, x))
+    y_p = np.asarray(m_plain.apply(p_plain, x))
+    np.testing.assert_allclose(y_f, y_p, atol=1e-4, rtol=1e-4)
+
+    def loss(m, p):
+        return jnp.sum(m.apply(p, x) ** 2)
+
+    g_f = jax.grad(lambda p: loss(m_fused, p))(p_plain)
+    g_p = jax.grad(lambda p: loss(m_plain, p))(p_plain)
+    for a, b in zip(jax.tree_util.tree_leaves(g_f),
+                    jax.tree_util.tree_leaves(g_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-3)
+
+
 def test_group_norm_inside_jit():
     """groups/eps/relu must stay static under jit (the trainer's compiled
     step is the only real call site) — regression: tracing them broke the
