@@ -777,9 +777,15 @@ class MeshEngine:
                 (lambda h: h.get_validation_dataset())
                 if which == "validation" else (lambda h: h.get_test_dataset())
             )
+        shared_state = trainer.state
         try:
             for s in self.site_ids:
                 trainer.data_handle = handles[s]
+                # per-site state during the site's eval: user hooks
+                # (save_predictions) see the SAME clientId/baseDirectory/
+                # outputDirectory the engine transport would give them, and
+                # per-subject dumps land in the site's own output dir
+                trainer.state = self.site_states[s]
                 ds = datasets_fn(handles[s])
                 ds = ds if isinstance(ds, list) else [ds]
                 if not any(len(d) for d in ds):
@@ -789,6 +795,7 @@ class MeshEngine:
                 averages.accumulate(a)
         finally:
             trainer.data_handle = None
+            trainer.state = shared_state
         return averages, metrics
 
     # ---------------------------------------------------------------- wrap-up

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..data import COINNDataset
 from ..metrics import classification_outputs
+from ..ops.groupnorm import norm_relu
 from ..trainer import COINNTrainer
 from ..utils import parse_shape, stable_file_id
 
@@ -31,8 +32,6 @@ class _ConvBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        from ..ops.groupnorm import norm_relu
-
         x = nn.Conv(
             self.features, (3, 3, 3), strides=(self.stride,) * 3,
             padding="SAME", use_bias=False, dtype=self.dtype,
@@ -98,8 +97,6 @@ class VBM3DNet(nn.Module):
         x = jnp.asarray(x, self.dtype)
         w = self.width
         # stem: space-to-depth stride-2 conv (see _StemConv) + GN + relu
-        from ..ops.groupnorm import norm_relu
-
         x = _StemConv(w, dtype=self.dtype)(x)  # /2
         x = norm_relu(x, w, self.dtype, fused, True, "GroupNorm_0")
         x = _ConvBlock(w, dtype=self.dtype, fused_gn=fused)(x)

@@ -1,0 +1,22 @@
+"""Site-node entry point for the sequence (long-context) computation
+(engine stdin/stdout contract — see examples/fsv_classification/local.py)."""
+import json
+import sys
+
+from coinstac_dinunet_tpu import COINNLocal
+from coinstac_dinunet_tpu.models import SeqTrainer, SyntheticSeqDataset
+
+
+def compute(payload):
+    node = COINNLocal(
+        cache=payload.get("cache", {}),
+        input=payload.get("input", {}),
+        state=payload.get("state", {}),
+        task_id="seq_classification",
+    )
+    return node(trainer_cls=SeqTrainer, dataset_cls=SyntheticSeqDataset)
+
+
+if __name__ == "__main__":
+    result = compute(json.loads(sys.stdin.read()))
+    print(json.dumps(result))

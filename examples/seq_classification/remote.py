@@ -1,0 +1,21 @@
+"""Aggregator entry point for the sequence computation (engine
+stdin/stdout contract — see examples/fsv_classification/remote.py)."""
+import json
+import sys
+
+from coinstac_dinunet_tpu import COINNRemote
+from coinstac_dinunet_tpu.models import SeqTrainer
+
+
+def compute(payload):
+    node = COINNRemote(
+        cache=payload.get("cache", {}),
+        input=payload.get("input", {}),
+        state=payload.get("state", {}),
+    )
+    return node(trainer_cls=SeqTrainer)
+
+
+if __name__ == "__main__":
+    result = compute(json.loads(sys.stdin.read()))
+    print(json.dumps(result))

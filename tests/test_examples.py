@@ -90,3 +90,36 @@ def test_vbm_compspec_and_inputspec_are_valid_json():
     with open(os.path.join(VBM_EXAMPLE, "inputspec.json")) as f:
         ispec = json.load(f)
     assert ispec[0]["model_width"]["value"] == 4
+
+
+SEQ_EXAMPLE = os.path.join(REPO, "examples", "seq_classification")
+
+
+def test_seq_example_sim_reaches_success(tmp_path):
+    """The sequence example's 2-site simulation runs the long-context
+    family through the full federated lifecycle (flash attention in the
+    compiled step)."""
+    from coinstac_dinunet_tpu.engine import InProcessEngine
+    from coinstac_dinunet_tpu.models import SeqTrainer, SyntheticSeqDataset
+
+    eng = InProcessEngine(
+        str(tmp_path), n_sites=2, trainer_cls=SeqTrainer,
+        dataset_cls=SyntheticSeqDataset, inputspec=SEQ_EXAMPLE,
+        task_id="seq_classification", epochs=2, patience=10,
+        seq_len=32, d_model=32, max_len=64, num_features=8,
+    )
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(12):
+            open(os.path.join(d, f"subj_{i * 12 + j}"), "w").write("x")
+    eng.run(max_rounds=500)
+    assert eng.success
+
+
+def test_seq_compspec_and_inputspec_are_valid_json():
+    with open(os.path.join(SEQ_EXAMPLE, "compspec.json")) as f:
+        spec = json.load(f)
+    assert spec["computation"]["command"] == ["python", "local.py"]
+    with open(os.path.join(SEQ_EXAMPLE, "inputspec.json")) as f:
+        ispec = json.load(f)
+    assert ispec[0]["seq_len"]["value"] == 128

@@ -135,7 +135,9 @@ def test_mesh_engine_sparse_test_mode(tmp_path):
 
     class SparseXorTrainer(XorTrainer):
         def save_predictions(self, dataset, predictions):
-            calls.append((len(dataset), len(predictions)))
+            # hooks must see the engine-transport per-site state
+            calls.append((len(dataset), len(predictions),
+                          self.state.get("clientId")))
 
     args = {**BASE, "load_sparse": True, "save_predictions": True}
     file_eng = InProcessEngine(
@@ -156,9 +158,12 @@ def test_mesh_engine_sparse_test_mode(tmp_path):
     assert mesh_eng.success
 
     # one save_predictions call per test SUBJECT (len-1 datasets), same
-    # total as the file transport's sparse test
-    assert calls and all(n_ds == 1 for n_ds, _ in calls)
+    # total as the file transport's sparse test, and the hook saw a real
+    # per-site state on BOTH transports
+    assert calls and all(n_ds == 1 for n_ds, _, _ in calls)
     assert len(calls) == len(file_calls)
+    assert {c[2] for c in calls} <= {"site_0", "site_1"}
+    assert None not in {c[2] for c in calls}
 
     for key in ("test_metrics", "global_test_metrics"):
         a = np.asarray(file_eng.remote_cache[key], np.float64)
@@ -286,10 +291,12 @@ def test_mesh_federation_rejects_unknown_engine():
         MeshFederation(None, n_sites=2, agg_engine="bogusEngine")
 
 
-def test_mesh_engine_rejects_engine_only_features(tmp_path):
-    with pytest.raises(ValueError, match="pretrain"):
-        MeshEngine(tmp_path, n_sites=2, trainer_cls=XorTrainer,
-                   pretrain_args={"epochs": 2}, **BASE)
-    with pytest.raises(ValueError, match="sparse"):
-        MeshEngine(tmp_path, n_sites=2, trainer_cls=XorTrainer,
-                   load_sparse=True, **BASE)
+def test_mesh_engine_accepts_full_engine_surface(tmp_path):
+    """Pretrain broadcast and sparse test mode — once engine-transport-only
+    — now construct on the mesh transport (their behavior is covered by
+    test_mesh_engine_pretrain_matches_file_transport and
+    test_mesh_engine_sparse_test_mode)."""
+    MeshEngine(tmp_path / "a", n_sites=2, trainer_cls=XorTrainer,
+               pretrain_args={"epochs": 2}, **BASE)
+    MeshEngine(tmp_path / "b", n_sites=2, trainer_cls=XorTrainer,
+               load_sparse=True, **BASE)
