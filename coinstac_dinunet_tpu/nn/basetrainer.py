@@ -382,7 +382,7 @@ class NNTrainer:
         from ..utils.torch_import import is_torch_file
 
         if is_torch_file(path):
-            return self._load_torch_checkpoint(path)
+            return self._load_torch_checkpoint(path, load_optimizer)
         with open(path, "rb") as f:
             payload = flax.serialization.msgpack_restore(f.read())
         self.last_checkpoint_extra = dict(payload.get("extra", {}))
@@ -411,17 +411,23 @@ class NNTrainer:
         )
         return self
 
-    def _load_torch_checkpoint(self, path):
-        """Warm-start from a reference-ecosystem torch checkpoint
-        (``weights.tar`` written by torch.save — ref
-        ``nn/basetrainer.py:76-99``).  Only model weights are imported:
-        torch optimizer moments do not map onto optax state pytrees, so
-        each IMPORTED model's optimizer (and the step counter) restarts
-        fresh — the standard warm-start semantics.  Models absent from the
-        checkpoint keep their current weights and optimizer state.
-        ``cache['torch_name_map']`` ({torch name: 'flax/param/path'})
-        overrides positional pairing for divergent definition orders."""
-        from ..utils.torch_import import convert_torch_checkpoint
+    def _load_torch_checkpoint(self, path, load_optimizer=True):
+        """Warm-start (or optimizer-carrying resume) from a reference-
+        ecosystem torch checkpoint (``weights.tar`` written by torch.save —
+        ref ``nn/basetrainer.py:76-99``).  Model weights always import; for
+        a coinstac-format payload carrying per-model Adam optimizer state
+        the moments graft onto the optax state too (the reference loads
+        optimizer state dicts, ``:84-93``) — otherwise each imported
+        model's optimizer restarts fresh, the standard warm-start.  Models
+        absent from the checkpoint keep their current weights and
+        optimizer state.  ``cache['torch_name_map']`` ({torch name:
+        'flax/param/path'}) overrides positional pairing for divergent
+        definition orders; ``cache['import_torch_optimizer']=False``
+        forces the fresh-optimizer warm start."""
+        from ..utils.torch_import import (
+            _convert_checkpoint_with_opts, convert_torch_adam_state,
+            graft_adam_state,
+        )
 
         self.last_checkpoint_extra = {}
         name_map = self.cache.get("torch_name_map") or None
@@ -437,18 +443,36 @@ class NNTrainer:
                 "torch checkpoint import needs initialized models — call "
                 "init_nn() before load_checkpoint() on a torch file"
             )
-        imported = convert_torch_checkpoint(template, path, name_map=name_map)
+        imported, torch_opts = _convert_checkpoint_with_opts(
+            template, path, name_map=name_map
+        )
         if self.train_state is None:
             self._params = {**template, **imported}
             return self
         params = dict(self.train_state.params)
         params.update(imported)
-        # a warm start, not a resume: optimizer moments accumulated for the
-        # REPLACED weights must not be applied to the imported ones; models
-        # the checkpoint does not touch keep theirs
+        # fresh optimizer per imported model (stale moments for replaced
+        # weights must never apply) — then graft the checkpoint's torch
+        # Adam moments onto it when present and convertible
         opt_state = dict(self.train_state.opt_state)
+        want_opt = load_optimizer and self.cache.get(
+            "import_torch_optimizer", True
+        )
         for n in imported:
             opt_state[n] = self.optimizer[n].init(imported[n])
+            opt_sd = torch_opts.get(n)
+            if not (want_opt and opt_sd):
+                continue
+            try:
+                mu, nu, count = convert_torch_adam_state(
+                    template[n], opt_sd, name_map=name_map
+                )
+                opt_state[n] = graft_adam_state(opt_state[n], mu, nu, count)
+            except (ValueError, KeyError, TypeError) as exc:
+                logger.warn(
+                    f"torch optimizer state for {n!r} not imported ({exc}); "
+                    "starting that optimizer fresh"
+                )
         self.train_state = self.train_state.replace(
             params=params, opt_state=opt_state,
             step=jnp.zeros((), jnp.int32),

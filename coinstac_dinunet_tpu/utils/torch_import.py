@@ -30,6 +30,8 @@ __all__ = [
     "load_torch_payload",
     "convert_state_dict",
     "convert_torch_checkpoint",
+    "convert_torch_adam_state",
+    "graft_adam_state",
     "import_torch_checkpoint",
 ]
 
@@ -234,6 +236,25 @@ def convert_state_dict(flax_params, state_dict, name_map=None):
     return _unflatten([out[p] for p, _ in flax_flat], flax_params)
 
 
+def _convert_checkpoint_with_opts(template, path, name_map=None):
+    """(models, raw per-model torch optimizer state dicts) — see
+    :func:`convert_torch_checkpoint`."""
+    state_dicts, optimizers = load_torch_payload(path)
+    if set(state_dicts) == {None}:
+        state_dicts = {next(iter(template)): state_dicts[None]}
+    unknown = set(state_dicts) - set(template)
+    if unknown:
+        raise KeyError(
+            f"checkpoint models {sorted(unknown)} not in trainer models "
+            f"{list(template)}"
+        )
+    models = {
+        name: convert_state_dict(template[name], sd, name_map=name_map)
+        for name, sd in state_dicts.items()
+    }
+    return models, dict(optimizers or {})
+
+
 def convert_torch_checkpoint(template, path, name_map=None):
     """Convert a torch checkpoint file against ``template``
     ({model_name: flax_variables}, CREATION-ordered trees).
@@ -243,21 +264,99 @@ def convert_torch_checkpoint(template, path, name_map=None):
     semantics, ``nn/basetrainer.py:95-99``).  Returns ONLY the converted
     models — the caller decides what the untouched models keep (the
     trainer keeps their live trained state; :func:`import_torch_checkpoint`
-    keeps the template's values).
+    keeps the template's values).  Optimizer state import goes through
+    :func:`convert_torch_adam_state`.
     """
-    state_dicts, _optimizers = load_torch_payload(path)
-    if set(state_dicts) == {None}:
-        state_dicts = {next(iter(template)): state_dicts[None]}
-    unknown = set(state_dicts) - set(template)
-    if unknown:
-        raise KeyError(
-            f"checkpoint models {sorted(unknown)} not in trainer models "
-            f"{list(template)}"
+    models, _opts = _convert_checkpoint_with_opts(
+        template, path, name_map=name_map
+    )
+    return models
+
+
+def convert_torch_adam_state(template, opt_sd, name_map=None):
+    """Map one model's torch ``Adam`` optimizer ``state_dict`` onto optax
+    ``scale_by_adam`` moment trees.
+
+    torch keys moments by parameter INDEX in ``model.parameters()`` order —
+    definition order, i.e. the same positional pairing as the weights —
+    and stores them in the torch parameter layout, so each ``exp_avg`` /
+    ``exp_avg_sq`` goes through the same kind-driven transposes as its
+    weight.  ``batch_stats`` leaves (buffers on the torch side — not
+    optimizer params) get zero moments, matching a fresh state.  Models
+    that NEED ``name_map`` rerouting are refused: torch optimizer state is
+    index-keyed, so there is no name to reroute by.  Returns ``(mu_tree,
+    nu_tree, count)`` in ``template``'s structure; raises ``ValueError``
+    when the state does not line up (caller falls back to a fresh
+    optimizer — the documented warm-start).
+    """
+    if name_map:
+        raise ValueError(
+            "optimizer import cannot honor torch_name_map (torch optimizer "
+            "state is index-keyed, not name-keyed)"
         )
-    return {
-        name: convert_state_dict(template[name], sd, name_map=name_map)
-        for name, sd in state_dicts.items()
-    }
+    flat = _flatten_insertion_order(template)
+    trainable = [(p, l) for p, l in flat if p[0] != "batch_stats"]
+    groups = opt_sd.get("param_groups") or []
+    ordered_ix = [i for g in groups for i in g.get("params", [])]
+    if len(ordered_ix) != len(trainable):
+        raise ValueError(
+            f"torch optimizer tracks {len(ordered_ix)} params, model has "
+            f"{len(trainable)}"
+        )
+    state = opt_sd.get("state", {})
+    by_path, count = {}, 0
+    for (path, leaf), ix in zip(trainable, ordered_ix):
+        st = state.get(ix, state.get(str(ix)))
+        arr = np.asarray(leaf)
+        if st is None:  # param never stepped: zero moments
+            by_path[path] = (np.zeros(arr.shape, arr.dtype),) * 2
+            continue
+        m = _convert_tensor(f"exp_avg[{ix}]", st["exp_avg"], path, arr.shape)
+        v = _convert_tensor(f"exp_avg_sq[{ix}]", st["exp_avg_sq"], path,
+                            arr.shape)
+        if m is None or v is None:
+            raise ValueError(
+                f"optimizer moment for param {ix} does not convert to "
+                f"{'/'.join(path)!r} {tuple(arr.shape)}"
+            )
+        # moments take the param leaf's dtype, like a fresh optax state
+        by_path[path] = (m.astype(arr.dtype), v.astype(arr.dtype))
+        step = st.get("step", 0)
+        count = max(count, int(step.item() if hasattr(step, "item") else step))
+    mu, nu = [], []
+    for path, leaf in flat:
+        arr = np.asarray(leaf)
+        m, v = by_path.get(path, (np.zeros(arr.shape, arr.dtype),) * 2)
+        mu.append(m)
+        nu.append(v)
+    return _unflatten(mu, template), _unflatten(nu, template), count
+
+
+def graft_adam_state(opt_state, mu_tree, nu_tree, count):
+    """Replace the ``ScaleByAdamState`` inside an optax state chain with the
+    imported moments; everything else (schedules, weight decay wrappers)
+    keeps its fresh state."""
+    import jax.numpy as jnp
+    import optax
+
+    found = []
+
+    def walk(node):
+        if isinstance(node, optax.ScaleByAdamState):
+            found.append(True)
+            return node._replace(
+                count=jnp.asarray(count, jnp.int32), mu=mu_tree, nu=nu_tree
+            )
+        if isinstance(node, tuple):
+            items = [walk(x) for x in node]
+            # namedtuples rebuild positionally; plain tuples from one iterable
+            return type(node)(*items) if hasattr(node, "_fields") else tuple(items)
+        return node
+
+    out = walk(opt_state)
+    if not found:
+        raise ValueError("optimizer state has no ScaleByAdamState to graft")
+    return out
 
 
 def import_torch_checkpoint(params, path, name_map=None):

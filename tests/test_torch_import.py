@@ -358,6 +358,112 @@ def test_name_map_conv_transpose_override():
     np.testing.assert_allclose(got, want, atol=1e-5)
 
 
+def test_torch_adam_state_grafts_onto_optax(tmp_path):
+    """A coinstac-format checkpoint carrying torch Adam optimizer state
+    resumes the optimizer too: moments land in optax's ScaleByAdamState
+    (kind-aware transposes included) and the NEXT update step matches
+    torch's exactly — a true optimizer-carrying resume, not just a warm
+    start (ref ``nn/basetrainer.py:84-93`` loads optimizer state dicts)."""
+    import optax
+
+    torch.manual_seed(17)
+    net = _torch_mlp(seed=17)
+    opt = torch.optim.Adam(net.parameters(), lr=1e-2)
+    xb = torch.from_numpy(
+        np.random.default_rng(0).normal(size=(8, 66)).astype(np.float32))
+    for _ in range(3):
+        opt.zero_grad()
+        net(xb).pow(2).sum().backward()
+        opt.step()
+    ckpt = tmp_path / "with_opt.tar"
+    torch.save({"source": "coinstac",
+                "models": {"fsv_net": net.state_dict()},
+                "optimizers": {"fsv_net": opt.state_dict()}}, str(ckpt))
+
+    t = _fsv_trainer(tmp_path).init_nn()
+    t.load_checkpoint(full_path=str(ckpt))
+
+    def find_adam(node):
+        if isinstance(node, optax.ScaleByAdamState):
+            return node
+        if isinstance(node, tuple):
+            for x in node:
+                r = find_adam(x)
+                if r is not None:
+                    return r
+        return None
+
+    st = find_adam(t.train_state.opt_state["fsv_net"])
+    assert st is not None and int(st.count) == 3
+    tstate = opt.state_dict()["state"]
+    np.testing.assert_allclose(
+        np.asarray(st.mu["params"]["Dense_0"]["kernel"]),
+        tstate[0]["exp_avg"].numpy().T, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(st.nu["params"]["Dense_0"]["kernel"]),
+        tstate[0]["exp_avg_sq"].numpy().T, atol=1e-6)
+
+    # one more step on BOTH sides from the same loss -> same params
+    opt.zero_grad()
+    net(xb).pow(2).sum().backward()
+    opt.step()
+
+    params = t.train_state.params["fsv_net"]
+    grads = jax.grad(lambda p: jnp.sum(
+        t.nn["fsv_net"].apply(p, jnp.asarray(xb.numpy())) ** 2))(params)
+    updates, _ = t.optimizer["fsv_net"].update(
+        grads, t.train_state.opt_state["fsv_net"], params)
+    import optax as _ox
+    new_params = _ox.apply_updates(params, updates)
+    np.testing.assert_allclose(
+        np.asarray(new_params["params"]["Dense_0"]["kernel"]),
+        net[0].weight.detach().numpy().T, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(new_params["params"]["Dense_3"]["kernel"])
+        if "Dense_3" in new_params["params"] else
+        np.asarray(list(new_params["params"].values())[-1]["kernel"]),
+        net[-1].weight.detach().numpy().T, atol=1e-5, rtol=1e-5)
+
+
+def test_load_optimizer_false_skips_graft(tmp_path):
+    """Callers that explicitly pass load_optimizer=False (the pretrain
+    broadcast path) must get the fresh-optimizer warm start even when the
+    torch checkpoint carries Adam state."""
+    net = _torch_mlp(seed=23)
+    opt = torch.optim.Adam(net.parameters(), lr=1e-2)
+    xb = torch.from_numpy(
+        np.random.default_rng(2).normal(size=(4, 66)).astype(np.float32))
+    opt.zero_grad(); net(xb).pow(2).sum().backward(); opt.step()
+    ckpt = tmp_path / "pre.tar"
+    torch.save({"source": "coinstac",
+                "models": {"fsv_net": net.state_dict()},
+                "optimizers": {"fsv_net": opt.state_dict()}}, str(ckpt))
+    t = _fsv_trainer(tmp_path).init_nn()
+    t.load_checkpoint(full_path=str(ckpt), load_optimizer=False)
+    moments = jax.tree_util.tree_leaves(t.train_state.opt_state)
+    assert all(float(np.abs(np.asarray(m)).max()) == 0.0
+               for m in moments if hasattr(m, "shape") and np.asarray(m).ndim > 0)
+
+
+def test_torch_optimizer_import_opt_out(tmp_path):
+    """cache['import_torch_optimizer']=False keeps the fresh-optimizer
+    warm-start semantics even when the checkpoint carries Adam state."""
+    net = _torch_mlp(seed=19)
+    opt = torch.optim.Adam(net.parameters(), lr=1e-2)
+    xb = torch.from_numpy(
+        np.random.default_rng(1).normal(size=(4, 66)).astype(np.float32))
+    opt.zero_grad(); net(xb).pow(2).sum().backward(); opt.step()
+    ckpt = tmp_path / "opt_out.tar"
+    torch.save({"source": "coinstac",
+                "models": {"fsv_net": net.state_dict()},
+                "optimizers": {"fsv_net": opt.state_dict()}}, str(ckpt))
+    t = _fsv_trainer(tmp_path, import_torch_optimizer=False).init_nn()
+    t.load_checkpoint(full_path=str(ckpt))
+    moments = jax.tree_util.tree_leaves(t.train_state.opt_state)
+    assert all(float(np.abs(np.asarray(m)).max()) == 0.0
+               for m in moments if hasattr(m, "shape") and np.asarray(m).ndim > 0)
+
+
 def test_name_map_overrides_positional_pairing(tmp_path):
     """Explicit name_map entries re-route torch entries whose definition
     order diverges from the flax call order."""
