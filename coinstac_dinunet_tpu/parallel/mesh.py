@@ -131,17 +131,21 @@ class MeshFederation:
                 k: np.stack([s[k] for s in per_site_host])
                 for k in per_site_host[0]
             }
+            self._sample_batch_keys = tuple(glob_h.keys())
+            spec = self._train_batch_specs()
             out = {}
             for k, host in glob_h.items():
-                sharding = NamedSharding(self.mesh, P("site", None, "device"))
+                sharding = NamedSharding(self.mesh, self._spec_for(spec, k))
                 out[k] = jax.make_array_from_callback(
                     host.shape, sharding, lambda idx, a=host: a[idx]
                 )
             return out
         stacked = [self.trainer._stack_batches(b) for b in per_site_batches]
         glob = {k: jnp.stack([s[k] for s in stacked]) for k in stacked[0]}
+        self._sample_batch_keys = tuple(glob.keys())
+        spec = self._train_batch_specs()
         shardings = {
-            k: NamedSharding(self.mesh, P("site", None, "device")) for k in glob
+            k: NamedSharding(self.mesh, self._spec_for(spec, k)) for k in glob
         }
         return jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s), glob, shardings
@@ -381,8 +385,14 @@ class MeshFederation:
         return ("site", "device")
 
     def _train_batch_specs(self):
-        """in_specs entry for the stacked (site, k, B, ...) batch pytree."""
+        """in_specs entry for the stacked (site, k, B, ...) batch pytree —
+        a single spec, or a per-key dict (see :meth:`_spec_for`)."""
         return P("site", None, "device")
+
+    @staticmethod
+    def _spec_for(spec, k):
+        """Resolve a batch-spec hook result for key ``k`` (dict or single)."""
+        return spec[k] if isinstance(spec, dict) else spec
 
     def _build_step(self, engine=None):
         trainer = self.trainer
@@ -597,10 +607,7 @@ class MeshFederation:
             self._eval = self._build_eval()
         spec = self._eval_batch_specs()
         shardings = {
-            k: NamedSharding(
-                self.mesh, spec[k] if isinstance(spec, dict) else spec
-            )
-            for k in glob
+            k: NamedSharding(self.mesh, self._spec_for(spec, k)) for k in glob
         }
         glob = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, s), glob, shardings

@@ -25,6 +25,9 @@ hooks — only the hooks differ here.  This composes the long-context stack
 with the full federated trainer stack (optax, metrics, checkpoints,
 MeshEngine fold lifecycle) — the reference has neither (SURVEY §5); the
 sp=1 degenerate case reproduces ``MeshFederation``'s dSGD math exactly.
+Runs single- AND multi-process (one process per host: sites across hosts,
+sp over each host's local chips, so the ring's ppermute hops ride ICI and
+only the site mean crosses DCN — ``tests/test_multihost.py``).
 """
 import jax
 import jax.numpy as jnp
@@ -104,21 +107,6 @@ class SeqMeshFederation(MeshFederation):
             for k in keys
         }
 
-    # -------------------------------------------------------------- batching
-    def stack_site_batches(self, per_site_batches):
-        from jax.sharding import NamedSharding
-
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "SeqMeshFederation currently supports the single-process "
-                "runtime (multi-host: shard sites over processes with "
-                "MeshFederation, or sp over the in-process axis)"
-            )
-        stacked = [self.trainer._stack_batches(b) for b in per_site_batches]
-        glob = {k: jnp.stack([s[k] for s in stacked]) for k in stacked[0]}
-        self._sample_batch_keys = tuple(glob.keys())
-        specs = self._train_batch_specs()
-        return {
-            k: jax.device_put(v, NamedSharding(self.mesh, specs[k]))
-            for k, v in glob.items()
-        }
+    # batching: inherited — MeshFederation.stack_site_batches resolves the
+    # per-key placement through _train_batch_specs in BOTH the single- and
+    # multi-process branches (sites across hosts, sp within a host's chips)

@@ -118,20 +118,13 @@ from coinstac_dinunet_tpu.parallel import hosts
 hosts.initialize_multihost(f"127.0.0.1:{port}", n, pid)
 
 import numpy as np
-from coinstac_dinunet_tpu.models import FSVTrainer
 from coinstac_dinunet_tpu.parallel.mesh import MeshFederation
 
-cache = {"input_size": 10, "batch_size": 8, "num_classes": 2, "seed": 0,
-         "learning_rate": 1e-2, "compute_dtype": "float32",
-         "local_data_parallel": False, "share_compiled": False}
-cache.update(__CACHE_EXTRA__)
-tr = FSVTrainer(cache=cache, state={}, data_handle=None)
+__TRAINER_SETUP__
 tr.init_nn()  # same seed in every process -> identical replicas
 __MESH_SETUP__
 rng = np.random.default_rng(0)  # identical global data in every process
-per_site = [[{"inputs": rng.normal(size=(8, 10)).astype(np.float32),
-              "labels": rng.integers(0, 2, size=8).astype(np.int32),
-              "_mask": np.ones(8, np.float32)}] for _ in range(n)]
+per_site = __PER_SITE__
 losses = []
 for _ in range(__ROUNDS__):
     aux = fed.train_step(per_site)
@@ -144,12 +137,30 @@ print(f"WORKER_OK {pid} losses={['%.6f' % l for l in losses]}" + extra,
       flush=True)
 """
 
+FSV_TRAINER_SETUP = '''
+from coinstac_dinunet_tpu.models import FSVTrainer
 
-def _worker(cache_extra="{}", mesh_setup=None, rounds=3, extra=""):
+cache = {"input_size": 10, "batch_size": 8, "num_classes": 2, "seed": 0,
+         "learning_rate": 1e-2, "compute_dtype": "float32",
+         "local_data_parallel": False, "share_compiled": False}
+cache.update(__CACHE_EXTRA__)
+tr = FSVTrainer(cache=cache, state={}, data_handle=None)'''
+
+FSV_PER_SITE = (
+    '[[{"inputs": rng.normal(size=(8, 10)).astype(np.float32), '
+    '"labels": rng.integers(0, 2, size=8).astype(np.int32), '
+    '"_mask": np.ones(8, np.float32)}] for _ in range(n)]'
+)
+
+
+def _worker(cache_extra="{}", mesh_setup=None, rounds=3, extra="",
+            trainer_setup=None, per_site=None):
     mesh_setup = mesh_setup or (
         "fed = MeshFederation(tr, n_sites=n, devices_per_site=1)"
     )
     return (WORKER_TEMPLATE
+            .replace("__TRAINER_SETUP__", trainer_setup or FSV_TRAINER_SETUP)
+            .replace("__PER_SITE__", per_site or FSV_PER_SITE)
             .replace("__CACHE_EXTRA__", cache_extra)
             .replace("__MESH_SETUP__", mesh_setup)
             .replace("__ROUNDS__", str(rounds))
@@ -213,4 +224,52 @@ def test_two_process_mesh_rankdad():
         ),
         device_count=1,
     )
+    assert marks[0] == marks[1], marks
+
+
+SEQ_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+from coinstac_dinunet_tpu.parallel import hosts
+
+hosts.initialize_multihost(f"127.0.0.1:{port}", n, pid)
+
+import numpy as np
+from coinstac_dinunet_tpu.models import SeqTrainer
+from coinstac_dinunet_tpu.parallel.seq_mesh import SeqMeshFederation
+
+cache = {"seq_len": 16, "num_features": 8, "num_classes": 2, "d_model": 16,
+         "num_heads": 4, "num_layers": 1, "max_len": 32, "batch_size": 4,
+         "seed": 0, "learning_rate": 1e-2, "share_compiled": False,
+         "local_data_parallel": False}
+tr = SeqTrainer(cache=cache, state={}, data_handle=None)
+tr.init_nn()  # same seed in every process -> identical replicas
+mesh = hosts.host_aligned_site_mesh(n_sites=n)  # (site=n, 2 local devices)
+fed = SeqMeshFederation(tr, n_sites=n, sp=2, devices=mesh.devices.ravel())
+rng = np.random.default_rng(0)  # identical global data in every process
+per_site = [[{"inputs": rng.normal(size=(4, 16, 8)).astype(np.float32),
+              "labels": rng.integers(0, 2, size=4).astype(np.int32),
+              "_mask": np.ones(4, np.float32)}] for _ in range(n)]
+losses = []
+for _ in range(3):
+    aux = fed.train_step(per_site)
+    losses.append(float(np.asarray(jax.device_get(aux["loss"]))))
+assert all(np.isfinite(l) for l in losses), losses
+assert losses[-1] < losses[0], losses
+leaf = jax.tree_util.tree_leaves(tr.train_state.params)[0]
+extra = " p0=%.8f" % float(np.asarray(leaf.addressable_shards[0].data).ravel()[0])
+print(f"WORKER_OK {pid} losses={['%.6f' % l for l in losses]}" + extra,
+      flush=True)
+"""
+
+
+def test_two_process_seq_mesh_sp():
+    """Sequence parallelism across OS processes: 2 sites (one per process)
+    x sp=2 local devices — ring attention's ppermute hops stay on a host's
+    devices while the dSGD site mean crosses the process boundary.  Losses
+    fall and replicas stay in lockstep."""
+    marks = _run_two_process_workers(SEQ_WORKER, device_count=2)
     assert marks[0] == marks[1], marks
