@@ -71,6 +71,13 @@ _SLOW_TESTS = frozenset((
     "test_fresh_process_run_reaches_success",
     "test_fresh_process_matches_in_process_scores",
     "test_fresh_process_powersgd_mid_protocol",
+    "test_two_process_seq_mesh_sp",
+    "test_seq_example_sim_reaches_success",
+    "test_resnet_fused_gn_param_tree_and_function",
+    "test_vbm_fused_gn_param_tree_and_function",
+    "test_sp_model_matches_unsharded",
+    "test_mesh_engine_pretrain_matches_file_transport",
+    "test_mesh_engine_sparse_test_mode",
 ))
 
 
