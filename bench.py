@@ -385,6 +385,37 @@ def _watchdog(seconds, what):
     return done
 
 
+def _bench_lever_ab(shape, batch, width, steps, fast):
+    """Flagship samples/s with each round-4 lever toggled, so the driver's
+    bench run captures the A/B deltas even when ``validate_tpu.py`` never
+    got a live chip (each variant in its own process would be cleaner —
+    ``scripts/validate_tpu.py`` — but in-process works because the toggles
+    are cache keys that split the compiled-step bucket)."""
+    from coinstac_dinunet_tpu.models import VBMTrainer
+
+    rng = np.random.default_rng(5)
+    b = _synth_batch(rng, shape, batch)
+    out = {}
+    base_cache = {
+        "input_shape": shape, "model_width": width, "batch_size": batch,
+        "num_classes": 2, "seed": 0, "learning_rate": 1e-3,
+        "compute_dtype": "bfloat16", "local_data_parallel": False,
+    }
+    variants = {
+        "flagship_no_fused_gn": {"fused_groupnorm": False},
+    }
+    import jax
+
+    on_accelerator = jax.devices()[0].platform != "cpu"
+    if on_accelerator and not fast:  # ~4x the flagship FLOPs: never on CPU
+        variants["flagship_width32"] = {"model_width": 32}
+    for tag, extra in variants.items():
+        t = _mk_trainer(VBMTrainer, {**base_cache, **extra})
+        sps, _ = _bench_single_step(t, b, max(steps // 2, 2), 2)
+        out[tag] = round(sps, 1)
+    return out
+
+
 def main():
     fast = bool(os.environ.get("COINN_BENCH_FAST"))
     shape = (24, 24, 24) if fast else (64, 64, 64)
@@ -427,6 +458,18 @@ def main():
     except Exception as exc:  # noqa: BLE001
         print(f"# file-round failed: {exc}", file=sys.stderr)
         file_rounds = None
+    try:
+        # the fused-GN flagship baseline is the already-timed config entry;
+        # only the TOGGLED variants get re-timed
+        levers = _bench_lever_ab(shape, batch, width, steps, fast)
+        base_sps = configs.get("vbm3d_cnn_8site", {}).get(
+            "samples_per_sec_per_chip"
+        )
+        if levers is not None and base_sps is not None:
+            levers = {"flagship_fused_gn": base_sps, **levers}
+    except Exception as exc:  # noqa: BLE001
+        print(f"# lever A/B failed: {exc}", file=sys.stderr)
+        levers = None
 
     flagship = configs.get("vbm3d_cnn_8site", {})
     print(json.dumps({
@@ -445,6 +488,7 @@ def main():
         "configs": configs,
         "round_wallclock_s_cpu_mesh": scaling,
         "round_wallclock_s_cpu_file": file_rounds,
+        "levers_ab": levers,
     }))
 
 
