@@ -15,16 +15,21 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 STEP = r"""
-import json, sys, time
+import json, os, sys, time
 import numpy as np
 model, batch = sys.argv[1], int(sys.argv[2])
+fast = bool(os.environ.get("COINN_VALIDATE_FAST"))  # CPU smoke of the matrix
 from coinstac_dinunet_tpu.models import ResNetTrainer, VBMTrainer
 if model == "vbm":
-    cache = {"input_shape": (64, 64, 64), "model_width": 16, "batch_size": batch}
-    cls, shape, ch = VBMTrainer, (64, 64, 64), None
+    shape = (16, 16, 16) if fast else (64, 64, 64)
+    cache = {"input_shape": shape, "model_width": 8 if fast else 16,
+             "batch_size": batch}
+    cls, ch = VBMTrainer, None
 else:
-    cache = {"input_shape": (64, 64, 3), "model_width": 64, "batch_size": batch}
-    cls, shape, ch = ResNetTrainer, (64, 64), 3
+    shape = (32, 32) if fast else (64, 64)
+    cache = {"input_shape": (*shape, 3), "model_width": 16 if fast else 64,
+             "batch_size": batch}
+    cls, ch = ResNetTrainer, 3
 cache.update({"num_classes": 2, "seed": 0, "learning_rate": 1e-3,
               "compute_dtype": "bfloat16", "local_data_parallel": False})
 for flag in sys.argv[3:]:
@@ -33,7 +38,9 @@ for flag in sys.argv[3:]:
     elif flag == "nofusedgn":
         cache["fused_groupnorm"] = False
     elif flag.startswith("width"):
-        cache["model_width"] = int(flag[5:])
+        # fast mode scales widths by the same /2 as the base config, so the
+        # wider variant stays a DIFFERENT width and the lever is exercised
+        cache["model_width"] = max(int(flag[5:]) // (2 if fast else 1), 1)
 t = cls(cache=cache, state={}, data_handle=None)
 t.init_nn()
 rng = np.random.default_rng(0)
@@ -43,11 +50,11 @@ b = {"inputs": rng.normal(size=size).astype(np.float32),
      "_mask": np.ones(batch, np.float32)}
 stacked = t._stack_batches([b])
 ts = t.train_state
-for _ in range(3):
+for _ in range(1 if fast else 3):
     ts, aux = t.train_step(ts, stacked)
 float(np.asarray(aux["loss"]))
-best, steps = 1e9, 60
-for _ in range(3):
+best, steps = 1e9, (3 if fast else 60)
+for _ in range(1 if fast else 3):
     t0 = time.perf_counter()
     for _ in range(steps):
         ts, aux = t.train_step(ts, stacked)
@@ -59,21 +66,28 @@ print(json.dumps({"ms_per_step": round(best * 1e3, 3),
 
 
 ATTN = r"""
-import json, sys, time
+import json, os, sys, time
 import numpy as np
 import jax, jax.numpy as jnp
 t = int(sys.argv[1]); causal = len(sys.argv) > 2 and sys.argv[2] == "causal"
+FAST = bool(os.environ.get("COINN_VALIDATE_FAST"))
+if FAST:
+    t = min(t, 256)
 from coinstac_dinunet_tpu.ops import flash_attention
 b, h, d = 1, 8, 128
 rng = np.random.default_rng(0)
 mk = lambda: jnp.asarray(rng.normal(size=(b, h, t, d)), jnp.bfloat16)
 q, k, v = mk(), mk(), mk()
 
+impl = "pallas"
+if FAST and jax.default_backend() == "cpu":
+    impl = "pallas_interpret"  # CPU smoke: compiled pallas is TPU-only
+
 @jax.jit
 def grads(q, k, v):
     return jax.grad(
         lambda q, k, v: jnp.sum(
-            flash_attention(q, k, v, causal=causal, impl="pallas")
+            flash_attention(q, k, v, causal=causal, impl=impl)
             .astype(jnp.float32) ** 2
         ), argnums=(0, 1, 2),
     )(q, k, v)
@@ -118,21 +132,26 @@ def run(tag, args, no_s2d=False, script=STEP, xla_bwd=False):
 
 
 def main():
+    fast = bool(os.environ.get("COINN_VALIDATE_FAST"))
+    vb = "4" if fast else "128"
+    rb = "8" if fast else "256"
     # flagship: final config, then each lever toggled off
-    run("vbm_final", ["vbm", "128"])
-    run("vbm_no_s2d", ["vbm", "128"], no_s2d=True)
-    run("vbm_no_cast", ["vbm", "128", "nocast"])
-    run("vbm_no_fused_gn", ["vbm", "128", "nofusedgn"])
+    run("vbm_final", ["vbm", vb])
+    run("vbm_no_s2d", ["vbm", vb], no_s2d=True)
+    run("vbm_no_cast", ["vbm", vb, "nocast"])
+    run("vbm_no_fused_gn", ["vbm", vb, "nofusedgn"])
     # width-32 variant: cout fills the 128 MXU lanes from stage 2 on —
     # report MFU alongside the width-16 flagship (PERF.md MXU-fill lever)
-    run("vbm_width32", ["vbm", "128", "width32"])
-    run("vbm_width32_no_fused_gn", ["vbm", "128", "width32", "nofusedgn"])
+    run("vbm_width32", ["vbm", vb, "width32"])
+    run("vbm_width32_no_fused_gn", ["vbm", vb, "width32", "nofusedgn"])
     # ResNet-18 (config 4): 2-D s2d stem on/off
-    run("resnet_final", ["resnet", "256"])
-    run("resnet_no_s2d", ["resnet", "256"], no_s2d=True)
+    run("resnet_final", ["resnet", rb])
+    run("resnet_no_s2d", ["resnet", rb], no_s2d=True)
     # flash-attention backward at long context: Pallas two-kernel bwd vs
-    # the XLA-scan recompute (COINN_FLASH_XLA_BWD kill switch)
-    for t in ("8192", "16384"):
+    # the XLA-scan recompute (COINN_FLASH_XLA_BWD kill switch).  Fast mode
+    # runs ONE clamped length and labels it honestly.
+    lengths = ("256",) if fast else ("8192", "16384")
+    for t in lengths:
         run(f"flash_bwd_pallas_t{t}", [t, "causal"], script=ATTN)
         run(f"flash_bwd_xla_t{t}", [t, "causal"], script=ATTN, xla_bwd=True)
 
