@@ -19,8 +19,14 @@ Also reported inside the same JSON line:
 - ``mfu``: flagship model-FLOPs utilization against the chip's peak.
 
 ``vs_baseline``: the reference publishes no numbers (SURVEY §6), so the
-ratio is against a torch-CPU implementation of the same flagship model and
-step on this host — the reference's own compute path when no GPU is present.
+north-star denominator (BASELINE.json: "≥6× the single-V100 samples/sec
+baseline") must be CONSTRUCTED.  ``vs_baseline`` is the per-chip ratio
+against a derived single-V100 throughput of the reference's own compute
+path (plain fp32 torch — no AMP anywhere in the reference; see
+``_v100_leg`` for the explicit roofline derivation, labeled derived, with
+a best-case-AMP second leg).  The old torch-CPU-same-host comparison is
+still reported as ``vs_torch_cpu_host`` but is no longer the headline —
+it answers "what if the deployment has no GPU", not the north star.
 """
 import json
 import os
@@ -180,6 +186,7 @@ def _bench_configs(fast, peak):
         if flops:
             tf = sps / batch_n * flops / 1e12
             entry["achieved_tflops"] = round(tf, 4)
+            entry["flops_per_sample"] = round(flops / batch_n)
             if peak:
                 entry["mfu"] = round(tf * 1e12 / peak, 4)
         out[name] = entry
@@ -385,15 +392,15 @@ def _watchdog(seconds, what):
     return done
 
 
-def _bench_lever_ab(shape, batch, width, steps, fast):
+def _bench_lever_ab(steps, fast):
     """Flagship samples/s with each round-4 lever toggled, so the driver's
     bench run captures the A/B deltas even when ``validate_tpu.py`` never
     got a live chip (each variant in its own process would be cleaner —
     ``scripts/validate_tpu.py`` — but in-process works because the toggles
-    are cache keys that split the compiled-step bucket).  The untoggled
-    baseline is the already-timed ``vbm3d_cnn_8site`` entry: the variants
-    here derive from the SAME matrix cache, so config drift cannot split
-    the A/B."""
+    are cache keys that split the compiled-step bucket).  The fused-GN
+    baseline is re-timed HERE, back-to-back with the toggled variants at
+    the same step count, so warm-up/thermal drift between the config
+    matrix pass and this pass cannot skew the deltas."""
     flagship = next(
         (name, cls, cache, batch_fn)
         for name, cls, cache, batch_fn in _config_matrix(fast)
@@ -403,6 +410,7 @@ def _bench_lever_ab(shape, batch, width, steps, fast):
     b = batch_fn()
     out = {}
     variants = {
+        "flagship_fused_gn": {},  # the A of every A/B, timed in this loop
         "flagship_no_fused_gn": {"fused_groupnorm": False},
     }
     import jax
@@ -421,6 +429,88 @@ def _bench_lever_ab(shape, batch, width, steps, fast):
             print(f"# lever {tag} failed: {exc}", file=sys.stderr)
             out[tag] = None
     return out
+
+
+# ------------------------------------------------------------ V100 leg
+# The north star (BASELINE.json) compares the 8-site×4-chip aggregate to
+# "the single-V100 samples/sec baseline" — which nobody ever published
+# (SURVEY §6: the reference has no numbers) and no V100 exists in this
+# environment, so the leg is DERIVED from the model's measured FLOPs and
+# V100 rooflines, with every assumption explicit.  Two legs:
+#
+# - fp32 (reference-faithful): the reference trains plain fp32 torch —
+#   no autocast/AMP/half anywhere (ref ``nn/basetrainer.py:249-250``
+#   casts inputs with .float(); whole-repo scan finds no amp).  V100
+#   fp32 peak is 15.7 TFLOPS; cuDNN 3-D convolutions at these shapes
+#   sustain well under peak — 50% is a deliberately GENEROUS grant (it
+#   biases the ratio AGAINST us), so vs_baseline is a floor.
+# - amp_best_case: the strongest conceivable V100 setup — a hand-ported
+#   AMP/fp16 training loop the reference does not have.  125 TFLOPS
+#   tensor-core peak; 3-D convs with 16..128 channels underfill the
+#   8×-multiple tensor-core tiles (public MLPerf-era 3D-UNet V100 runs
+#   land ~20-30% MFU), so 25% achievable is granted.
+_V100_FP32_PEAK_TFLOPS = 15.7
+_V100_FP16_PEAK_TFLOPS = 125.0
+_V100_FP32_GRANTED_MFU = 0.50
+_V100_AMP_GRANTED_MFU = 0.25
+
+
+def _v100_leg(flops_per_sample):
+    """Derived single-V100 samples/s for the flagship from its MEASURED
+    per-sample fwd+bwd model FLOPs (XLA cost analysis — the same count a
+    V100 would execute).  Returns the two legs + the derivation record."""
+    if not flops_per_sample:
+        return None
+    fp32 = _V100_FP32_PEAK_TFLOPS * 1e12 * _V100_FP32_GRANTED_MFU
+    amp = _V100_FP16_PEAK_TFLOPS * 1e12 * _V100_AMP_GRANTED_MFU
+    return {
+        "status": "derived",  # no V100 in this environment; see BASELINE.md
+        "flops_per_sample": round(flops_per_sample),
+        "fp32_ref_path_samples_per_sec": round(fp32 / flops_per_sample, 1),
+        "amp_best_case_samples_per_sec": round(amp / flops_per_sample, 1),
+        "assumptions": {
+            "fp32": f"{_V100_FP32_PEAK_TFLOPS} TFLOPS peak x "
+                    f"{_V100_FP32_GRANTED_MFU:.0%} granted MFU "
+                    "(reference trains plain fp32 torch, no AMP)",
+            "amp": f"{_V100_FP16_PEAK_TFLOPS} TFLOPS tensor-core peak x "
+                   f"{_V100_AMP_GRANTED_MFU:.0%} granted MFU "
+                   "(hand-ported AMP the reference does not have)",
+        },
+    }
+
+
+def _north_star(per_chip, v100, scaling):
+    """The BASELINE.json target, answered with stated assumptions: v4-32 =
+    8 sites x 4 chips = 32 chips; aggregate = measured per-chip x 32 x a
+    weak-scaling efficiency taken from the measured virtual-mesh round
+    wall-clocks (per-site work is constant across site counts, so perfect
+    weak scaling keeps round_s flat: eff = round_s(min_n)/round_s(max_n))."""
+    if not (per_chip and v100):
+        return None
+    eff = None
+    if scaling:
+        vals = {int(k): v for k, v in scaling.items() if v}
+        if len(vals) >= 2:
+            lo, hi = min(vals), max(vals)
+            eff = round(min(1.0, vals[lo] / vals[hi]), 3)
+    chips = 32
+    agg = per_chip * chips * (eff if eff else 1.0)
+    denom = v100["fp32_ref_path_samples_per_sec"]
+    amp_denom = v100["amp_best_case_samples_per_sec"]
+    return {
+        "target": ">=6x single-V100 samples/s at 8 sites x 4 chips",
+        "aggregate_samples_per_sec": round(agg, 1),
+        "chips": chips,
+        "scaling_efficiency": eff,
+        "scaling_efficiency_source": (
+            "virtual CPU mesh round wall-clock (no multi-chip hardware "
+            "in this environment)" if eff else "unmeasured (assumed 1.0)"
+        ),
+        "x_vs_v100_fp32_ref_path": round(agg / denom, 1),
+        "x_vs_v100_amp_best_case": round(agg / amp_denom, 1),
+        "met_vs_ref_path": bool(agg / denom >= 6.0),
+        "met_vs_amp_best_case": bool(agg / amp_denom >= 6.0),
+    }
 
 
 def main():
@@ -454,7 +544,6 @@ def main():
     except Exception as exc:  # noqa: BLE001
         print(f"# torch baseline failed: {exc}", file=sys.stderr)
         base = None
-    vs = round(ours / base, 3) if (ours and base) else None
     try:
         scaling = _bench_round_scaling(fast)
     except Exception as exc:  # noqa: BLE001
@@ -466,26 +555,26 @@ def main():
         print(f"# file-round failed: {exc}", file=sys.stderr)
         file_rounds = None
     try:
-        # the fused-GN flagship baseline is the already-timed config entry;
-        # only the TOGGLED variants get re-timed
-        levers = _bench_lever_ab(shape, batch, width, steps, fast)
-        base_sps = configs.get("vbm3d_cnn_8site", {}).get(
-            "samples_per_sec_per_chip"
-        )
-        if levers is not None and base_sps is not None:
-            levers = {"flagship_fused_gn": base_sps, **levers}
+        levers = _bench_lever_ab(steps, fast)
     except Exception as exc:  # noqa: BLE001
         print(f"# lever A/B failed: {exc}", file=sys.stderr)
         levers = None
 
     flagship = configs.get("vbm3d_cnn_8site", {})
+    v100 = _v100_leg(flagship.get("flops_per_sample"))
+    # headline ratio: per-chip vs the derived reference-faithful V100 leg
+    vs = (round(ours / v100["fp32_ref_path_samples_per_sec"], 3)
+          if (ours and v100) else None)
     print(json.dumps({
         "metric": "vbm3d_cnn_samples_per_sec_per_chip",
         "value": round(ours, 2) if ours else None,
         "unit": "samples/sec/chip",
         "vs_baseline": vs,
-        "baseline": "torch-cpu same model+step on this host",
-        "baseline_samples_per_sec": round(base, 2) if base else None,
+        "baseline": "derived single-V100 fp32 reference path (see v100_leg)",
+        "v100_leg": v100,
+        "north_star": _north_star(ours, v100, scaling),
+        "vs_torch_cpu_host": round(ours / base, 3) if (ours and base) else None,
+        "torch_cpu_samples_per_sec": round(base, 2) if base else None,
         "mfu": flagship.get("mfu"),
         "achieved_tflops": flagship.get("achieved_tflops"),
         "peak_tflops": round(peak / 1e12, 1) if peak else None,

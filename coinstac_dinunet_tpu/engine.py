@@ -516,6 +516,12 @@ class MeshEngine:
         self._trainer = trainer
         self._mesh_pretrain(trainer, handles)
         sp = int(rc.get("sequence_parallel", 1) or 1)
+        tp = int(rc.get("tensor_parallel", 1) or 1)
+        if sp > 1 and tp > 1:
+            raise ValueError(
+                f"sequence_parallel={sp} and tensor_parallel={tp} are "
+                "mutually exclusive (one intra-site mesh axis); pick one"
+            )
         if sp > 1:
             # intra-site axis shards the SEQUENCE (ring attention) instead
             # of the batch — the trainer must implement iteration_sharded
@@ -530,6 +536,23 @@ class MeshEngine:
 
             fed = SeqMeshFederation(
                 trainer, self.n_sites, sp=sp,
+                agg_engine=str(rc.get("agg_engine", "dSGD")),
+                devices=self.devices,
+            )
+        elif tp > 1:
+            # intra-site axis shards the model's heavy matmuls (Megatron
+            # col/row parallelism) — the trainer must implement iteration_tp
+            if self.devices_per_site not in (None, tp):
+                raise ValueError(
+                    f"devices_per_site={self.devices_per_site} conflicts "
+                    f"with tensor_parallel={tp}: the intra-site axis is the "
+                    "tensor axis (tp ranks per site); drop one of the two "
+                    "settings"
+                )
+            from .parallel.tp_mesh import TPMeshFederation
+
+            fed = TPMeshFederation(
+                trainer, self.n_sites, tp=tp,
                 agg_engine=str(rc.get("agg_engine", "dSGD")),
                 devices=self.devices,
             )
@@ -697,7 +720,8 @@ class MeshEngine:
         trainer.init_nn()
         if wfile and os.path.exists(os.path.join(xfer, wfile)):
             trainer.load_checkpoint(
-                full_path=os.path.join(xfer, wfile), load_optimizer=False
+                full_path=os.path.join(xfer, wfile), load_optimizer=False,
+                allow_torch=False,  # broadcast file: framework msgpack only
             )
         logger.info(
             f"MeshEngine: pretrain at {designated} "

@@ -25,13 +25,99 @@ from ..trainer import COINNTrainer
 from ..utils import stable_file_id
 
 
+class TPDense(nn.Module):
+    """Dense layer whose MATMUL can shard over a tensor-parallel mesh axis
+    while its PARAMETERS stay full-shape and replicated.
+
+    Megatron-style column/row parallelism, adapted to the federated setting:
+    every rank stores the whole kernel (so checkpoints, the cross-site
+    replication invariant, and the dSGD/PowerSGD aggregation plane are all
+    independent of ``tp``) but COMPUTES only its slice — 1/tp of the FLOPs
+    and 1/tp of the intermediate activation memory, which is where the
+    transformer's cost lives; the weights themselves are small here.
+
+    - ``mode='col'``: output features shard; rank r computes
+      ``x @ kernel[:, r-th column block]``.  ``groups=g`` slices each of
+      ``g`` equal feature blocks separately (a fused qkv projection must
+      shard per-head WITHIN q, k and v, not across the concatenation).
+    - ``mode='row'``: input features are sharded; rank r multiplies its
+      activation shard by its kernel row block, and a ``psum`` over the
+      axis assembles the output.  The bias enters as ``bias/tp`` per rank
+      BEFORE the psum, so the forward value is exactly ``+bias``.
+
+    Gradient assembly across ``tp`` is a uniform ``pmean`` — exact for
+    sliced and replicated leaves alike; see the cotangent derivation in
+    ``parallel/tp_mesh.py``'s module docstring.
+
+    With ``tp_axis=None`` this is exactly ``nn.Dense`` (same init, same
+    math, same param shapes) — one param tree serves every tp value.
+    """
+
+    features: int
+    mode: str = "col"
+    groups: int = 1
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.float32
+    tp_axis: str = None
+
+    @nn.compact
+    def __call__(self, x):
+        d_local = x.shape[-1]
+        n = lax.axis_size(self.tp_axis) if self.tp_axis else 1
+        # row mode sees a feature-sharded input: the stored kernel is the
+        # full (d_global, features) matrix
+        d_in = d_local * n if (self.tp_axis and self.mode == "row") else d_local
+        # param dtype pinned f32 like nn.Dense's param_dtype default (under
+        # jax_enable_x64 an unpinned initializer would draw f64 — different
+        # values, breaking the one-tree-for-every-tp invariant)
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (d_in, self.features),
+            jnp.float32,
+        )
+        bias = (self.param("bias", nn.initializers.zeros, (self.features,),
+                           jnp.float32)
+                if self.use_bias else None)
+        kernel = kernel.astype(self.dtype)
+        x = jnp.asarray(x, self.dtype)
+        if not self.tp_axis:
+            y = x @ kernel
+            return y + bias.astype(self.dtype) if bias is not None else y
+        r = lax.axis_index(self.tp_axis)
+        if self.mode == "col":
+            g, f = self.groups, self.features // self.groups
+            assert f % n == 0, (
+                f"tp={n} must divide the per-group features {f}"
+            )
+            fl = f // n
+            # (d, g*f) → (d, g, f) → this rank's (d, g, f/n) → (d, g*f/n)
+            k3 = kernel.reshape(d_in, g, f)
+            kl = lax.dynamic_slice_in_dim(k3, r * fl, fl, axis=2)
+            y = x @ kl.reshape(d_in, g * fl)
+            if bias is not None:
+                b3 = bias.reshape(g, f)
+                blocal = lax.dynamic_slice_in_dim(b3, r * fl, fl, axis=1)
+                y = y + blocal.reshape(g * fl).astype(self.dtype)
+            return y
+        if self.mode != "row":
+            raise ValueError(f"unknown TPDense mode {self.mode!r}")
+        kl = lax.dynamic_slice_in_dim(kernel, r * d_local, d_local, axis=0)
+        y = x @ kl
+        if bias is not None:
+            y = y + (bias / n).astype(self.dtype)
+        return lax.psum(y, self.tp_axis)
+
+
 class MultiHeadSelfAttention(nn.Module):
     """Self-attention over (B, T, D) through the fused flash kernel.
 
     ``sp_axis`` switches to exact global ring attention over that mesh axis
     (the module then sees only this rank's sequence block and MUST be traced
     inside a ``shard_map`` binding the axis — see ``parallel/seq_mesh.py``).
-    Parameters are identical either way, so one checkpoint serves both.
+    ``tp_axis`` shards the HEADS over that mesh axis instead (Megatron
+    attention: column-parallel qkv by head groups, local flash attention on
+    this rank's heads, row-parallel output projection) — see
+    ``parallel/tp_mesh.py``.  Parameters are identical in every mode, so one
+    checkpoint serves all of them.
     """
 
     num_heads: int
@@ -39,15 +125,26 @@ class MultiHeadSelfAttention(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = None  # None → platform default (pallas on TPU)
     sp_axis: str = None  # sequence-parallel mesh axis (ring attention)
+    tp_axis: str = None  # tensor-parallel mesh axis (head sharding)
 
     @nn.compact
     def __call__(self, x):
         b, t, d = x.shape
         assert d % self.num_heads == 0, "num_heads must divide d_model"
         hd = d // self.num_heads
-        qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype)(x)
+        heads = self.num_heads
+        if self.tp_axis:
+            n = lax.axis_size(self.tp_axis)
+            assert heads % n == 0, "tp must divide num_heads"
+            heads = heads // n
+        # qkv groups=3: each of q/k/v slices by this rank's head block.
+        # Explicit name= keeps the historical nn.Dense param keys, so
+        # checkpoints from before the TPDense swap keep loading.
+        qkv = TPDense(3 * d, mode="col", groups=3, use_bias=False,
+                      dtype=self.dtype, tp_axis=self.tp_axis,
+                      name="Dense_0")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        split = lambda a: a.reshape(b, t, self.num_heads, hd).transpose(0, 2, 1, 3)
+        split = lambda a: a.reshape(b, t, heads, hd).transpose(0, 2, 1, 3)
         if self.sp_axis:
             from ..parallel.ring_attention import ring_attention
 
@@ -60,8 +157,9 @@ class MultiHeadSelfAttention(nn.Module):
                 split(q), split(k), split(v), causal=self.causal,
                 impl=self.attn_impl,
             )
-        out = out.transpose(0, 2, 1, 3).reshape(b, t, d)
-        return nn.Dense(d, use_bias=False, dtype=self.dtype)(out)
+        out = out.transpose(0, 2, 1, 3).reshape(b, t, heads * hd)
+        return TPDense(d, mode="row", use_bias=False, dtype=self.dtype,
+                       tp_axis=self.tp_axis, name="Dense_1")(out)
 
 
 class TransformerBlock(nn.Module):
@@ -71,6 +169,7 @@ class TransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = None
     sp_axis: str = None
+    tp_axis: str = None
 
     @nn.compact
     def __call__(self, x):
@@ -78,12 +177,17 @@ class TransformerBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MultiHeadSelfAttention(
             self.num_heads, self.causal, self.dtype, self.attn_impl,
-            self.sp_axis,
+            self.sp_axis, self.tp_axis,
         )(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        h = nn.Dense(self.mlp_ratio * d, dtype=self.dtype)(h)
+        # Megatron MLP: column-parallel up (gelu on the local feature
+        # shard is exact — elementwise), row-parallel down with one psum.
+        # name= preserves the pre-TPDense checkpoint keys.
+        h = TPDense(self.mlp_ratio * d, mode="col", dtype=self.dtype,
+                    tp_axis=self.tp_axis, name="Dense_0")(h)
         h = nn.gelu(h)
-        return x + nn.Dense(d, dtype=self.dtype)(h)
+        return x + TPDense(d, mode="row", dtype=self.dtype,
+                           tp_axis=self.tp_axis, name="Dense_1")(h)
 
 
 class SeqClassifier(nn.Module):
@@ -105,9 +209,16 @@ class SeqClassifier(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = None
     sp_axis: str = None
+    tp_axis: str = None
 
     @nn.compact
     def __call__(self, x):
+        if self.sp_axis and self.tp_axis:
+            raise ValueError(
+                "sp_axis and tp_axis are mutually exclusive in this model "
+                "(one intra-site mesh axis); pick sequence OR tensor "
+                "parallelism per run"
+            )
         # x: (B, T, F) continuous features (e.g. ROI timeseries); under
         # sequence parallelism T is this rank's block of the global sequence
         x = jnp.asarray(x, self.dtype)
@@ -136,6 +247,7 @@ class SeqClassifier(nn.Module):
             x = TransformerBlock(
                 self.num_heads, causal=self.causal, dtype=self.dtype,
                 attn_impl=self.attn_impl, sp_axis=self.sp_axis,
+                tp_axis=self.tp_axis,
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.sp_axis:
@@ -178,7 +290,7 @@ class SeqTrainer(COINNTrainer):
     metrics, checkpoints — one checkpoint format across sp values.
     """
 
-    def _build_model(self, sp_axis=None):
+    def _build_model(self, sp_axis=None, tp_axis=None):
         return SeqClassifier(
             num_classes=int(self.cache.get("num_classes", 2)),
             d_model=int(self.cache.get("d_model", 128)),
@@ -189,19 +301,32 @@ class SeqTrainer(COINNTrainer):
             dtype=jnp.dtype(self.cache.setdefault("compute_dtype", "float32")),
             attn_impl=self.cache.get("attn_impl"),
             sp_axis=sp_axis,
+            tp_axis=tp_axis,
         )
 
     def _init_nn_model(self):
         self.nn["seq_net"] = self._build_model()
 
-    def iteration_sharded(self, params, batch, rng=None, sp_axis=None):
-        if sp_axis is None:
-            return self.iteration(params, batch, rng)
-        model = self._build_model(sp_axis=sp_axis)
+    def _iteration_axis(self, params, batch, **axes):
+        """Shared body of the axis-sharded iterations: same params, the
+        model re-built with the given mesh axis bound (ring attention for
+        ``sp_axis``, Megatron col/row slicing for ``tp_axis``); logits come
+        out replicated across the intra axis."""
+        model = self._build_model(**axes)
         logits = model.apply(params["seq_net"], batch["inputs"])
         return classification_outputs(
             logits, batch["labels"], mask=batch.get("_mask")
         )
+
+    def iteration_sharded(self, params, batch, rng=None, sp_axis=None):
+        if sp_axis is None:
+            return self.iteration(params, batch, rng)
+        return self._iteration_axis(params, batch, sp_axis=sp_axis)
+
+    def iteration_tp(self, params, batch, rng=None, tp_axis=None):
+        if tp_axis is None:
+            return self.iteration(params, batch, rng)
+        return self._iteration_axis(params, batch, tp_axis=tp_axis)
 
     def example_inputs(self):
         x = jnp.zeros(

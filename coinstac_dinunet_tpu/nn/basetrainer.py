@@ -191,6 +191,25 @@ class NNTrainer:
             "override iteration_sharded() to run with sequence_parallel > 1"
         )
 
+    def iteration_tp(self, params, batch, rng=None, tp_axis=None):
+        """Tensor-parallel-aware iteration (hook for the ``(site, tp)``
+        mesh, :class:`~..parallel.tp_mesh.TPMeshFederation`).
+
+        Called inside ``shard_map`` with the site's batch REPLICATED across
+        the ``tp`` ranks; the model must compute each heavy matmul's
+        rank-slice (Megatron column/row parallelism — ``TPDense`` in
+        ``models/transformer.py``) and psum the row-parallel outputs so the
+        loss comes out replicated.  Default: plain ``iteration`` when
+        ``tp_axis`` is None, otherwise refuse — running the full model on
+        every tp rank would silently waste tp× the compute, and slicing
+        without the matching collectives would change the math."""
+        if tp_axis is None:
+            return self.iteration(params, batch, rng)
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement tensor parallelism; "
+            "override iteration_tp() to run with tensor_parallel > 1"
+        )
+
     def _init_optimizer(self):
         """Default: one Adam per model at ``cache['learning_rate']``."""
         lr = float(self.cache.get("learning_rate", 1e-3))
@@ -377,11 +396,23 @@ class NNTrainer:
         atomic_write(path, flax.serialization.msgpack_serialize(payload))
         return path
 
-    def load_checkpoint(self, name=None, full_path=None, load_optimizer=True):
+    def load_checkpoint(self, name=None, full_path=None, load_optimizer=True,
+                        allow_torch=True):
         path = full_path or self.checkpoint_path(name)
         from ..utils.torch_import import is_torch_file
 
         if is_torch_file(path):
+            if not allow_torch:
+                # wire-received files (aggregator pretrain broadcast) are
+                # always this framework's own msgpack checkpoints; a torch
+                # pickle arriving there is at best a misconfiguration and at
+                # worst an attack on the sites — never deserialize it
+                raise RuntimeError(
+                    f"{path!r} is a torch checkpoint, but torch import is "
+                    "only allowed for operator-configured local files "
+                    "(cache['pretrained_path']), not files received from "
+                    "the aggregator"
+                )
             return self._load_torch_checkpoint(path, load_optimizer)
         with open(path, "rb") as f:
             payload = flax.serialization.msgpack_restore(f.read())
@@ -444,7 +475,8 @@ class NNTrainer:
                 "init_nn() before load_checkpoint() on a torch file"
             )
         imported, torch_opts = _convert_checkpoint_with_opts(
-            template, path, name_map=name_map
+            template, path, name_map=name_map,
+            allow_unsafe=bool(self.cache.get("allow_unsafe_torch_pickle")),
         )
         if self.train_state is None:
             self._params = {**template, **imported}
@@ -458,6 +490,7 @@ class NNTrainer:
         want_opt = load_optimizer and self.cache.get(
             "import_torch_optimizer", True
         )
+        grafted_counts = []
         for n in imported:
             opt_state[n] = self.optimizer[n].init(imported[n])
             opt_sd = torch_opts.get(n)
@@ -468,14 +501,19 @@ class NNTrainer:
                     template[n], opt_sd, name_map=name_map
                 )
                 opt_state[n] = graft_adam_state(opt_state[n], mu, nu, count)
+                grafted_counts.append(count)
             except (ValueError, KeyError, TypeError) as exc:
                 logger.warn(
                     f"torch optimizer state for {n!r} not imported ({exc}); "
                     "starting that optimizer fresh"
                 )
+        # a true resume carries the step forward too: anything keyed on
+        # train_state.step (LR schedules, step-based logging) continues
+        # from the imported optimizer count.  A plain warm start (no
+        # optimizer graft) restarts at step 0.
+        step = jnp.asarray(max(grafted_counts, default=0), jnp.int32)
         self.train_state = self.train_state.replace(
-            params=params, opt_state=opt_state,
-            step=jnp.zeros((), jnp.int32),
+            params=params, opt_state=opt_state, step=step,
         )
         return self
 

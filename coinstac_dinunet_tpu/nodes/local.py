@@ -366,6 +366,9 @@ class COINNLocal:
                         self.input["pretrained_weights"],
                     ),
                     load_optimizer=False,
+                    # aggregator-broadcast file: must be this framework's own
+                    # msgpack checkpoint — never route it into torch.load
+                    allow_torch=False,
                 )
                 self.cache["_train_state"] = trainer.train_state
             self.out["phase"] = Phase.COMPUTATION.value

@@ -615,6 +615,29 @@ class MeshFederation:
         return self._eval(self.trainer.train_state, glob)
 
 
+class ReplicatedBatchFederation(MeshFederation):
+    """Shared hooks for intra-site axes that do NOT shard samples.
+
+    Sequence parallelism (``seq_mesh.py``) and tensor parallelism
+    (``tp_mesh.py``) both keep every intra-site rank holding the site's
+    full mask and produce aux outputs replicated across the intra axis
+    (the model's own collective — pooling psum or row-parallel psum) —
+    so the participation weight needs no intra-axis reduction and aux
+    reduces over ``site`` only."""
+
+    def _site_weight(self, stacked):
+        mask = stacked.get("_mask")
+        if mask is None:
+            return jnp.float32(1)
+        return (jnp.sum(jnp.asarray(mask, jnp.float32)) > 0).astype(
+            jnp.float32
+        )
+
+    def _aux_axes(self):
+        # reducing over the intra axis too would multi-count every sample
+        return ("site",)
+
+
 def lockstep_batches(n_sites, site_sizes, batch_size):
     """Equal-length epochs for every site (≙ the padded sampler invariant,
     ref ``data/data.py:203-242``): global batches per epoch = ceil(max/B)."""

@@ -33,12 +33,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import MeshFederation
+from .mesh import ReplicatedBatchFederation
 
 __all__ = ["SeqMeshFederation"]
 
 
-class SeqMeshFederation(MeshFederation):
+class SeqMeshFederation(ReplicatedBatchFederation):
     """Federated rounds over a ``(site, sp)`` mesh (sequence parallelism).
 
     ``rankDAD`` is rejected: its per-sample factor capture assumes each rank
@@ -76,20 +76,8 @@ class SeqMeshFederation(MeshFederation):
 
         return sp_grad_reduce
 
-    def _site_weight(self, stacked):
-        # the mask does not shard with the sequence: every sp rank holds
-        # the site's full mask — no intra-site psum needed
-        mask = stacked.get("_mask")
-        if mask is None:
-            return jnp.float32(1)
-        return (jnp.sum(jnp.asarray(mask, jnp.float32)) > 0).astype(
-            jnp.float32
-        )
-
-    def _aux_axes(self):
-        # aux outputs are replicated across sp (pooling collective inside
-        # the model) — reducing over sp too would sp×-count every sample
-        return ("site",)
+    # _site_weight/_aux_axes: inherited from ReplicatedBatchFederation —
+    # every sp rank holds the site's full mask, aux replicated across sp
 
     def _train_batch_specs(self):
         """``inputs`` (site, k, B, T, F) shards T over ``sp``; labels/_mask

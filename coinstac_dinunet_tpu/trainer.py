@@ -80,7 +80,9 @@ class COINNTrainer(NNTrainer):
             return False
         path = os.path.join(self.state.get("baseDirectory", "."), fname)
         if os.path.exists(path):
-            self.load_checkpoint(full_path=path, load_optimizer=False)
+            # broadcast file — framework msgpack only, never torch pickles
+            self.load_checkpoint(full_path=path, load_optimizer=False,
+                                 allow_torch=False)
             # keep a local copy as the fold's current best
             shutil.copy(path, self.checkpoint_path(self.cache.get("best_nn_state", "best.ckpt")))
             return True

@@ -45,22 +45,45 @@ def _torch():
 
 
 def is_torch_file(path):
-    """Cheap magic-byte sniff: torch>=1.6 zip archives start ``PK``; legacy
-    torch pickles also begin with a pickle protocol marker ``\\x80``."""
+    """Magic-byte sniff for torch checkpoints.
+
+    torch>=1.6 writes a zip archive whose payload member is ``*/data.pkl``
+    — a bare ``PK`` header is NOT enough (any zip would route into
+    ``torch.load``), so the zip's member list is checked.  Legacy torch
+    pickles begin with the pickle protocol marker ``\\x80``; that byte is
+    necessarily ambiguous (it is also msgpack's empty fixmap), so the
+    torch load path wraps failures into a clear format error rather than
+    letting an arbitrary ``\\x80`` file produce a deep unpickling trace."""
     try:
         with open(path, "rb") as f:
             head = f.read(2)
     except OSError:
         return False
-    return head[:2] == b"PK" or head[:1] == b"\x80"
+    if head[:2] == b"PK":
+        import zipfile
+
+        try:
+            with zipfile.ZipFile(path) as z:
+                return any(n.endswith("data.pkl") for n in z.namelist())
+        except (zipfile.BadZipFile, OSError):
+            return False
+    return head[:1] == b"\x80"
 
 
-def load_torch_payload(path):
+def load_torch_payload(path, allow_unsafe=False):
     """``torch.load`` a checkpoint and normalize it to the reference's two
     shapes: ``({model_name: state_dict}, optimizers_or_None)`` for a
     ``source='coinstac'`` payload, or ``({None: state_dict}, None)`` for a
     raw state dict (caller assigns it to its first model — exactly the
-    reference fallback, ``nn/basetrainer.py:95-99``)."""
+    reference fallback, ``nn/basetrainer.py:95-99``).
+
+    Loads with ``weights_only=True`` (data-only, no code execution).  A
+    legacy checkpoint that the weights-only unpickler rejects (pickled
+    module classes / non-allowlisted globals) is REFUSED unless the
+    operator passes ``allow_unsafe=True`` — full unpickling executes
+    arbitrary code from the file, so it must only ever be enabled for
+    operator-trusted local files (``cache['allow_unsafe_torch_pickle']``),
+    never for anything received over the wire."""
     import pickle
 
     torch = _torch()
@@ -68,11 +91,24 @@ def load_torch_payload(path):
         raise RuntimeError("torch is required to import torch checkpoints")
     try:
         payload = torch.load(path, map_location="cpu", weights_only=True)
-    except pickle.UnpicklingError:
-        # ONLY the weights-only rejection (non-allowlisted globals in the
-        # user's own legacy checkpoint) falls back to full unpickling;
-        # corruption/IO errors propagate with their original cause
+    except pickle.UnpicklingError as exc:
+        if not allow_unsafe:
+            raise RuntimeError(
+                f"torch checkpoint {path!r} is not loadable with "
+                "weights_only=True (it pickles non-tensor globals). "
+                "Loading it requires full unpickling, which EXECUTES CODE "
+                "from the file.  If — and only if — this file comes from a "
+                "source you trust (your own legacy training run), set "
+                "cache['allow_unsafe_torch_pickle']=True and retry."
+            ) from exc
         payload = torch.load(path, map_location="cpu", weights_only=False)
+    except Exception as exc:
+        # \x80-sniffed non-torch file (e.g. a stray msgpack/pickle artifact):
+        # surface a format error, not an unpickler internals trace
+        raise RuntimeError(
+            f"{path!r} looked like a torch checkpoint (magic bytes) but "
+            f"torch.load failed: {exc}"
+        ) from exc
     if isinstance(payload, dict) and str(payload.get("source", "")).lower() == "coinstac":
         return dict(payload.get("models", {})), payload.get("optimizers")
     return {None: payload}, None
@@ -236,10 +272,11 @@ def convert_state_dict(flax_params, state_dict, name_map=None):
     return _unflatten([out[p] for p, _ in flax_flat], flax_params)
 
 
-def _convert_checkpoint_with_opts(template, path, name_map=None):
+def _convert_checkpoint_with_opts(template, path, name_map=None,
+                                  allow_unsafe=False):
     """(models, raw per-model torch optimizer state dicts) — see
     :func:`convert_torch_checkpoint`."""
-    state_dicts, optimizers = load_torch_payload(path)
+    state_dicts, optimizers = load_torch_payload(path, allow_unsafe=allow_unsafe)
     if set(state_dicts) == {None}:
         state_dicts = {next(iter(template)): state_dicts[None]}
     unknown = set(state_dicts) - set(template)
@@ -255,7 +292,8 @@ def _convert_checkpoint_with_opts(template, path, name_map=None):
     return models, dict(optimizers or {})
 
 
-def convert_torch_checkpoint(template, path, name_map=None):
+def convert_torch_checkpoint(template, path, name_map=None,
+                             allow_unsafe=False):
     """Convert a torch checkpoint file against ``template``
     ({model_name: flax_variables}, CREATION-ordered trees).
 
@@ -268,7 +306,7 @@ def convert_torch_checkpoint(template, path, name_map=None):
     :func:`convert_torch_adam_state`.
     """
     models, _opts = _convert_checkpoint_with_opts(
-        template, path, name_map=name_map
+        template, path, name_map=name_map, allow_unsafe=allow_unsafe
     )
     return models
 
@@ -304,12 +342,15 @@ def convert_torch_adam_state(template, opt_sd, name_map=None):
             f"{len(trainable)}"
         )
     state = opt_sd.get("state", {})
-    by_path, count = {}, 0
+    by_path, steps = {}, []
     for (path, leaf), ix in zip(trainable, ordered_ix):
         st = state.get(ix, state.get(str(ix)))
         arr = np.asarray(leaf)
-        if st is None:  # param never stepped: zero moments
+        if st is None:  # param never stepped: zero moments, step 0 — feeds
+            # the divergence check below (a tracked-but-never-stepped param
+            # IS the params-added-mid-training case)
             by_path[path] = (np.zeros(arr.shape, arr.dtype),) * 2
+            steps.append(0)
             continue
         m = _convert_tensor(f"exp_avg[{ix}]", st["exp_avg"], path, arr.shape)
         v = _convert_tensor(f"exp_avg_sq[{ix}]", st["exp_avg_sq"], path,
@@ -322,7 +363,19 @@ def convert_torch_adam_state(template, opt_sd, name_map=None):
         # moments take the param leaf's dtype, like a fresh optax state
         by_path[path] = (m.astype(arr.dtype), v.astype(arr.dtype))
         step = st.get("step", 0)
-        count = max(count, int(step.item() if hasattr(step, "item") else step))
+        steps.append(int(step.item() if hasattr(step, "item") else step))
+    # optax ScaleByAdamState keeps ONE global count; torch keeps one per
+    # param.  When the stepped params disagree (params added mid-training,
+    # frozen periods), any single count over-corrects bias for some of them
+    # — refuse, and the caller falls back to the documented fresh-optimizer
+    # warm start.  Off-by-one is tolerated (a checkpoint written mid-step).
+    count = max(steps, default=0)
+    if steps and count - min(steps) > 1:
+        raise ValueError(
+            f"torch per-param step counts disagree (min {min(steps)}, max "
+            f"{count}) — a single optax count would mis-apply Adam bias "
+            "correction; starting the optimizer fresh instead"
+        )
     mu, nu = [], []
     for path, leaf in flat:
         arr = np.asarray(leaf)
@@ -359,12 +412,13 @@ def graft_adam_state(opt_state, mu_tree, nu_tree, count):
     return out
 
 
-def import_torch_checkpoint(params, path, name_map=None):
+def import_torch_checkpoint(params, path, name_map=None, allow_unsafe=False):
     """Load a torch checkpoint file onto a dict-of-models param tree.
 
     Returns a new params dict; models absent from the checkpoint keep
     ``params``'s values.  See :func:`convert_torch_checkpoint`.
     """
     out = dict(params)
-    out.update(convert_torch_checkpoint(params, path, name_map=name_map))
+    out.update(convert_torch_checkpoint(params, path, name_map=name_map,
+                                        allow_unsafe=allow_unsafe))
     return out

