@@ -129,6 +129,54 @@ class InProcessEngine:
         self.rounds = 0
         self.success = False
         self.last_remote_out = {}
+        self.dead_sites = set()
+        # seed the quorum roster with the FULL consortium: a site dying in
+        # round 0 must be judged (and recorded) against the original
+        # n_sites, not silently absorbed into a shrunken roster
+        # (COINNRemote._init_runs setdefaults, so this wins)
+        self.remote_cache["all_sites"] = list(self.site_ids)
+
+    # --------------------------------------------------------- site dropout
+    def _alive_site_ids(self):
+        return [s for s in self.site_ids if s not in self.dead_sites]
+
+    def _quorum_configured(self):
+        """True when site_quorum was configured on ANY of this engine's
+        channels: engine **args (in-process), a node cache that already
+        resolved it (fresh-process, after round 1), or the fresh-process
+        engine's ``first_input`` (before round 1) — either at the top
+        level or nested in a ``*_args`` tier of the 3-tier arg pipeline."""
+
+        def has_quorum(d):
+            if not isinstance(d, dict):
+                return False
+            if d.get("site_quorum"):
+                return True
+            return any(
+                isinstance(v, dict) and v.get("site_quorum")
+                for k, v in d.items() if str(k).endswith("_args")
+            )
+
+        if has_quorum(self.args):
+            return True
+        if any(has_quorum(c) for c in self.site_caches.values()):
+            return True
+        fi = getattr(self, "first_input", None)
+        return bool(fi) and any(has_quorum(v) for v in fi.values())
+
+    def _site_failure(self, s, exc):
+        """A site's invocation raised.  Without ``site_quorum`` the failure
+        propagates (reference-faithful all-site lockstep); with it, the site
+        is marked dead and excluded from all subsequent rounds — the REMOTE
+        enforces the actual quorum policy and the documented survivor-
+        weighted semantics (``COINNRemote._check_quorum``)."""
+        if not self._quorum_configured():
+            raise exc
+        self.dead_sites.add(s)
+        logger.warn(
+            f"site {s} died mid-run ({type(exc).__name__}: {exc}); "
+            "excluded from the remaining rounds (site_quorum set)"
+        )
 
     def site_data_dir(self, site_id, data_dir="data"):
         d = os.path.join(self.site_states[site_id]["baseDirectory"], data_dir)
@@ -140,7 +188,7 @@ class InProcessEngine:
         """One full engine round: every site computes, files relay to the
         aggregator, the aggregator computes, its output + files relay back."""
         site_outs = {}
-        for s in self.site_ids:
+        for s in self._alive_site_ids():
             node = COINNLocal(
                 cache=self.site_caches[s],
                 input=self.site_inputs[s],
@@ -148,14 +196,20 @@ class InProcessEngine:
                 **{**self.site_spec.get(s, {}), **self.args,
                    **self.site_args.get(s, {})},
             )
-            result = node(
-                trainer_cls=self.trainer_cls,
-                dataset_cls=self.dataset_cls,
-                datahandle_cls=self.datahandle_cls,
-                learner_cls=self.learner_cls,
-            )
+            try:
+                result = node(
+                    trainer_cls=self.trainer_cls,
+                    dataset_cls=self.dataset_cls,
+                    datahandle_cls=self.datahandle_cls,
+                    learner_cls=self.learner_cls,
+                )
+            except Exception as exc:  # noqa: BLE001 — see _site_failure
+                self._site_failure(s, exc)
+                continue
             site_outs[s] = result["output"]
 
+        if not site_outs:
+            raise RuntimeError("every site died; nothing to aggregate")
         remote = COINNRemote(
             cache=self.remote_cache, input=site_outs, state=self.remote_state
         )
@@ -166,15 +220,15 @@ class InProcessEngine:
         self.success = bool(result.get("success"))
         self.last_remote_out = remote_out
 
-        # relay aggregator transfer files into every site's inbox
+        # relay aggregator transfer files into every surviving site's inbox
         xfer = self.remote_state["transferDirectory"]
         for f in os.listdir(xfer):
-            for s in self.site_ids:
+            for s in self._alive_site_ids():
                 shutil.copy(
                     os.path.join(xfer, f),
                     os.path.join(self.site_states[s]["baseDirectory"], f),
                 )
-        self.site_inputs = {s: dict(remote_out) for s in self.site_ids}
+        self.site_inputs = {s: dict(remote_out) for s in self._alive_site_ids()}
         self.rounds += 1
         return site_outs, remote_out
 
@@ -262,18 +316,24 @@ class SubprocessEngine(InProcessEngine):
 
     def step_round(self):
         site_outs = {}
-        for s in self.site_ids:
+        for s in self._alive_site_ids():
             inp = dict(self.site_inputs[s])
             if s not in self._first_done:
                 inp.update(self.first_input.get(s, {}))
                 self._first_done.add(s)
-            res = self._invoke(self.local_script, {
-                "cache": self.site_caches[s], "input": inp,
-                "state": self.site_states[s],
-            })
+            try:
+                res = self._invoke(self.local_script, {
+                    "cache": self.site_caches[s], "input": inp,
+                    "state": self.site_states[s],
+                })
+            except Exception as exc:  # noqa: BLE001 — see _site_failure
+                self._site_failure(s, exc)
+                continue
             self.site_caches[s] = res.get("cache", {})
             site_outs[s] = res["output"]
 
+        if not site_outs:
+            raise RuntimeError("every site died; nothing to aggregate")
         res = self._invoke(self.remote_script, {
             "cache": self.remote_cache, "input": site_outs,
             "state": self.remote_state,
@@ -285,12 +345,12 @@ class SubprocessEngine(InProcessEngine):
 
         xfer = self.remote_state["transferDirectory"]
         for f in os.listdir(xfer):
-            for s in self.site_ids:
+            for s in self._alive_site_ids():
                 shutil.copy(
                     os.path.join(xfer, f),
                     os.path.join(self.site_states[s]["baseDirectory"], f),
                 )
-        self.site_inputs = {s: dict(remote_out) for s in self.site_ids}
+        self.site_inputs = {s: dict(remote_out) for s in self._alive_site_ids()}
         self.rounds += 1
         return site_outs, remote_out
 

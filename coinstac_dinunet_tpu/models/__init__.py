@@ -16,7 +16,8 @@ Design: channels-last layouts (NDHWC), GroupNorm rather than BatchNorm (pure
 ``apply`` — no mutable batch statistics to drift across federated sites),
 optional bfloat16 compute with float32 params.
 """
-from .cnn3d import SyntheticVBMDataset, VBM3DNet, VBMTrainer  # noqa: F401
+from .cnn3d import (NiftiVBMDataset, SyntheticVBMDataset,  # noqa: F401
+                    VBM3DNet, VBMTrainer, fit_volume)
 from .mlp import FSVDataset, FSVNet, FSVTrainer  # noqa: F401
 from .multinet import MultiNetTrainer  # noqa: F401
 from .resnet import ResNet18, ResNetTrainer, SyntheticImageDataset  # noqa: F401
@@ -28,7 +29,7 @@ from .transformer import (  # noqa: F401
 
 __all__ = [
     "FSVNet", "FSVTrainer", "FSVDataset",
-    "VBM3DNet", "VBMTrainer", "SyntheticVBMDataset",
+    "VBM3DNet", "VBMTrainer", "SyntheticVBMDataset", "NiftiVBMDataset", "fit_volume",
     "ResNet18", "ResNetTrainer", "SyntheticImageDataset",
     "MultiNetTrainer",
     "SeqClassifier", "SeqTrainer", "SyntheticSeqDataset",

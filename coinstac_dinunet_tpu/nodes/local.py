@@ -60,6 +60,10 @@ class COINNLocal:
         num_averages=1,
         seed=None,
         verbose=False,
+        # opt-in dropout tolerance: freezes into shared_args so the
+        # aggregator's quorum policy sees it on EVERY transport, including
+        # fresh-process nodes configured via first_input
+        site_quorum=None,
         # engine-specific knobs (present so they freeze into shared_args)
         matrix_approximation_rank=1,
         start_powerSGD_iter=10,
@@ -481,6 +485,9 @@ class COINNLocal:
                     if not str(k).startswith("_")
                 }),
             }
-        except Exception:
+        except Exception as exc:
             traceback.print_exc()
-            raise RuntimeError(f"Local node failed with partial out: {self.out}")
+            raise RuntimeError(
+                f"Local node failed ({type(exc).__name__}: {exc}) with "
+                f"partial out: {self.out}"
+            )

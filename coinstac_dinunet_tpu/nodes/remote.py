@@ -24,6 +24,7 @@ from .. import config, utils
 from ..config.keys import AggEngine, GatherMode, Key, Mode, Phase
 from ..data import EmptyDataHandle
 from ..parallel import COINNReducer, DADReducer, PowerSGDReducer
+from ..utils import logger
 from ..utils.logger import lazy_debug
 from ..utils.profiling import PhaseTimer
 from ..utils.utils import performance_improved_, stop_training_
@@ -47,10 +48,64 @@ class COINNRemote:
                 self.cache.update(**site["shared_args"])
                 self.cache[Key.ARGS_CACHED.value] = True
 
+    # ---------------------------------------------------------- site dropout
+    def _check_quorum(self):
+        """Enforce the site-participation contract at every barrier.
+
+        The reference hard-fails on a silent site — every barrier is an
+        all-site check (ref ``remote.py:225-258``), so a site that stops
+        reporting wedges or kills the run with no diagnosis.  Default here
+        is the same lockstep contract but LOUD: a site missing from the
+        round's input raises with the dropped-site list.  Opt-in
+        ``cache['site_quorum']`` (int = min alive sites, float in (0,1] =
+        min alive fraction of the initial roster) lets the run continue
+        with the survivors: reductions are already participation-weighted
+        (absent sites simply contribute nothing), so the math degrades to
+        the survivor average — the documented semantics, never a silent
+        re-weighting.  Once dropped, a site stays dropped (its mid-round
+        state is gone); quorum is always judged against the ORIGINAL
+        roster."""
+        roster = self.cache.get("all_sites")
+        if not roster:
+            return
+        alive = set(self.input.keys())
+        dropped = sorted(set(roster) - alive)
+        prev = set(self.cache.get("dropped_sites", []))
+        if set(dropped) == prev:
+            return
+        self.cache["dropped_sites"] = dropped
+        quorum = self.cache.get("site_quorum")
+        if not quorum:
+            raise RuntimeError(
+                f"sites {dropped} stopped reporting (round input has "
+                f"{sorted(alive)} of {roster}).  The default contract is "
+                "all-site lockstep (reference-faithful); set "
+                "cache['site_quorum'] (min alive count, or fraction of the "
+                "initial roster) to let the run continue with survivors."
+            )
+        need = (int(math.ceil(float(quorum) * len(roster)))
+                if 0 < float(quorum) <= 1 and not isinstance(quorum, int)
+                else int(quorum))
+        if len(alive) < max(need, 1):
+            raise RuntimeError(
+                f"quorum unmet: {len(alive)} sites alive "
+                f"({sorted(alive)}), quorum {quorum} of {len(roster)} "
+                f"requires >= {max(need, 1)}; dropped: {dropped}"
+            )
+        logger.warn(
+            f"sites {dropped} dropped; continuing with {sorted(alive)} "
+            f"(quorum {quorum} satisfied) — aggregates are survivor-"
+            "weighted from this round on"
+        )
+
     # ------------------------------------------------------------- run set-up
     def _init_runs(self):
         if self.cache.get("seed") is None:
             self.cache["seed"] = config.current_seed
+        # engines pre-seed the full consortium roster (a round-0 death must
+        # count against the original n_sites); standalone deployments fall
+        # back to the INIT round's participants
+        self.cache.setdefault("all_sites", sorted(self.input.keys()))
         self.cache[Key.GLOBAL_TEST_SERIALIZABLE.value] = []
         self.cache["data_size"] = {
             site: site_vars.get("data_size")
@@ -245,6 +300,7 @@ class COINNRemote:
             ),
         )
         self.out["phase"] = self.input.get("phase", Phase.INIT_RUNS.value)
+        self._check_quorum()
 
         if check(all, "phase", Phase.INIT_RUNS.value, self.input):
             self._init_runs()
@@ -298,6 +354,9 @@ class COINNRemote:
                     if not str(k).startswith("_")
                 }),
             }
-        except Exception:
+        except Exception as exc:
             traceback.print_exc()
-            raise RuntimeError(f"Remote node failed with partial out: {self.out}")
+            raise RuntimeError(
+                f"Remote node failed ({type(exc).__name__}: {exc}) with "
+                f"partial out: {self.out}"
+            )

@@ -342,15 +342,19 @@ def convert_torch_adam_state(template, opt_sd, name_map=None):
             f"{len(trainable)}"
         )
     state = opt_sd.get("state", {})
-    by_path, steps = {}, []
+    by_path, steps, stateless = {}, [], []
     for (path, leaf), ix in zip(trainable, ordered_ix):
         st = state.get(ix, state.get(str(ix)))
         arr = np.asarray(leaf)
-        if st is None:  # param never stepped: zero moments, step 0 — feeds
-            # the divergence check below (a tracked-but-never-stepped param
-            # IS the params-added-mid-training case)
+        if st is None:
+            # tracked but never stepped (frozen backbone, layer added just
+            # before saving): zero moments under the global count — its
+            # early updates run smaller than a fresh Adam's until the bias
+            # correction washes out.  A documented approximation, warned
+            # below; refusing here would throw away every OTHER param's
+            # moments, which is strictly worse.
             by_path[path] = (np.zeros(arr.shape, arr.dtype),) * 2
-            steps.append(0)
+            stateless.append("/".join(path))
             continue
         m = _convert_tensor(f"exp_avg[{ix}]", st["exp_avg"], path, arr.shape)
         v = _convert_tensor(f"exp_avg_sq[{ix}]", st["exp_avg_sq"], path,
@@ -365,16 +369,27 @@ def convert_torch_adam_state(template, opt_sd, name_map=None):
         step = st.get("step", 0)
         steps.append(int(step.item() if hasattr(step, "item") else step))
     # optax ScaleByAdamState keeps ONE global count; torch keeps one per
-    # param.  When the stepped params disagree (params added mid-training,
+    # param.  When STEPPED params disagree (params added mid-training,
     # frozen periods), any single count over-corrects bias for some of them
     # — refuse, and the caller falls back to the documented fresh-optimizer
     # warm start.  Off-by-one is tolerated (a checkpoint written mid-step).
+    # Params with NO state entry get zero moments + a warning instead (see
+    # above): discarding the whole import for them loses strictly more.
     count = max(steps, default=0)
     if steps and count - min(steps) > 1:
         raise ValueError(
             f"torch per-param step counts disagree (min {min(steps)}, max "
             f"{count}) — a single optax count would mis-apply Adam bias "
             "correction; starting the optimizer fresh instead"
+        )
+    if stateless:
+        from . import logger
+
+        logger.warn(
+            f"{len(stateless)} tracked param(s) carry no torch optimizer "
+            f"state ({stateless[:3]}…); imported with zero moments under "
+            f"count={count} — their early updates run smaller than a fresh "
+            "Adam's until the bias correction washes out"
         )
     mu, nu = [], []
     for path, leaf in flat:

@@ -1,0 +1,22 @@
+"""Site-node entry point for the NIfTI-backed VBM computation (engine
+stdin/stdout contract — see examples/fsv_classification/local.py)."""
+import json
+import sys
+
+from coinstac_dinunet_tpu import COINNLocal
+from coinstac_dinunet_tpu.models import NiftiVBMDataset, VBMTrainer
+
+
+def compute(payload):
+    node = COINNLocal(
+        cache=payload.get("cache", {}),
+        input=payload.get("input", {}),
+        state=payload.get("state", {}),
+        task_id="vbm_nifti",
+    )
+    return node(trainer_cls=VBMTrainer, dataset_cls=NiftiVBMDataset)
+
+
+if __name__ == "__main__":
+    result = compute(json.loads(sys.stdin.read()))
+    print(json.dumps(result))

@@ -1,0 +1,20 @@
+"""Aggregator entry point for the VBM computation."""
+import json
+import sys
+
+from coinstac_dinunet_tpu import COINNRemote
+from coinstac_dinunet_tpu.models import VBMTrainer
+
+
+def compute(payload):
+    node = COINNRemote(
+        cache=payload.get("cache", {}),
+        input=payload.get("input", {}),
+        state=payload.get("state", {}),
+    )
+    return node(trainer_cls=VBMTrainer)
+
+
+if __name__ == "__main__":
+    result = compute(json.loads(sys.stdin.read()))
+    print(json.dumps(result))

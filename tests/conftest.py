@@ -71,6 +71,10 @@ _SLOW_TESTS = frozenset((
     "test_mesh_engine_tp2_matches_tp1",
     "test_mesh_engine_tp_powersgd",
     "test_tp_model_matches_unsharded",
+    "test_nifti_vbm_engine_run",
+    "test_site_death_without_quorum_fails_loudly",
+    "test_subprocess_engine_quorum",
+    "test_round_zero_death_counts_against_original_roster",
     "test_fresh_process_run_reaches_success",
     "test_fresh_process_matches_in_process_scores",
     "test_fresh_process_powersgd_mid_protocol",

@@ -1,0 +1,200 @@
+"""Site-dropout tolerance at the aggregator barriers (beyond-ref robustness).
+
+The reference hard-fails every barrier on a silent site (ref
+``distrib/nodes/remote.py:225-258`` all-site checks) with no diagnosis.
+Default here is the same all-site lockstep contract but LOUD (dropped-site
+list in the error); opt-in ``site_quorum`` lets a run continue with the
+survivors under documented survivor-weighted semantics
+(``COINNRemote._check_quorum``, ``InProcessEngine._site_failure``).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu.engine import InProcessEngine
+
+from test_trainer import XorDataset, XorTrainer
+
+
+class DyingXorDataset(XorDataset):
+    """Raises during loading once the owning site reaches
+    ``cache['die_at_epoch']`` — a realistic mid-fold site crash (disk/IO
+    death inside the input pipeline)."""
+
+    def __getitem__(self, ix):
+        die_at = self.cache.get("die_at_epoch")
+        if die_at is not None and int(self.cache.get("epoch", 0)) >= int(die_at):
+            raise RuntimeError("simulated site crash (dataset IO died)")
+        return super().__getitem__(ix)
+
+
+def _engine(tmp_path, n_sites=3, per_site=24, site_args=None, **args):
+    base_args = dict(
+        task_id="xor", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+        batch_size=8, epochs=4, validation_epochs=1, learning_rate=5e-2,
+        input_shape=(2,), seed=11, patience=50,
+    )
+    base_args.update(args)
+    eng = InProcessEngine(
+        tmp_path, n_sites=n_sites, trainer_cls=XorTrainer,
+        dataset_cls=DyingXorDataset, site_args=site_args, **base_args,
+    )
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(per_site):
+            with open(os.path.join(d, f"s_{i * per_site + j}"), "w") as f:
+                f.write("x")
+    return eng
+
+
+def test_site_death_without_quorum_fails_loudly(tmp_path):
+    """Default contract: a dying site kills the run — with the site's
+    failure as the error, not a silent wedge or re-weighting."""
+    eng = _engine(tmp_path, site_args={"site_2": {"die_at_epoch": 2}})
+    # COINNLocal wraps the underlying failure in its partial-out report
+    with pytest.raises(RuntimeError, match="Local node failed"):
+        eng.run(max_rounds=600)
+
+
+def test_site_death_with_quorum_completes(tmp_path):
+    """The VERDICT r4 'done' criterion: with site_quorum set, a site dying
+    mid-fold is excluded and the run completes on the survivors."""
+    eng = _engine(
+        tmp_path, site_quorum=2,
+        site_args={"site_2": {"die_at_epoch": 2}},
+    )
+    eng.run(max_rounds=600)
+    assert eng.success, f"no SUCCESS after {eng.rounds} rounds"
+    assert eng.dead_sites == {"site_2"}
+    # the remote recorded the drop and the survivors produced global scores
+    assert eng.remote_cache.get("dropped_sites") == ["site_2"]
+    task_dir = os.path.join(eng.remote_state["outputDirectory"], "xor")
+    csvs = [f for f in os.listdir(task_dir) if f.endswith(".csv")]
+    assert any("global_test_metrics" in f for f in csvs)
+    # surviving sites got the results zip; the dead one did not
+    for s in ("site_0", "site_1"):
+        outd = eng.site_states[s]["outputDirectory"]
+        assert any(f.endswith(".zip") for f in os.listdir(outd)), s
+
+
+def test_quorum_unmet_fails_loudly(tmp_path):
+    """Two of three sites dying breaches quorum=2 — the aggregator refuses
+    with the quorum arithmetic in the error."""
+    eng = _engine(
+        tmp_path, site_quorum=2,
+        site_args={"site_1": {"die_at_epoch": 2},
+                   "site_2": {"die_at_epoch": 2}},
+    )
+    with pytest.raises(RuntimeError, match="quorum unmet"):
+        eng.run(max_rounds=600)
+
+
+def test_fractional_quorum(tmp_path):
+    """site_quorum=0.5 of a 3-site roster tolerates one death (ceil(1.5)=2
+    alive required)."""
+    eng = _engine(
+        tmp_path, site_quorum=0.5,
+        site_args={"site_0": {"die_at_epoch": 2}},
+    )
+    eng.run(max_rounds=600)
+    assert eng.success
+    assert eng.remote_cache.get("dropped_sites") == ["site_0"]
+
+
+class DyingAtIndexDataset(XorDataset):
+    """Raises during INIT_RUNS indexing — a site dead from the very first
+    round (the roster must still count it)."""
+
+    def load_index(self, dataset_name, file):
+        if self.cache.get("die_at_index"):
+            raise RuntimeError("simulated site crash (indexing died)")
+        super().load_index(dataset_name, file)
+
+
+def test_round_zero_death_counts_against_original_roster(tmp_path):
+    """A site dying in the FIRST round must be judged and recorded against
+    the original n_sites roster, not silently absorbed (the engine seeds
+    cache['all_sites'] before any round runs)."""
+    eng = InProcessEngine(
+        tmp_path, n_sites=3, trainer_cls=XorTrainer,
+        dataset_cls=DyingAtIndexDataset, task_id="xor", data_dir="data",
+        split_ratio=[0.7, 0.15, 0.15], batch_size=8, epochs=2,
+        input_shape=(2,), seed=11, patience=50, site_quorum=2,
+        site_args={"site_2": {"die_at_index": True}},
+    )
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(24):
+            with open(os.path.join(d, f"s_{i * 24 + j}"), "w") as f:
+                f.write("x")
+    eng.run(max_rounds=600)
+    assert eng.success
+    assert eng.dead_sites == {"site_2"}
+    assert eng.remote_cache.get("dropped_sites") == ["site_2"]
+    assert sorted(eng.remote_cache.get("all_sites")) == [
+        "site_0", "site_1", "site_2"]
+
+
+def test_subprocess_engine_quorum(tmp_path):
+    """Dropout tolerance on the protocol-faithful fresh-process engine:
+    site_quorum rides first_input through the 3-tier arg pipeline into
+    shared_args, and a site whose subprocess dies mid-run is excluded while
+    the survivors reach SUCCESS."""
+    import sys
+
+    from coinstac_dinunet_tpu.engine import SubprocessEngine
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "dying_local.py"
+    script.write_text('''
+import json, sys
+from coinstac_dinunet_tpu import COINNLocal
+from coinstac_dinunet_tpu.models import FSVDataset, FSVTrainer
+
+
+class DyingFSVDataset(FSVDataset):
+    def __getitem__(self, ix):
+        d = self.cache.get("die_at_epoch")
+        if d is not None and int(self.cache.get("epoch", 0)) >= int(d):
+            raise RuntimeError("simulated site crash")
+        return super().__getitem__(ix)
+
+
+payload = json.loads(sys.stdin.read())
+node = COINNLocal(cache=payload.get("cache", {}), input=payload.get("input", {}),
+                  state=payload.get("state", {}), task_id="fsv_classification")
+print(json.dumps(node(trainer_cls=FSVTrainer, dataset_cls=DyingFSVDataset)))
+''')
+    args = dict(
+        data_dir="data", split_ratio=[0.6, 0.2, 0.2], batch_size=4, epochs=2,
+        validation_epochs=1, learning_rate=5e-2, input_size=12,
+        hidden_sizes=[8], num_classes=2, seed=7, synthetic=True,
+        verbose=False, patience=50, persist_round_state=True, site_quorum=2,
+    )
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "xla_cache")
+    eng = SubprocessEngine(
+        tmp_path / "run", n_sites=3,
+        local_script=str(script),
+        remote_script=os.path.join(REPO, "examples", "fsv_classification",
+                                   "remote.py"),
+        first_input={
+            s: {"fsv_classification_args": (
+                {**args, "die_at_epoch": 1} if s == "site_2" else args)}
+            for s in ("site_0", "site_1", "site_2")
+        },
+        env=env,
+    )
+    assert eng._quorum_configured()
+    for s in eng.site_ids:
+        d = eng.site_data_dir(s)
+        for i in range(10):
+            with open(os.path.join(d, f"{s}_subj{i}.txt"), "w") as f:
+                f.write("x")
+    eng.run(max_rounds=200)
+    assert eng.success, eng.last_remote_out
+    assert eng.dead_sites == {"site_2"}

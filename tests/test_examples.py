@@ -123,3 +123,16 @@ def test_seq_compspec_and_inputspec_are_valid_json():
     with open(os.path.join(SEQ_EXAMPLE, "inputspec.json")) as f:
         ispec = json.load(f)
     assert ispec[0]["seq_len"]["value"] == 128
+
+
+NIFTI_EXAMPLE = os.path.join(REPO, "examples", "vbm_nifti")
+
+
+def test_nifti_compspec_and_inputspec_are_valid_json():
+    with open(os.path.join(NIFTI_EXAMPLE, "compspec.json")) as f:
+        spec = json.load(f)
+    assert spec["computation"]["command"] == ["python", "local.py"]
+    assert "labels_file" in spec["computation"]["input"]
+    with open(os.path.join(NIFTI_EXAMPLE, "inputspec.json")) as f:
+        ispec = json.load(f)
+    assert ispec[0]["labels_file"]["value"] == "labels.csv"

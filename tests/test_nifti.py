@@ -1,0 +1,144 @@
+"""NIfTI input pipeline: the built-in NIfTI-1 reader + the real-data VBM
+dataset through the full engine lifecycle (VERDICT r4 item 7: exercise the
+input path the way a COINSTAC deployment does — real volume files through
+``COINNDataset.load_index``/``__getitem__``, not in-memory synthetics)."""
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu.data.nifti import load_nifti, save_nifti
+from coinstac_dinunet_tpu.models import NiftiVBMDataset, VBMTrainer, fit_volume
+
+
+# ------------------------------------------------------------------ reader
+@pytest.mark.parametrize("dtype", [np.float32, np.int16, np.uint8, np.float64])
+@pytest.mark.parametrize("gz", [False, True])
+def test_nifti_roundtrip(tmp_path, dtype, gz):
+    rng = np.random.default_rng(0)
+    arr = (rng.normal(size=(5, 7, 3)) * 50).astype(dtype)
+    p = str(tmp_path / ("v.nii.gz" if gz else "v.nii"))
+    save_nifti(p, arr)
+    back = load_nifti(p)
+    np.testing.assert_array_equal(back, arr.astype(back.dtype))
+
+
+def test_nifti_scl_slope_applied(tmp_path):
+    """Header scl_slope/scl_inter scaling must apply (quantized int16
+    volumes are common in the wild)."""
+    arr = np.arange(24, dtype=np.int16).reshape(2, 3, 4)
+    p = str(tmp_path / "scaled.nii")
+    save_nifti(p, arr)
+    raw = bytearray(open(p, "rb").read())
+    struct.pack_into("<2f", raw, 112, 0.5, 10.0)  # slope, inter
+    open(p, "wb").write(bytes(raw))
+    back = load_nifti(p)
+    np.testing.assert_allclose(back, arr * 0.5 + 10.0, atol=1e-5)
+
+
+def test_nifti_big_endian(tmp_path):
+    """Endianness comes from sizeof_hdr's byte order, not assumed."""
+    arr = np.arange(8, dtype=np.int16).reshape(2, 2, 2)
+    hdr = bytearray(348)
+    struct.pack_into(">i", hdr, 0, 348)
+    struct.pack_into(">8h", hdr, 40, 3, 2, 2, 2, 1, 1, 1, 1)
+    struct.pack_into(">h", hdr, 70, 4)  # int16
+    struct.pack_into(">h", hdr, 72, 16)
+    struct.pack_into(">f", hdr, 108, 352.0)
+    struct.pack_into(">2f", hdr, 112, 1.0, 0.0)
+    hdr[344:348] = b"n+1\x00"
+    p = str(tmp_path / "be.nii")
+    payload = bytes(hdr) + b"\x00" * 4 + arr.astype(">i2").tobytes(order="F")
+    open(p, "wb").write(payload)
+    np.testing.assert_array_equal(load_nifti(p), arr)
+
+
+def test_nifti_fortran_order(tmp_path):
+    """NIfTI voxel data is column-major on disk; an asymmetric volume
+    catches any C-order confusion."""
+    arr = np.arange(2 * 3 * 4, dtype=np.float32).reshape(2, 3, 4)
+    p = str(tmp_path / "f.nii")
+    save_nifti(p, arr)
+    np.testing.assert_array_equal(load_nifti(p), arr)
+
+
+def test_nifti_clear_errors(tmp_path):
+    p = str(tmp_path / "junk.nii")
+    open(p, "wb").write(b"\x00" * 400)
+    with pytest.raises(ValueError, match="NIfTI"):
+        load_nifti(p)
+    # right sizeof_hdr, wrong magic (e.g. an ANALYZE pair's .hdr)
+    hdr = bytearray(400)
+    struct.pack_into("<i", hdr, 0, 348)
+    p2 = str(tmp_path / "pair.nii")
+    open(p2, "wb").write(bytes(hdr))
+    with pytest.raises(ValueError, match="nibabel"):
+        load_nifti(p2)
+
+
+def test_fit_volume_crop_and_pad():
+    arr = np.arange(4 * 6 * 2, dtype=np.float32).reshape(4, 6, 2)
+    out = fit_volume(arr, (2, 4, 4))
+    assert out.shape == (2, 4, 4)
+    np.testing.assert_array_equal(out[:, :, 1:3], arr[1:3, 1:5, :])
+    assert out[:, :, 0].sum() == 0 and out[:, :, 3].sum() == 0
+
+
+# ----------------------------------------------------------------- dataset
+def _make_site_data(d, n, shape=(10, 12, 9), start=0):
+    rng = np.random.default_rng(start)
+    rows = []
+    for i in range(n):
+        y = (start + i) % 2
+        vol = (rng.normal(loc=0.6 * y, size=shape)).astype(np.float32)
+        name = f"subj_{start + i}.nii.gz"
+        save_nifti(os.path.join(d, name), vol)
+        rows.append(f"{name},{y}")
+    # a stray unlabeled file must be skipped, not crash the fold
+    save_nifti(os.path.join(d, "stray.nii.gz"),
+               np.zeros(shape, np.float32))
+    with open(os.path.join(d, "labels.csv"), "w") as f:
+        f.write("filename,label\n" + "\n".join(rows) + "\n")
+
+
+def test_nifti_vbm_engine_run(tmp_path):
+    """Two-site federated run training on real .nii.gz files end-to-end:
+    load_index label filtering, header parsing, crop/pad to the static
+    grid, z-scoring, splits, loaders with device prefetch, SUCCESS."""
+    from coinstac_dinunet_tpu.engine import InProcessEngine
+
+    eng = InProcessEngine(
+        tmp_path, n_sites=2, trainer_cls=VBMTrainer,
+        dataset_cls=NiftiVBMDataset, task_id="vbm_nii", data_dir="data",
+        split_ratio=[0.7, 0.15, 0.15], batch_size=4, epochs=2,
+        learning_rate=1e-3, input_shape=(8, 8, 8), model_width=4,
+        num_classes=2, seed=5, verbose=False,
+    )
+    for i, s in enumerate(eng.site_ids):
+        _make_site_data(eng.site_data_dir(s), 12, start=i * 12)
+    eng.run(max_rounds=400)
+    assert eng.success, f"no SUCCESS after {eng.rounds} rounds"
+
+
+def test_nifti_dataset_getitem(tmp_path):
+    d = tmp_path / "data"; d.mkdir()
+    _make_site_data(str(d), 4)
+    ds = NiftiVBMDataset()
+    cache = {"input_shape": (8, 8, 8), "data_dir": "data"}
+    state = {"baseDirectory": str(tmp_path), "clientId": "s"}
+    files = sorted(os.listdir(d))
+    ds.add(files, cache=cache, state=state)
+    assert len(ds) == 4  # stray + labels.csv skipped
+    item = ds[0]
+    assert item["inputs"].shape == (8, 8, 8)
+    assert abs(float(item["inputs"].mean())) < 1e-4  # z-scored
+    assert item["labels"] in (0, 1)
+
+
+def test_fit_volume_rejects_wrong_ndim():
+    """A 4-D volume against a 3-D grid must fail with a dimensionality
+    message, not a cryptic broadcast error mid-fold."""
+    with pytest.raises(ValueError, match="4-D"):
+        fit_volume(np.zeros((4, 4, 4, 7), np.float32), (4, 4, 4))

@@ -616,3 +616,47 @@ def test_divergent_per_param_steps_fall_back_fresh(tmp_path):
                for m in moments
                if hasattr(m, "shape") and np.asarray(m).ndim > 0)
     assert int(t.train_state.step) == 0
+
+
+def test_stateless_params_graft_with_zero_moments(tmp_path):
+    """A tracked param with NO torch state entry (frozen backbone, layer
+    added just before saving) must not discard the whole optimizer import:
+    the stepped params keep their moments, the stateless one gets zero
+    moments, and the step divergence refusal stays reserved for STEPPED
+    params that disagree."""
+    import optax
+
+    net = _torch_mlp(seed=47)
+    opt = torch.optim.Adam(net.parameters(), lr=1e-2)
+    xb = torch.from_numpy(
+        np.random.default_rng(11).normal(size=(4, 66)).astype(np.float32))
+    for _ in range(4):
+        opt.zero_grad(); net(xb).pow(2).sum().backward(); opt.step()
+    sd = opt.state_dict()
+    del sd["state"][0]  # param 0: tracked in param_groups, no state
+    ckpt = tmp_path / "frozen.tar"
+    torch.save({"source": "coinstac",
+                "models": {"fsv_net": net.state_dict()},
+                "optimizers": {"fsv_net": sd}}, str(ckpt))
+    t = _fsv_trainer(tmp_path).init_nn()
+    t.load_checkpoint(full_path=str(ckpt))
+
+    def find_adam(node):
+        if isinstance(node, optax.ScaleByAdamState):
+            return node
+        if isinstance(node, tuple):
+            for x in node:
+                r = find_adam(x)
+                if r is not None:
+                    return r
+        return None
+
+    st = find_adam(t.train_state.opt_state["fsv_net"])
+    assert st is not None and int(st.count) == 4
+    # param 0 (first Dense kernel): zero moments
+    mu0 = np.asarray(st.mu["params"]["Dense_0"]["kernel"])
+    assert float(np.abs(mu0).max()) == 0.0
+    # a stepped param kept its moments
+    mu_last = np.asarray(list(st.mu["params"].values())[-1]["kernel"])
+    assert float(np.abs(mu_last).max()) > 0.0
+    assert int(t.train_state.step) == 4
