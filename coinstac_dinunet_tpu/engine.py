@@ -130,6 +130,7 @@ class InProcessEngine:
         self.success = False
         self.last_remote_out = {}
         self.dead_sites = set()
+        self.site_failures = {}
         # seed the quorum roster with the FULL consortium: a site dying in
         # round 0 must be judged (and recorded) against the original
         # n_sites, not silently absorbed into a shrunken roster
@@ -173,8 +174,9 @@ class InProcessEngine:
         if not self._quorum_configured():
             raise exc
         self.dead_sites.add(s)
+        self.site_failures[s] = f"{type(exc).__name__}: {exc}"
         logger.warn(
-            f"site {s} died mid-run ({type(exc).__name__}: {exc}); "
+            f"site {s} died mid-run ({self.site_failures[s]}); "
             "excluded from the remaining rounds (site_quorum set)"
         )
 
@@ -209,7 +211,10 @@ class InProcessEngine:
             site_outs[s] = result["output"]
 
         if not site_outs:
-            raise RuntimeError("every site died; nothing to aggregate")
+            raise RuntimeError(
+                "every site died; nothing to aggregate — failures: "
+                f"{self.site_failures}"
+            )
         remote = COINNRemote(
             cache=self.remote_cache, input=site_outs, state=self.remote_state
         )
@@ -333,7 +338,10 @@ class SubprocessEngine(InProcessEngine):
             site_outs[s] = res["output"]
 
         if not site_outs:
-            raise RuntimeError("every site died; nothing to aggregate")
+            raise RuntimeError(
+                "every site died; nothing to aggregate — failures: "
+                f"{self.site_failures}"
+            )
         res = self._invoke(self.remote_script, {
             "cache": self.remote_cache, "input": site_outs,
             "state": self.remote_state,
@@ -800,8 +808,9 @@ class MeshEngine:
             # dump per-subject outputs — host path, exact count merge (≙
             # the engine transport's test_distributed)
             return self._host_test_sparse(handles)
-        if not trainer.new_metrics().jit_safe:
-            return self._host_eval(handles, which)
+        # non-jit-safe metrics (AUC) also run on the mesh: the compiled
+        # step gathers (score, true, mask) across sites and the host
+        # accumulates — no serial per-site fallback (round-4 perf cliff)
         bs = int(self.cache.get("batch_size", 16))
         datasets = {
             s: (handles[s].get_validation_dataset() if which == "validation"
@@ -833,9 +842,20 @@ class MeshEngine:
                     b = dict(template)
                     b["_mask"] = np.zeros_like(np.asarray(template["_mask"]))
                 filled.append(b)
-            m_state, a_state = fed.eval_step(filled)
+            m_state, a_state, hs = fed.eval_step(filled)
             if m_state is not None:
                 metrics.update(m_state)
+            elif hs is not None:
+                metrics.add(
+                    np.asarray(hs["score"]), np.asarray(hs["true"]),
+                    mask=np.asarray(hs["mask"]),
+                )
+            elif not metrics.jit_safe:
+                # non-jit-safe metrics with an iteration that exposes no
+                # pred/true (host_scores_payload returned None): the mesh
+                # path cannot feed them — fall back to the exact per-site
+                # host evaluation rather than return silently-empty metrics
+                return self._host_eval(handles, which)
             averages.update(a_state)
         return averages, metrics
 

@@ -30,7 +30,7 @@ class _ConvBlock(nn.Module):
     features: int
     stride: int = 1
     dtype: jnp.dtype = jnp.bfloat16
-    fused_gn: bool = True
+    fused_gn: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -79,14 +79,17 @@ class VBM3DNet(nn.Module):
     is the benchmark flagship; ``width=32`` fills the MXU's 128 output
     lanes from stage 2 on (higher MFU at more FLOPs/sample — report both,
     docs/PERF.md).  ``fused_gn`` routes every norm through the fused
-    GroupNorm(+ReLU) with the closed-form backward (exact; kill switch
-    ``cache['fused_groupnorm']=False`` / env ``COINN_NO_FUSED_GN``).
+    GroupNorm(+ReLU) with the closed-form backward — exact, but measured
+    SLOWER on-device than XLA's autodiff of flax GroupNorm (it splits
+    fusions with the adjacent convs; round-5 A/B in docs/PERF.md), so it
+    defaults OFF (opt in with ``cache['fused_groupnorm']=True``; env kill
+    switch ``COINN_NO_FUSED_GN`` still forces it off).
     """
 
     num_classes: int = 2
     width: int = 16
     dtype: jnp.dtype = jnp.bfloat16
-    fused_gn: bool = True
+    fused_gn: bool = False
 
     @nn.compact
     def __call__(self, x, train=False, rng=None):
@@ -216,7 +219,7 @@ class VBMTrainer(COINNTrainer):
             num_classes=int(self.cache.get("num_classes", 2)),
             width=int(self.cache.get("model_width", 16)),
             dtype=jnp.dtype(self.cache.setdefault("compute_dtype", "bfloat16")),
-            fused_gn=bool(self.cache.get("fused_groupnorm", True)),
+            fused_gn=bool(self.cache.get("fused_groupnorm", False)),
         )
 
     def example_inputs(self):

@@ -26,7 +26,7 @@ class _ResBlock(nn.Module):
     features: int
     stride: int = 1
     dtype: jnp.dtype = jnp.bfloat16
-    fused_gn: bool = True
+    fused_gn: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -72,7 +72,7 @@ class ResNet18(nn.Module):
     num_classes: int = 2
     width: int = 64
     dtype: jnp.dtype = jnp.bfloat16
-    fused_gn: bool = True
+    fused_gn: bool = False
 
     @nn.compact
     def __call__(self, x, train=False, rng=None):
@@ -118,7 +118,7 @@ class ResNetTrainer(COINNTrainer):
             num_classes=int(self.cache.get("num_classes", 2)),
             width=int(self.cache.get("model_width", 64)),
             dtype=jnp.dtype(self.cache.setdefault("compute_dtype", "bfloat16")),
-            fused_gn=bool(self.cache.get("fused_groupnorm", True)),
+            fused_gn=bool(self.cache.get("fused_groupnorm", False)),
         )
 
     def example_inputs(self):

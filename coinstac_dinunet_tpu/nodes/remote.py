@@ -68,9 +68,21 @@ class COINNRemote:
         roster = self.cache.get("all_sites")
         if not roster:
             return
-        alive = set(self.input.keys())
-        dropped = sorted(set(roster) - alive)
         prev = set(self.cache.get("dropped_sites", []))
+        returned = prev & set(self.input.keys())
+        if returned:
+            # once dropped, a site STAYS dropped: its mid-run state is gone,
+            # so a reappearing process is reporting from a stale model —
+            # aggregating it would silently corrupt the global average
+            logger.warn(
+                f"sites {sorted(returned)} reappeared after being dropped; "
+                "ignoring their output (their round state is stale)"
+            )
+            self.input = utils.FrozenDict({
+                k: v for k, v in self.input.items() if k not in prev
+            })
+        alive = set(self.input.keys())
+        dropped = sorted((set(roster) - alive) | prev)
         if set(dropped) == prev:
             return
         self.cache["dropped_sites"] = dropped

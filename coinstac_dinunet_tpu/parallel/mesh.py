@@ -575,10 +575,25 @@ class MeshFederation:
             m_state, a_state = trainer._step_outputs(
                 it, batch, metrics_shell, averages_shell
             )
+            hs = None
             if m_state is not None:
                 m_state = jax.lax.psum(m_state, aux_axes)
+            elif not getattr(metrics_shell, "jit_safe", True):
+                # non-jit-safe metrics (AUC): gather every site's (score,
+                # true, mask) along the batch axis so the HOST accumulates
+                # while the eval itself stays one compiled mesh step per
+                # batch index — without this, a 32-site AUC run degraded
+                # to a serial per-site host loop (32x the eval wall-clock)
+                hs = trainer.host_scores_payload(it, batch)
+                if hs is not None:
+                    hs = jax.tree_util.tree_map(
+                        lambda x: jax.lax.all_gather(
+                            x, aux_axes, axis=0, tiled=True
+                        ),
+                        hs,
+                    )
             a_state = jax.lax.psum(a_state, aux_axes)
-            return m_state, a_state
+            return m_state, a_state, hs
 
         @jax.jit
         def ev(ts, batch):
@@ -586,14 +601,17 @@ class MeshFederation:
                 site_eval,
                 mesh=mesh,
                 in_specs=(P(), eval_spec),
-                out_specs=(P(), P()),
+                out_specs=(P(), P(), P()),
                 check_vma=False,
             )(ts, batch)
 
         return ev
 
     def eval_step(self, site_batches):
-        """Globally-reduced evaluation over one batch per site."""
+        """Globally-reduced evaluation over one batch per site: returns
+        ``(metrics_state, averages_state, host_scores)`` — metrics_state
+        for jit-safe metrics (host_scores None), or host_scores (gathered
+        score/true/mask arrays) for host-accumulated metrics like AUC."""
         if isinstance(site_batches, (list, tuple)):
             self._sample_batch_keys = tuple(site_batches[0].keys())
             glob = {

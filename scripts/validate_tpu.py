@@ -37,6 +37,8 @@ for flag in sys.argv[3:]:
         cache["cast_inputs"] = False
     elif flag == "nofusedgn":
         cache["fused_groupnorm"] = False
+    elif flag == "fusedgn":
+        cache["fused_groupnorm"] = True
     elif flag.startswith("width"):
         # fast mode scales widths by the same /2 as the base config, so the
         # wider variant stays a DIFFERENT width and the lever is exercised
@@ -139,11 +141,13 @@ def main():
     run("vbm_final", ["vbm", vb])
     run("vbm_no_s2d", ["vbm", vb], no_s2d=True)
     run("vbm_no_cast", ["vbm", vb, "nocast"])
+    # fused GN defaults OFF since the round-5 on-device regression; the
+    # A/B keeps both sides explicit
     run("vbm_no_fused_gn", ["vbm", vb, "nofusedgn"])
+    run("vbm_fused_gn", ["vbm", vb, "fusedgn"])
     # width-32 variant: cout fills the 128 MXU lanes from stage 2 on —
     # report MFU alongside the width-16 flagship (PERF.md MXU-fill lever)
     run("vbm_width32", ["vbm", vb, "width32"])
-    run("vbm_width32_no_fused_gn", ["vbm", vb, "width32", "nofusedgn"])
     # ResNet-18 (config 4): 2-D s2d stem on/off
     run("resnet_final", ["resnet", rb])
     run("resnet_no_s2d", ["resnet", rb], no_s2d=True)

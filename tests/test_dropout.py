@@ -198,3 +198,21 @@ print(json.dumps(node(trainer_cls=FSVTrainer, dataset_cls=DyingFSVDataset)))
     eng.run(max_rounds=200)
     assert eng.success, eng.last_remote_out
     assert eng.dead_sites == {"site_2"}
+
+
+def test_dropped_site_cannot_rejoin():
+    """Once dropped, a site stays dropped: a reappearing process reports
+    from a stale model, so its output is filtered out of aggregation and
+    the drop record is preserved."""
+    from coinstac_dinunet_tpu.nodes.remote import COINNRemote
+
+    cache = {"all_sites": ["site_0", "site_1", "site_2"],
+             "dropped_sites": ["site_2"], "site_quorum": 2}
+    remote = COINNRemote(cache=cache, input={
+        "site_0": {"phase": "computation"},
+        "site_1": {"phase": "computation"},
+        "site_2": {"phase": "computation"},  # zombie reappears
+    }, state={})
+    remote._check_quorum()
+    assert "site_2" not in remote.input  # filtered, not re-aggregated
+    assert cache["dropped_sites"] == ["site_2"]  # record preserved

@@ -327,7 +327,7 @@ def test_mesh_eval_reduces_counts_globally(tmp_path):
         tr.data_handle.get_train_dataset()
         loader = tr.data_handle.get_loader("train", dataset=None, shuffle=False)
         batches.append(loader.batch_at(0))
-    m_state, a_state = fed.eval_step(batches)
+    m_state, a_state, _ = fed.eval_step(batches)
     metrics = sites[0].new_metrics()
     metrics.update(m_state)
     total = sum(float(np.asarray(m_state[k])) for k in ("tp", "fp", "tn", "fn"))
