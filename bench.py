@@ -190,6 +190,11 @@ def _bench_configs(fast, peak):
             if peak:
                 entry["mfu"] = round(tf * 1e12 / peak, 4)
         out[name] = entry
+        # per-config breadcrumb: the relayed tunnel can wedge mid-matrix
+        # (observed round 5) and a hang is uncatchable — completed entries
+        # on stderr are the killed run's only record
+        print(f"# partial {name}: {json.dumps(entry)}", file=sys.stderr,
+              flush=True)
     return out
 
 

@@ -23,6 +23,19 @@ Completes the framework's parallelism matrix (dp / tp / sp / ep live in
 Uses the same ``TSPConfig``/``init_tsp_params`` parameter pytree as
 ``sequence.py`` (dense FFN path), so the two scale-out strategies are
 interchangeable on one checkpoint.
+
+Why pp is NOT a trainer-stack knob like ``sequence_parallel``/
+``tensor_parallel`` (``seq_mesh.py``/``tp_mesh.py``): those integrations
+keep the TrainState full-shape and replicated — the invariant the whole
+federated stack (dSGD/PowerSGD plane, tp/sp-independent checkpoints,
+cross-site lockstep) is built on — because sp shards activations by
+TIME and tp shards COMPUTE, neither needing sharded parameter storage.
+Pipelining's entire value is sharding the LAYER PARAMETERS' memory across
+ranks; a replicated-storage pp would pay the bubble for no memory win.  A
+model too big for one chip's HBM therefore uses this module's explicitly
+sharded step (or the GSPMD path in ``sequence.py``) directly — at that
+scale the per-site training loop IS the pipelined step, and the federated
+layer above it is unchanged.
 """
 import numpy as np
 

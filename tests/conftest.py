@@ -79,6 +79,7 @@ _SLOW_TESTS = frozenset((
     "test_fresh_process_matches_in_process_scores",
     "test_fresh_process_powersgd_mid_protocol",
     "test_two_process_seq_mesh_sp",
+    "test_two_process_tp_mesh",
     "test_seq_example_sim_reaches_success",
     "test_resnet_fused_gn_param_tree_and_function",
     "test_vbm_fused_gn_param_tree_and_function",

@@ -227,43 +227,29 @@ def test_two_process_mesh_rankdad():
     assert marks[0] == marks[1], marks
 
 
-SEQ_WORKER = r"""
-import os, sys
-import jax
-jax.config.update("jax_platforms", "cpu")
-pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
-
-from coinstac_dinunet_tpu.parallel import hosts
-
-hosts.initialize_multihost(f"127.0.0.1:{port}", n, pid)
-
-import numpy as np
+SEQ_TRAINER_SETUP = '''
 from coinstac_dinunet_tpu.models import SeqTrainer
-from coinstac_dinunet_tpu.parallel.seq_mesh import SeqMeshFederation
 
 cache = {"seq_len": 16, "num_features": 8, "num_classes": 2, "d_model": 16,
          "num_heads": 4, "num_layers": 1, "max_len": 32, "batch_size": 4,
          "seed": 0, "learning_rate": 1e-2, "share_compiled": False,
          "local_data_parallel": False}
-tr = SeqTrainer(cache=cache, state={}, data_handle=None)
-tr.init_nn()  # same seed in every process -> identical replicas
-mesh = hosts.host_aligned_site_mesh(n_sites=n)  # (site=n, 2 local devices)
-fed = SeqMeshFederation(tr, n_sites=n, sp=2, devices=mesh.devices.ravel())
-rng = np.random.default_rng(0)  # identical global data in every process
-per_site = [[{"inputs": rng.normal(size=(4, 16, 8)).astype(np.float32),
-              "labels": rng.integers(0, 2, size=4).astype(np.int32),
-              "_mask": np.ones(4, np.float32)}] for _ in range(n)]
-losses = []
-for _ in range(3):
-    aux = fed.train_step(per_site)
-    losses.append(float(np.asarray(jax.device_get(aux["loss"]))))
-assert all(np.isfinite(l) for l in losses), losses
-assert losses[-1] < losses[0], losses
-leaf = jax.tree_util.tree_leaves(tr.train_state.params)[0]
-extra = " p0=%.8f" % float(np.asarray(leaf.addressable_shards[0].data).ravel()[0])
-print(f"WORKER_OK {pid} losses={['%.6f' % l for l in losses]}" + extra,
-      flush=True)
-"""
+cache.update(__CACHE_EXTRA__)
+tr = SeqTrainer(cache=cache, state={}, data_handle=None)'''
+
+SEQ_PER_SITE = (
+    '[[{"inputs": rng.normal(size=(4, 16, 8)).astype(np.float32), '
+    '"labels": rng.integers(0, 2, size=4).astype(np.int32), '
+    '"_mask": np.ones(4, np.float32)}] for _ in range(n)]'
+)
+
+SP_MESH_SETUP = """from coinstac_dinunet_tpu.parallel.seq_mesh import SeqMeshFederation
+mesh = hosts.host_aligned_site_mesh(n_sites=n)
+fed = SeqMeshFederation(tr, n_sites=n, sp=2, devices=mesh.devices.ravel())"""
+
+TP_MESH_SETUP = """from coinstac_dinunet_tpu.parallel.tp_mesh import TPMeshFederation
+mesh = hosts.host_aligned_site_mesh(n_sites=n)
+fed = TPMeshFederation(tr, n_sites=n, tp=2, devices=mesh.devices.ravel())"""
 
 
 def test_two_process_seq_mesh_sp():
@@ -271,5 +257,22 @@ def test_two_process_seq_mesh_sp():
     x sp=2 local devices — ring attention's ppermute hops stay on a host's
     devices while the dSGD site mean crosses the process boundary.  Losses
     fall and replicas stay in lockstep."""
-    marks = _run_two_process_workers(SEQ_WORKER, device_count=2)
+    marks = _run_two_process_workers(
+        _worker(trainer_setup=SEQ_TRAINER_SETUP, per_site=SEQ_PER_SITE,
+                mesh_setup=SP_MESH_SETUP, extra=FED_EXTRA),
+        device_count=2,
+    )
+    assert marks[0] == marks[1], marks
+
+
+def test_two_process_tp_mesh():
+    """Tensor parallelism across OS processes: 2 sites (one per process)
+    x tp=2 local devices — Megatron row-parallel psums stay on a host's
+    devices while the dSGD site mean crosses the process boundary.  Losses
+    fall and replicas stay in lockstep."""
+    marks = _run_two_process_workers(
+        _worker(trainer_setup=SEQ_TRAINER_SETUP, per_site=SEQ_PER_SITE,
+                mesh_setup=TP_MESH_SETUP, extra=FED_EXTRA),
+        device_count=2,
+    )
     assert marks[0] == marks[1], marks
