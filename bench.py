@@ -137,6 +137,14 @@ def _config_matrix(fast):
     base = {"num_classes": 2, "seed": 0, "learning_rate": 1e-3,
             "local_data_parallel": False}
     return [
+        # FLAGSHIP FIRST (BASELINE config 3): tunnel windows can be short
+        # (observed ~12-25 min, round 5) and a wedge mid-matrix keeps only
+        # the configs already breadcrumbed — the headline must not queue
+        # behind the MLP configs
+        ("vbm3d_cnn_8site", VBMTrainer,
+         {**base, "input_shape": vbm_shape, "model_width": 8 if fast else 16,
+          "batch_size": vbm_batch, "compute_dtype": "bfloat16"},
+         lambda: _synth_batch(rng, vbm_shape, vbm_batch)),
         # 1. FSV MLP, 1 site, local (PR1 ref config)
         ("fsv_mlp_local", FSVTrainer,
          {**base, "input_size": 66, "batch_size": mlp_batch,
@@ -148,11 +156,6 @@ def _config_matrix(fast):
          {**base, "input_size": 66, "batch_size": mlp_batch,
           "compute_dtype": "float32"},
          lambda: _synth_batch(rng, (66,), mlp_batch)),
-        # 3. VBM 3-D CNN, 8 sites, k-fold CV (flagship)
-        ("vbm3d_cnn_8site", VBMTrainer,
-         {**base, "input_shape": vbm_shape, "model_width": 8 if fast else 16,
-          "batch_size": vbm_batch, "compute_dtype": "bfloat16"},
-         lambda: _synth_batch(rng, vbm_shape, vbm_batch)),
         # 4. ResNet-18 image classification, 16 sites
         ("resnet18_16site", ResNetTrainer,
          {**base, "input_shape": (*img_shape, 3), "model_width": 16 if fast else 64,
