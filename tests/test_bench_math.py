@@ -1,0 +1,53 @@
+"""The bench's derived-comparison math and JSON schema — the driver and
+the north-star judgment consume these fields, so they are pinned here
+(no device needed; bench.py imports jax lazily)."""
+import importlib.util
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(repo, "bench.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    return m
+
+
+def test_v100_leg_derivation(bench):
+    v = bench._v100_leg(3.06e9)
+    assert v["status"] == "derived"
+    # fp32 leg: 15.7 TFLOPS x 50% / 3.06 GFLOP per sample
+    assert abs(v["fp32_ref_path_samples_per_sec"] - 15.7e12 * 0.5 / 3.06e9) < 1
+    assert abs(v["amp_best_case_samples_per_sec"] - 125e12 * 0.25 / 3.06e9) < 1
+    # assumptions are spelled out for the judge/reader
+    assert "fp32" in v["assumptions"] and "amp" in v["assumptions"]
+    assert bench._v100_leg(None) is None
+
+
+def test_north_star_math(bench):
+    v = bench._v100_leg(3.06e9)
+    ns = bench._north_star(13757.0, v, {"2": 0.010, "32": 0.012})
+    assert ns["chips"] == 32
+    # weak-scaling efficiency from the measured round times: t(2)/t(32)
+    assert ns["scaling_efficiency"] == pytest.approx(0.01 / 0.012, abs=1e-3)
+    agg = 13757.0 * 32 * ns["scaling_efficiency"]
+    assert ns["aggregate_samples_per_sec"] == pytest.approx(agg, rel=1e-3)
+    assert ns["x_vs_v100_fp32_ref_path"] == pytest.approx(
+        agg / v["fp32_ref_path_samples_per_sec"], rel=1e-2)
+    assert ns["met_vs_ref_path"] is True
+    assert ns["met_vs_amp_best_case"] is True
+    # no scaling data -> efficiency unmeasured, assumed 1.0 and labeled
+    ns2 = bench._north_star(13757.0, v, None)
+    assert ns2["scaling_efficiency"] is None
+    assert "unmeasured" in ns2["scaling_efficiency_source"]
+    assert bench._north_star(None, v, None) is None
+
+
+def test_flagship_is_first_in_matrix(bench):
+    """Short tunnel windows must measure the headline first."""
+    names = [n for n, *_ in bench._config_matrix(True)]
+    assert names[0] == "vbm3d_cnn_8site"
