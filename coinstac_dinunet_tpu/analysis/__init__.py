@@ -1,0 +1,50 @@
+"""dinulint — AST-based static analyzer for JAX hazards and federated
+protocol conformance.
+
+Rule families (see ``docs/ANALYSIS.md``):
+
+- ``jax-api-drift`` — references to JAX symbols absent/deprecated at the
+  pinned JAX version (the class of bug that broke the seed's 57 tests).
+- ``trace-host-sync`` / ``trace-impure`` / ``trace-py-control`` /
+  ``trace-set-iter`` — host-sync, impurity, Python control flow, and
+  nondeterministic-set hazards inside jit/shard_map-traced functions.
+- ``protocol-conformance`` — producer/consumer agreement of the
+  local↔remote wire keys against the ``config/keys.py`` vocabulary.
+
+CLI::
+
+    python -m coinstac_dinunet_tpu.analysis [paths...] \
+        [--format text|json] [--baseline FILE] [--write-baseline] \
+        [--rules id,id] [--jax-version X.Y.Z] [--list-rules]
+
+Exit status: 0 when no *new* (non-baselined, non-suppressed) findings, 1
+otherwise, 2 on usage errors.  Pure stdlib ``ast`` — never imports JAX.
+"""
+from .core import (  # noqa: F401
+    Finding,
+    Module,
+    ProjectRule,
+    Rule,
+    default_rules,
+    filter_baselined,
+    load_baseline,
+    register_rule,
+    run_lint,
+    write_baseline,
+)
+from .jax_api import JaxApiDriftRule, SYMBOL_TABLE, symbol_status  # noqa: F401
+from .protocol import ProtocolConformanceRule, load_vocabulary  # noqa: F401
+from .trace_hazards import (  # noqa: F401
+    HostSyncRule,
+    ImpureCallRule,
+    PyControlFlowRule,
+    SetIterationRule,
+)
+
+__all__ = [
+    "Finding", "Module", "Rule", "ProjectRule", "register_rule",
+    "default_rules", "run_lint", "load_baseline", "write_baseline",
+    "filter_baselined", "JaxApiDriftRule", "SYMBOL_TABLE", "symbol_status",
+    "ProtocolConformanceRule", "load_vocabulary", "HostSyncRule",
+    "ImpureCallRule", "PyControlFlowRule", "SetIterationRule",
+]

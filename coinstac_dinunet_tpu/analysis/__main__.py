@@ -1,0 +1,116 @@
+"""``python -m coinstac_dinunet_tpu.analysis`` — the dinulint CLI."""
+import argparse
+import json
+import os
+import sys
+
+from .core import (
+    default_rules,
+    filter_baselined,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from .jax_api import JaxApiDriftRule
+
+DEFAULT_BASELINE = "dinulint_baseline.json"
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m coinstac_dinunet_tpu.analysis",
+        description="dinulint: JAX-hazard + federated-protocol static analysis",
+    )
+    p.add_argument("paths", nargs="*", default=["coinstac_dinunet_tpu"],
+                   help="files or directories to lint (default: the package)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: ./{DEFAULT_BASELINE} if present)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline with the current findings and exit 0")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--jax-version", default=None,
+                   help="pin the jax version for jax-api-drift "
+                        "(default: installed jax metadata)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print findings matched by the baseline")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    rules = default_rules()
+    if args.jax_version:
+        rules = [
+            JaxApiDriftRule(jax_version=args.jax_version)
+            if isinstance(r, JaxApiDriftRule) else r
+            for r in rules
+        ]
+    if args.list_rules:
+        for r in sorted(rules, key=lambda r: r.id):
+            print(f"{r.id}: {r.doc}")
+        return 0
+
+    rule_ids = args.rules.split(",") if args.rules else None
+    if rule_ids:
+        known = {r.id for r in rules}
+        unknown = sorted(set(rule_ids) - known)
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+    if args.write_baseline and rule_ids:
+        print("--write-baseline with --rules would drop every other rule's "
+              "baselined findings; refresh over the full rule set instead",
+              file=sys.stderr)
+        return 2
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    findings, errors = run_lint(args.paths, rules=rules, rule_ids=rule_ids)
+
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+
+    if args.write_baseline:
+        out = baseline_path or DEFAULT_BASELINE
+        write_baseline(out, findings)
+        print(f"wrote {len(findings)} finding(s) to {out}")
+        return 0
+
+    baseline_counts = {}
+    if baseline_path and os.path.exists(baseline_path):
+        baseline_counts = load_baseline(baseline_path)
+    new, baselined = filter_baselined(findings, baseline_counts)
+
+    if args.format == "json":
+        payload = {
+            "new": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in baselined],
+            "errors": [{"path": p, "error": e} for p, e in errors],
+        }
+        print(json.dumps(payload, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if args.show_baselined:
+            for f in baselined:
+                print(f"{f.render()} [baselined]")
+        for path, err in errors:
+            print(f"{path}: parse error: {err}", file=sys.stderr)
+        summary = f"{len(new)} new finding(s), {len(baselined)} baselined"
+        if errors:
+            summary += f", {len(errors)} parse error(s)"
+        print(summary)
+
+    return 1 if new or errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

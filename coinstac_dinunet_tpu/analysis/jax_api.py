@@ -1,0 +1,308 @@
+"""jax-api-drift: references to JAX symbols that don't exist at the pinned
+version.
+
+The seed's defining breakage — 9 modules calling ``jax.shard_map`` against
+JAX 0.4.37, where it still lives in ``jax.experimental.shard_map`` — is an
+``AttributeError`` at *trace* time: import succeeds, tests collect, and the
+failure only surfaces when a step function is first built.  This rule makes
+that class of bug a millisecond-scale static finding instead.
+
+The symbol table is declarative: each entry gives the version window in which
+the dotted path exists (``added``/``removed``), an optional ``deprecated``
+bound, and a replacement hint.  The pinned version is read from installed
+package metadata (``importlib.metadata`` — no ``import jax``, keeping the
+linter JAX-free and fast) and can be overridden with ``--jax-version``.
+
+References inside the body of an ``if hasattr(jax, "shard_map"):`` (or any
+guard whose test contains a matching ``hasattr``) are the *sanctioned*
+version-portability idiom — ``utils/jax_compat.py`` is built from them — and
+are never reported.  The guard's ``else:`` branch is exempt wholesale: it
+only runs when the probe failed (the *other* version line), so every jax
+reference there is a deliberate fallback.  The getattr-shim spelling the
+hints recommend, ``getattr(pltpu, "CompilerParams", None) or
+pltpu.TPUCompilerParams``, is likewise sanctioned — operands after a
+jax-rooted ``getattr`` probe in an ``or`` chain only evaluate when the probe
+returned None.
+"""
+import ast
+
+from .core import Finding, Rule, dotted_name, register_rule
+
+#: version -> status windows for drift-prone dotted paths.  ``added``: first
+#: version the name exists at; ``removed``: first version it no longer
+#: exists at; ``deprecated``: first version it warns at.  All bounds
+#: optional.  Verified against 0.4.37 (the pinned toolchain) and the >=0.6
+#: release notes the package targets.
+SYMBOL_TABLE = {
+    "jax.shard_map": {
+        "added": "0.6.0",
+        "hint": "use coinstac_dinunet_tpu.utils.jax_compat.shard_map "
+                "(falls back to jax.experimental.shard_map.shard_map)",
+    },
+    "jax.P": {
+        "added": "0.6.0",
+        "hint": "use jax.sharding.PartitionSpec",
+    },
+    "jax.typeof": {"added": "0.6.0"},
+    "jax.make_mesh": {"added": "0.4.35"},
+    "jax.lax.axis_size": {
+        "added": "0.4.38",
+        "hint": "use coinstac_dinunet_tpu.utils.jax_compat.axis_size "
+                "(lax.psum(1, axis_name) constant-folds to the static size)",
+    },
+    "jax.experimental.pallas.tpu.CompilerParams": {
+        "added": "0.7.0",
+        "hint": "getattr fallback to pltpu.TPUCompilerParams on 0.4.x",
+    },
+    "jax.experimental.pallas.tpu.InterpretParams": {
+        "added": "0.7.0",
+        "hint": "gate the TPU-flavored interpreter on "
+                "hasattr(pltpu, 'InterpretParams')",
+    },
+    "jax.experimental.pallas.tpu.TPUCompilerParams": {
+        "removed": "0.7.0",
+        "hint": "renamed pltpu.CompilerParams in >=0.7; use a getattr shim",
+    },
+    "jax.experimental.shard_map": {
+        "deprecated": "0.6.0",
+        "removed": "0.8.0",
+        "hint": "top-level jax.shard_map from 0.6 "
+                "(coinstac_dinunet_tpu.utils.jax_compat bridges both)",
+    },
+    "jax.tree_map": {
+        "deprecated": "0.4.25",
+        "removed": "0.6.0",
+        "hint": "use jax.tree_util.tree_map (any version) or jax.tree.map",
+    },
+    "jax.tree_leaves": {
+        "deprecated": "0.4.25",
+        "removed": "0.6.0",
+        "hint": "use jax.tree_util.tree_leaves or jax.tree.leaves",
+    },
+    "jax.tree_structure": {
+        "deprecated": "0.4.25",
+        "removed": "0.6.0",
+        "hint": "use jax.tree_util.tree_structure or jax.tree.structure",
+    },
+    "jax.tree_unflatten": {
+        "deprecated": "0.4.25",
+        "removed": "0.6.0",
+        "hint": "use jax.tree_util.tree_unflatten or jax.tree.unflatten",
+    },
+    "jax.tree_transpose": {
+        "deprecated": "0.4.25",
+        "removed": "0.6.0",
+        "hint": "use jax.tree_util.tree_transpose or jax.tree.transpose",
+    },
+    "jax.linear_util": {"removed": "0.4.16"},
+    "jax.abstract_arrays": {"removed": "0.4.14"},
+    "jax.random.KeyArray": {"removed": "0.4.24", "hint": "use jax.Array"},
+    "jax.experimental.maps": {
+        "removed": "0.4.14",
+        "hint": "xmap/Mesh moved; use jax.sharding.Mesh + shard_map",
+    },
+    "jax.experimental.global_device_array": {
+        "removed": "0.4.14",
+        "hint": "use jax.Array",
+    },
+}
+
+DEFAULT_JAX_VERSION = "0.4.37"  # pinned toolchain fallback
+
+
+def parse_version(v):
+    parts = []
+    for tok in str(v).split("."):
+        num = ""
+        for ch in tok:
+            if ch.isdigit():
+                num += ch
+            else:
+                break
+        parts.append(int(num or 0))
+    return tuple((parts + [0, 0, 0])[:3])
+
+
+def installed_jax_version():
+    """Pinned jax version from package metadata (never imports jax)."""
+    try:
+        from importlib.metadata import version
+
+        return version("jax")
+    except Exception:  # noqa: BLE001 — metadata missing in odd installs
+        return DEFAULT_JAX_VERSION
+
+
+def symbol_status(dotted, version):
+    """('missing'|'deprecated'|'ok', entry) for ``dotted`` at ``version``.
+
+    Longest-prefix match, so ``jax.experimental.maps.Mesh`` resolves through
+    the ``jax.experimental.maps`` entry.
+    """
+    v = parse_version(version)
+    probe = dotted
+    while probe:
+        entry = SYMBOL_TABLE.get(probe)
+        if entry is not None:
+            added = entry.get("added")
+            removed = entry.get("removed")
+            deprecated = entry.get("deprecated")
+            if added and v < parse_version(added):
+                return "missing", probe, entry
+            if removed and v >= parse_version(removed):
+                return "missing", probe, entry
+            if deprecated and v >= parse_version(deprecated):
+                return "deprecated", probe, entry
+            return "ok", probe, entry
+        probe = probe.rpartition(".")[0]
+    return "ok", dotted, None
+
+
+@register_rule
+class JaxApiDriftRule(Rule):
+    id = "jax-api-drift"
+    doc = ("References to JAX symbols absent (or deprecated) at the pinned "
+           "JAX version, resolved through a version->symbol table.")
+
+    def __init__(self, jax_version=None):
+        self.jax_version = jax_version or installed_jax_version()
+
+    def _module_aliases(self, tree):
+        """name -> dotted module path for jax-rooted imports."""
+        aliases = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax" or a.name.startswith("jax."):
+                        aliases[a.asname or a.name.split(".")[0]] = (
+                            a.name if a.asname else a.name.split(".")[0]
+                        )
+                        if a.asname:
+                            aliases[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.module == "jax" or node.module.startswith("jax."):
+                    for a in node.names:
+                        aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def _hasattr_guards(self, tree, aliases):
+        """(guarded_dotted, first_line, last_line) exemption spans.
+
+        Three sanctioned version-gating shapes (``guarded_dotted is None``
+        exempts every symbol in the span, a dotted string only that symbol):
+
+        - the body of ``if hasattr(jax, "x"):`` exempts ``jax.x``;
+        - its ``else:`` branch exempts everything — it only runs when the
+          probe failed, i.e. on the complement version line, so any jax
+          reference there is a deliberate old/new-API fallback;
+        - operands after a jax-rooted ``getattr(mod, "x", ...)`` probe in an
+          ``or`` chain exempt everything — they only evaluate when the probe
+          came back falsy.
+        """
+        guards = []
+
+        def jax_base(expr):
+            base = dotted_name(expr)
+            if base is None:
+                return None
+            root = base.split(".", 1)[0]
+            target = aliases.get(root)
+            if target is not None:
+                base = base.replace(root, target, 1)
+            if base == "jax" or base.startswith("jax."):
+                return base
+            return None
+
+        def span(nodes):
+            last = nodes[-1]
+            return nodes[0].lineno, getattr(last, "end_lineno", None) or last.lineno
+
+        def probe(call, func_name):
+            """base dotted path if ``call`` is hasattr/getattr(<jax>, "str")."""
+            if not (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Name)
+                and call.func.id == func_name
+                and len(call.args) >= 2
+                and isinstance(call.args[1], ast.Constant)
+                and isinstance(call.args[1].value, str)
+            ):
+                return None
+            return jax_base(call.args[0])
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.If) and node.body:
+                for call in ast.walk(node.test):
+                    base = probe(call, "hasattr")
+                    if base is None:
+                        continue
+                    guards.append((f"{base}.{call.args[1].value}",
+                                   *span(node.body)))
+                    if node.orelse:
+                        guards.append((None, *span(node.orelse)))
+            elif isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or):
+                for i, value in enumerate(node.values[:-1]):
+                    if probe(value, "getattr") is not None:
+                        guards.append((None, *span(node.values[i + 1:])))
+                        break
+        return guards
+
+    @staticmethod
+    def _guarded(dotted, lineno, guards):
+        return any(
+            (g is None or dotted == g or dotted.startswith(g + "."))
+            and start <= lineno <= end
+            for g, start, end in guards
+        )
+
+    def _check(self, dotted, module, node, findings, seen, guards=()):
+        status, sym, entry = symbol_status(dotted, self.jax_version)
+        if status == "ok" or entry is None:
+            return
+        if self._guarded(dotted, node.lineno, guards):
+            return
+        key = (sym, node.lineno)
+        if key in seen:
+            return
+        seen.add(key)
+        hint = entry.get("hint")
+        if status == "missing":
+            msg = f"{sym} does not exist in jax {self.jax_version}"
+        else:
+            msg = f"{sym} is deprecated in jax {self.jax_version}"
+        if hint:
+            msg += f" — {hint}"
+        findings.append(Finding(
+            rule=self.id, path=module.path, line=node.lineno,
+            col=node.col_offset, message=msg,
+        ))
+
+    def visit_module(self, module):
+        findings, seen = [], set()
+        aliases = self._module_aliases(module.tree)
+        guards = self._hasattr_guards(module.tree, aliases)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module == "jax" or node.module.startswith("jax.")
+            ):
+                for a in node.names:
+                    self._check(f"{node.module}.{a.name}", module, node,
+                                findings, seen, guards)
+                self._check(node.module, module, node, findings, seen, guards)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax" or a.name.startswith("jax."):
+                        self._check(a.name, module, node, findings, seen,
+                                    guards)
+            elif isinstance(node, ast.Attribute):
+                dotted = dotted_name(node)
+                if dotted is None:
+                    continue
+                root = dotted.split(".", 1)[0]
+                target = aliases.get(root)
+                if target is None:
+                    continue
+                resolved = dotted.replace(root, target, 1)
+                if resolved == "jax" or resolved.startswith("jax."):
+                    self._check(resolved, module, node, findings, seen, guards)
+        return findings

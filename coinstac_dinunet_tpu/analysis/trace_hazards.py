@@ -1,0 +1,348 @@
+"""trace-hazards: host code that breaks (or silently de-optimizes) inside a
+traced JAX computation.
+
+A "traced context" here is any function that JAX will trace to jaxpr:
+
+- decorated with ``jit``/``jax.jit``/``partial(jax.jit, ...)`` (same for
+  ``pmap``/``vmap``/``grad``/``value_and_grad``/``checkpoint``/``remat``/
+  ``custom_vjp``),
+- passed as the first argument to a ``jit(...)``/``shard_map(...)``/
+  ``pallas_call(...)`` call or to ``lax.scan``/``lax.cond``/
+  ``lax.while_loop``,
+- or named like a step builder's inner function (``_build_*_step`` style:
+  any ``def`` whose name ends with ``_step``/``_kernel`` defined inside a
+  function whose name starts with ``_build_``).
+
+Within such functions the rules flag:
+
+- ``trace-host-sync`` — ``.item()``, ``float()``/``int()``/``bool()`` on
+  non-literal arguments, ``np.asarray``/``np.array`` on traced values: each
+  forces a device→host readback (or a ConcretizationTypeError under jit).
+- ``trace-impure`` — ``time.time()``/``perf_counter()``, ``datetime.now()``,
+  ``np.random.*``/``random.*``/``os.urandom``: baked in as compile-time
+  constants, so every call after the first reuses the first call's value.
+- ``trace-py-control`` — Python ``if``/``while`` on an expression derived
+  from the traced function's array arguments (shape/dtype/``is None``/
+  ``isinstance`` tests are static and exempt).
+- ``trace-set-iter`` — iterating a ``set`` literal/call to build a
+  list/dict/pytree: set order varies across processes, so the resulting
+  pytree structure (and therefore the compiled program) diverges between
+  hosts of the same multi-controller run.
+"""
+import ast
+
+from .core import Finding, Rule, dotted_name, register_rule
+
+_TRACER_NAMES = {
+    "jit", "pmap", "vmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "custom_vjp", "custom_jvp", "shard_map", "pallas_call",
+}
+_CALLABLE_TAKING = {
+    "jit", "pmap", "vmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "shard_map", "pallas_call", "scan", "cond", "while_loop", "fori_loop",
+    "custom_vjp", "custom_jvp", "map", "associative_scan",
+}
+_IMPURE_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "datetime.now", "datetime.datetime.now", "datetime.utcnow",
+    "os.urandom", "random.random", "random.randint", "random.uniform",
+    "random.choice", "random.shuffle", "random.seed",
+}
+_IMPURE_PREFIXES = ("np.random.", "numpy.random.")
+_HOST_CASTS = {"float", "int", "bool"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _callable_name(node):
+    """Dotted-ish display name of a Call's func; '' when unresolvable."""
+    return dotted_name(node, require_name_root=False)
+
+
+def _is_tracer_call(call):
+    """True for jit(...)/jax.jit(...)/partial(jax.jit, ...) etc."""
+    name = _callable_name(call.func)
+    last = name.rsplit(".", 1)[-1] if name else ""
+    if last in _TRACER_NAMES:
+        return True
+    if last == "partial" and call.args:
+        inner = _callable_name(call.args[0])
+        if inner and inner.rsplit(".", 1)[-1] in _TRACER_NAMES:
+            return True
+    return False
+
+
+def _positional_params(fn):
+    return [a.arg for a in list(fn.args.posonlyargs) + list(fn.args.args)]
+
+
+def _static_param_names(fn, call):
+    """Parameters pinned static via ``static_argnames``/``static_argnums`` on
+    a tracer call (``@partial(jax.jit, static_argnames=...)`` or
+    ``jit(fn, static_argnums=...)``) — those stay Python values, never
+    tracers, so hazards keyed on them are false alarms."""
+    names = set()
+    if not isinstance(call, ast.Call):
+        return names
+    pos = _positional_params(fn)
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    names.add(sub.value)
+        elif kw.arg == "static_argnums":
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, int):
+                    if 0 <= sub.value < len(pos):
+                        names.add(pos[sub.value])
+    return names
+
+
+def _collect_traced_functions(tree):
+    """All FunctionDef nodes JAX will trace → (reason, static param names)."""
+    traced = {}  # node -> (reason, static_names)
+
+    # decorators
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            name = _callable_name(dec.func if isinstance(dec, ast.Call) else dec)
+            last = name.rsplit(".", 1)[-1] if name else ""
+            if last in _TRACER_NAMES or (
+                isinstance(dec, ast.Call) and _is_tracer_call(dec)
+            ):
+                traced[node] = (
+                    f"@{name or last}", _static_param_names(node, dec)
+                )
+
+    # defs referenced as the traced callee of jit/shard_map/scan/... calls
+    local_defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local_defs.setdefault(node.name, node)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _callable_name(node.func)
+        last = name.rsplit(".", 1)[-1] if name else ""
+        if last not in _CALLABLE_TAKING:
+            continue
+        # jax.tree_util.map etc. are not tracers; require lax.map/jax-ish root
+        if last == "map" and not name.startswith(("lax.", "jax.lax.")):
+            continue
+        for arg in node.args[:1]:
+            target = None
+            if isinstance(arg, ast.Name):
+                target = local_defs.get(arg.id)
+            elif isinstance(arg, ast.Call) and _callable_name(arg.func).endswith("partial"):
+                if arg.args and isinstance(arg.args[0], ast.Name):
+                    target = local_defs.get(arg.args[0].id)
+            if target is not None and target not in traced:
+                traced[target] = (
+                    f"passed to {name}()", _static_param_names(target, node)
+                )
+
+    # step-builder idiom: inner *_step/*_kernel defs of a _build_* function
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and (
+            node.name.startswith("_build_") or node.name.endswith("_kernel")
+        ):
+            for inner in ast.walk(node):
+                if (
+                    isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and inner is not node
+                    and (inner.name.endswith("_step") or inner.name.endswith("_kernel"))
+                    and inner not in traced
+                ):
+                    traced[inner] = (f"inner step of {node.name}", set())
+            if node.name.endswith("_kernel") and node not in traced:
+                traced[node] = ("kernel naming convention", set())
+    return traced
+
+
+def _array_params(fn):
+    """Parameter names likely bound to traced arrays (all of them, minus
+    obvious non-array conventions)."""
+    skip = {"self", "cls"}
+    names = set()
+    args = fn.args
+    for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+        if a.arg not in skip:
+            names.add(a.arg)
+    return names
+
+
+def _test_is_static(test):
+    """True for tests that stay Python-static under tracing.  Boolean
+    combinations are static only when EVERY operand is — ``x is None or
+    x.sum() > 0`` still concretizes the traced half."""
+    if isinstance(test, ast.BoolOp):
+        return all(_test_is_static(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _test_is_static(test.operand)
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare):
+            comps = [node.left] + list(node.comparators)
+            if any(isinstance(c, ast.Constant) and c.value is None for c in comps):
+                return True
+        if isinstance(node, ast.Call):
+            name = _callable_name(node.func)
+            if name.rsplit(".", 1)[-1] in {"isinstance", "len", "hasattr", "callable"}:
+                return True
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return True
+    return False
+
+
+class _TracedBodyChecker(ast.NodeVisitor):
+    def __init__(self, rule_host, rule_impure, rule_ctl, rule_set,
+                 module, fn, reason, static_names=()):
+        self.rh, self.ri, self.rc, self.rs = (
+            rule_host, rule_impure, rule_ctl, rule_set
+        )
+        self.module = module
+        self.fn = fn
+        self.reason = reason
+        self.params = _array_params(fn) - set(static_names)
+        self.findings = []
+
+    def _emit(self, rule, node, message):
+        if rule is None:
+            return
+        self.findings.append(Finding(
+            rule=rule, path=self.module.path, line=node.lineno,
+            col=node.col_offset,
+            message=f"{message} (inside traced `{self.fn.name}`, {self.reason})",
+        ))
+
+    # nested defs are their own traced (or host) contexts — don't descend
+    def visit_FunctionDef(self, node):
+        if node is self.fn:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        name = _callable_name(node.func)
+        last = name.rsplit(".", 1)[-1] if name else ""
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            self._emit(self.rh, node, "`.item()` forces a device->host sync")
+        elif last in _HOST_CASTS and node.args and not isinstance(
+            node.args[0], ast.Constant
+        ) and isinstance(node.func, ast.Name):
+            self._emit(
+                self.rh, node,
+                f"`{last}()` on a traced value concretizes it on host",
+            )
+        elif name in ("np.asarray", "np.array", "numpy.asarray", "numpy.array"):
+            self._emit(
+                self.rh, node,
+                f"`{name}()` pulls a traced value to host memory",
+            )
+        elif name in _IMPURE_CALLS or name.startswith(_IMPURE_PREFIXES):
+            self._emit(
+                self.ri, node,
+                f"`{name}()` is baked in as a compile-time constant under "
+                "tracing (stale on every later call)",
+            )
+        self.generic_visit(node)
+
+    def _check_test(self, node, kind):
+        if _test_is_static(node.test):
+            return
+        names = {
+            n.id for n in ast.walk(node.test) if isinstance(n, ast.Name)
+        }
+        hit = sorted(names & self.params)
+        if hit:
+            self._emit(
+                self.rc, node,
+                f"Python `{kind}` on `{', '.join(hit)}` — traced values make "
+                "this a ConcretizationTypeError; use lax.cond/lax.while_loop "
+                "or jnp.where",
+            )
+
+    def visit_If(self, node):
+        self._check_test(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_test(node, "while")
+        self.generic_visit(node)
+
+    def _check_iter(self, iter_node):
+        is_set = isinstance(iter_node, ast.Set) or (
+            isinstance(iter_node, ast.Call)
+            and _callable_name(iter_node.func).rsplit(".", 1)[-1] == "set"
+        )
+        if is_set:
+            self._emit(
+                self.rs, iter_node,
+                "iterating a `set` under tracing: ordering varies across "
+                "processes, so pytree/program structure diverges between "
+                "hosts — sort it first",
+            )
+
+    def visit_For(self, node):
+        self._check_iter(node.iter)
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node):
+        # comprehension generators (list/set/dict/genexp) land here via
+        # generic_visit; the node itself carries no position — use the iter's
+        self._check_iter(node.iter)
+        self.generic_visit(node)
+
+
+class _TraceHazardBase(Rule):
+    """Shared machinery; subclasses pick which finding family they own."""
+
+    family = None  # 'host' | 'impure' | 'ctl' | 'set'
+
+    def visit_module(self, module):
+        findings = []
+        traced = _collect_traced_functions(module.tree)
+        for fn, (reason, static_names) in traced.items():
+            checker = _TracedBodyChecker(
+                rule_host=self.id if self.family == "host" else None,
+                rule_impure=self.id if self.family == "impure" else None,
+                rule_ctl=self.id if self.family == "ctl" else None,
+                rule_set=self.id if self.family == "set" else None,
+                module=module, fn=fn, reason=reason,
+                static_names=static_names,
+            )
+            checker.visit(fn)
+            findings.extend(checker.findings)
+        return findings
+
+
+@register_rule
+class HostSyncRule(_TraceHazardBase):
+    id = "trace-host-sync"
+    doc = (".item()/float()/np.asarray on traced values — device->host "
+           "syncs or ConcretizationTypeErrors inside jit/shard_map bodies.")
+    family = "host"
+
+
+@register_rule
+class ImpureCallRule(_TraceHazardBase):
+    id = "trace-impure"
+    doc = ("time.time()/fresh-PRNG calls inside traced functions are frozen "
+           "at compile time.")
+    family = "impure"
+
+
+@register_rule
+class PyControlFlowRule(_TraceHazardBase):
+    id = "trace-py-control"
+    doc = ("Python if/while on traced array arguments inside jit/shard_map "
+           "bodies.")
+    family = "ctl"
+
+
+@register_rule
+class SetIterationRule(_TraceHazardBase):
+    id = "trace-set-iter"
+    doc = ("set iteration feeding pytree construction under tracing — "
+           "cross-host nondeterminism.")
+    family = "set"

@@ -54,6 +54,65 @@ class Key(_StrEnum):
     DATA_CURSOR = "data_cursor"
 
 
+class LocalWire(_StrEnum):
+    """Message keys a SITE writes into its round output (site → aggregator).
+
+    This enum (with :class:`RemoteWire`) is the single source of truth for
+    the local↔remote JSON handshake: the ``protocol-conformance`` rule of
+    :mod:`coinstac_dinunet_tpu.analysis` statically cross-checks every key
+    produced by ``nodes/local.py`` (and the learner modules it delegates to)
+    against the keys consumed by ``nodes/remote.py``/the reducers — and both
+    against this vocabulary.  Adding a wire key without declaring it here is
+    a lint error (``proto-undeclared``).
+    """
+    PHASE = "phase"
+    MODE = "mode"
+    DATA_SIZE = "data_size"
+    SHARED_ARGS = "shared_args"
+    WEIGHTS_FILE = "weights_file"
+    REDUCE = "reduce"
+    GRADS_FILE = "grads_file"
+    GRAD_WEIGHT = "grad_weight"
+    TRAIN_SERIALIZABLE = "train_serializable"
+    VALIDATION_SERIALIZABLE = "validation_serializable"
+    TEST_SERIALIZABLE = "test_serializable"
+    # powerSGD two-invocation sync (P then Q) — see parallel/powersgd.py
+    POWERSGD_PHASE = "powerSGD_phase"
+    POWERSGD_P_FILE = "powerSGD_P_file"
+    POWERSGD_Q_FILE = "powerSGD_Q_file"
+    RANK1_FILE = "rank1_file"
+    # rankDAD compressed activation/delta payloads — see parallel/rankdad.py
+    DAD_DATA_FILE = "dad_data_file"
+    DAD_REST_FILE = "dad_rest_file"
+
+
+class RemoteWire(_StrEnum):
+    """Message keys the AGGREGATOR writes into its round output
+    (aggregator → every site).  See :class:`LocalWire` for the conformance
+    contract."""
+    PHASE = "phase"
+    GLOBAL_MODES = "global_modes"
+    GLOBAL_RUNS = "global_runs"
+    SAVE_CURRENT_AS_BEST = "save_current_as_best"
+    PRETRAINED_WEIGHTS = "pretrained_weights"
+    RESULTS_ZIP = "results_zip"
+    UPDATE = "update"
+    AVG_GRADS_FILE = "avg_grads_file"
+    POWERSGD_PHASE = "powerSGD_phase"
+    POWERSGD_P_FILE = "powerSGD_P_file"
+    POWERSGD_Q_FILE = "powerSGD_Q_file"
+    RANK1_FILE = "rank1_file"
+    DAD_DATA_FILE = "dad_data_file"
+    DAD_REST_FILE = "dad_rest_file"
+
+
+# Keys a node reads from ``input`` that the ENGINE/compspec injects on the
+# first invocation (not part of the local↔remote handshake); the
+# protocol-conformance rule treats reads of these as engine-provided rather
+# than consumed-but-never-produced.
+ENGINE_PROVIDED_KEYS = ("task_id", "data_conf")
+
+
 class AggEngine(_StrEnum):
     """Built-in gradient-aggregation engines (≙ AGG_Engine dSGD/powerSGD/rankDAD)."""
     DSGD = "dSGD"

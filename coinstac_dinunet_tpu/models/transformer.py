@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..data import COINNDataset
+from ..utils.jax_compat import axis_size
 from ..metrics import classification_outputs
 from ..ops import flash_attention
 from ..trainer import COINNTrainer
@@ -63,7 +64,7 @@ class TPDense(nn.Module):
     @nn.compact
     def __call__(self, x):
         d_local = x.shape[-1]
-        n = lax.axis_size(self.tp_axis) if self.tp_axis else 1
+        n = axis_size(self.tp_axis) if self.tp_axis else 1
         # row mode sees a feature-sharded input: the stored kernel is the
         # full (d_global, features) matrix
         d_in = d_local * n if (self.tp_axis and self.mode == "row") else d_local
@@ -134,7 +135,7 @@ class MultiHeadSelfAttention(nn.Module):
         hd = d // self.num_heads
         heads = self.num_heads
         if self.tp_axis:
-            n = lax.axis_size(self.tp_axis)
+            n = axis_size(self.tp_axis)
             assert heads % n == 0, "tp must divide num_heads"
             heads = heads // n
         # qkv groups=3: each of q/k/v slices by this rank's head block.
@@ -232,7 +233,7 @@ class SeqClassifier(nn.Module):
             # unsharded path's pos[:t] shape error would — dynamic_slice
             # would otherwise CLAMP the out-of-range offset and silently
             # reuse block-0 positions
-            t_global = t * lax.axis_size(self.sp_axis)
+            t_global = t * axis_size(self.sp_axis)
             if t_global > self.max_len:
                 raise ValueError(
                     f"global sequence length {t_global} exceeds max_len "
@@ -251,7 +252,7 @@ class SeqClassifier(nn.Module):
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.sp_axis:
-            t_global = t * lax.axis_size(self.sp_axis)
+            t_global = t * axis_size(self.sp_axis)
             pooled = lax.psum(jnp.sum(x, axis=1), self.sp_axis) / t_global
         else:
             pooled = jnp.mean(x, axis=1)

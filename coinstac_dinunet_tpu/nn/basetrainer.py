@@ -34,6 +34,7 @@ from .. import config
 from ..config.keys import Key, Mode
 from ..metrics import COINNAverages, Prf1a
 from ..utils import atomic_write, logger
+from ..utils.jax_compat import shard_map
 from ..utils.utils import performance_improved_, stop_training_
 
 CHECKPOINT_SOURCE = "coinstac-dinunet-tpu"
@@ -674,7 +675,7 @@ class NNTrainer:
             return ts, aux
 
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 shard_step, mesh=self._dp_mesh(n),
                 in_specs=(P(), P(None, "device")), out_specs=(P(), P()),
                 check_vma=False,
@@ -870,7 +871,7 @@ class NNTrainer:
                 return m_state, a_state, out_it
 
             fn = self._compiled[("eval_dp", n)] = jax.jit(
-                jax.shard_map(
+                shard_map(
                     shard_eval, mesh=self._dp_mesh(n),
                     in_specs=(P(), P("device")), out_specs=(P(), P(), P()),
                     check_vma=False,

@@ -19,7 +19,7 @@ import time
 import traceback
 
 from .. import config, utils
-from ..config.keys import AggEngine, Key, Mode, Phase
+from ..config.keys import AggEngine, Key, LocalWire, Mode, Phase, RemoteWire
 from ..data import COINNDataHandle
 from ..parallel import COINNLearner, DADLearner, PowerSGDLearner
 from ..utils import logger
@@ -114,15 +114,15 @@ class COINNLocal:
         out = {}
         trainer.data_handle.prepare_data()
         self.cache["num_folds"] = len(self.cache["splits"])
-        out["data_size"] = {}
+        out[LocalWire.DATA_SIZE.value] = {}
         for k, sp in self.cache["splits"].items():
             with open(os.path.join(self.cache["split_dir"], sp)) as f:
                 split = json.load(f)
-            out["data_size"][k] = {key: len(split.get(key, [])) for key in split}
+            out[LocalWire.DATA_SIZE.value][k] = {key: len(split.get(key, [])) for key in split}
         frozen = {k: self.cache.get(k) for k in self._args}
         frozen["num_folds"] = self.cache["num_folds"]
         self.cache["frozen_args"] = frozen
-        out["shared_args"] = utils.clean_recursive(frozen)
+        out[LocalWire.SHARED_ARGS.value] = utils.clean_recursive(frozen)
         return out
 
     def _next_run(self, trainer):
@@ -143,17 +143,17 @@ class COINNLocal:
         self.cache["best_nn_state"] = f"best.{tag}.ckpt"
         self.cache["latest_nn_state"] = f"latest.{tag}.ckpt"
         trainer.init_nn()
-        out["phase"] = Phase.COMPUTATION.value
+        out[LocalWire.PHASE.value] = Phase.COMPUTATION.value
         return out
 
     def _pretrain_local(self, trainer):
         """Designated site trains locally and ships its best weights
         (≙ ref ``local.py:152-170``)."""
-        out = {"phase": Phase.COMPUTATION.value}
+        out = {LocalWire.PHASE.value: Phase.COMPUTATION.value}
         pretrain_args = self.cache.get("pretrain_args") or {}
         epochs = int(pretrain_args.get("epochs", 0))
         any_pretrains = epochs > 0 and any(
-            r.get("pretrain") for r in self.input.get("global_runs", {}).values()
+            r.get("pretrain") for r in self.input.get(RemoteWire.GLOBAL_RUNS.value, {}).values()
         )
         if epochs > 0 and self.cache.get("pretrain"):
             saved = {
@@ -168,10 +168,10 @@ class COINNLocal:
             self.cache.update({k: v for k, v in saved.items() if v is not None})
             # advertise the shipped best weights so the aggregator broadcasts
             if self.cache.get("weights_file"):
-                out["weights_file"] = self.cache["weights_file"]
-            out["phase"] = Phase.PRE_COMPUTATION.value
+                out[LocalWire.WEIGHTS_FILE.value] = self.cache["weights_file"]
+            out[LocalWire.PHASE.value] = Phase.PRE_COMPUTATION.value
         if any_pretrains:
-            out["phase"] = Phase.PRE_COMPUTATION.value
+            out[LocalWire.PHASE.value] = Phase.PRE_COMPUTATION.value
         return out
 
     # ----------------------------------------- fresh-process round survival
@@ -349,25 +349,25 @@ class COINNLocal:
             ),
         )
 
-        self.out["phase"] = self.input.get("phase", Phase.INIT_RUNS.value)
-        if self.out["phase"] == Phase.INIT_RUNS.value:
+        self.out[LocalWire.PHASE.value] = self.input.get(RemoteWire.PHASE.value, Phase.INIT_RUNS.value)
+        if self.out[LocalWire.PHASE.value] == Phase.INIT_RUNS.value:
             self.out.update(**self._init_runs(trainer))
 
-        elif self.out["phase"] == Phase.NEXT_RUN.value:
+        elif self.out[LocalWire.PHASE.value] == Phase.NEXT_RUN.value:
             self.cache.update(
-                **self.input["global_runs"][self.state.get("clientId", "site")]
+                **self.input[RemoteWire.GLOBAL_RUNS.value][self.state.get("clientId", "site")]
             )
             self.out.update(**self._next_run(trainer))
             if self.cache.get("mode") == Mode.TRAIN.value:
                 self.out.update(**self._pretrain_local(trainer))
 
-        elif self.out["phase"] == Phase.PRE_COMPUTATION.value:
-            if self.input.get("pretrained_weights"):
+        elif self.out[LocalWire.PHASE.value] == Phase.PRE_COMPUTATION.value:
+            if self.input.get(RemoteWire.PRETRAINED_WEIGHTS.value):
                 trainer.init_nn()
                 trainer.load_checkpoint(
                     full_path=os.path.join(
                         self.state.get("baseDirectory", "."),
-                        self.input["pretrained_weights"],
+                        self.input[RemoteWire.PRETRAINED_WEIGHTS.value],
                     ),
                     load_optimizer=False,
                     # aggregator-broadcast file: must be this framework's own
@@ -375,9 +375,9 @@ class COINNLocal:
                     allow_torch=False,
                 )
                 self.cache["_train_state"] = trainer.train_state
-            self.out["phase"] = Phase.COMPUTATION.value
+            self.out[LocalWire.PHASE.value] = Phase.COMPUTATION.value
 
-        if self.out["phase"] == Phase.COMPUTATION.value and trainer.train_state is None:
+        if self.out[LocalWire.PHASE.value] == Phase.COMPUTATION.value and trainer.train_state is None:
             # later invocations within a fold: models are stateless flax defs;
             # the live train-state pytree persists in the cache (≙ the ref
             # sharing nn/optimizer via cache, ``trainer.py:18-20``)
@@ -407,18 +407,18 @@ class COINNLocal:
 
         learner = self._get_learner_cls(learner_cls)(trainer=trainer, mp_pool=mp_pool)
         client_id = self.state.get("clientId", "site")
-        global_modes = self.input.get("global_modes", {})
-        self.out["mode"] = global_modes.get(client_id, self.cache.get("mode"))
+        global_modes = self.input.get(RemoteWire.GLOBAL_MODES.value, {})
+        self.out[LocalWire.MODE.value] = global_modes.get(client_id, self.cache.get("mode"))
 
-        if self.out["phase"] == Phase.COMPUTATION.value:
-            if self.input.get("save_current_as_best"):
+        if self.out[LocalWire.PHASE.value] == Phase.COMPUTATION.value:
+            if self.input.get(RemoteWire.SAVE_CURRENT_AS_BEST.value):
                 trainer.save_checkpoint(name=self.cache["best_nn_state"])
 
-            if self.input.get("update"):
+            if self.input.get(RemoteWire.UPDATE.value):
                 self.out.update(**learner.step())
 
             if any(m == Mode.TRAIN.value for m in global_modes.values()) or (
-                not global_modes and self.out["mode"] == Mode.TRAIN.value
+                not global_modes and self.out[LocalWire.MODE.value] == Mode.TRAIN.value
             ):
                 self.out.update(**learner.to_reduce())
 
@@ -427,7 +427,7 @@ class COINNLocal:
             ):
                 self.out.update(**trainer.validation_distributed())
                 self.out.update(**learner.train_serializable())
-                self.out["mode"] = Mode.TRAIN_WAITING.value
+                self.out[LocalWire.MODE.value] = Mode.TRAIN_WAITING.value
                 # full site resume point at every epoch barrier (params,
                 # optimizer, rng, cache snapshot, compression-engine state)
                 self._barrier_autosave(trainer)
@@ -436,15 +436,15 @@ class COINNLocal:
                 m == Mode.TEST.value for m in global_modes.values()
             ):
                 self.out.update(**trainer.test_distributed())
-                self.out["mode"] = self.cache["frozen_args"]["mode"]
-                self.out["phase"] = Phase.NEXT_RUN_WAITING.value
+                self.out[LocalWire.MODE.value] = self.cache["frozen_args"]["mode"]
+                self.out[LocalWire.PHASE.value] = Phase.NEXT_RUN_WAITING.value
                 # _autosave (not a bare save) keeps the epoch/log record a
                 # later cache['resume'] train_local needs
                 trainer._autosave(len(self.cache.get("train_log", [])))
                 utils.save_cache(self.cache, {"outputDirectory": self.cache["log_dir"]})
 
-        elif self.out["phase"] == Phase.SUCCESS.value:
-            zip_name = self.input.get("results_zip")
+        elif self.out[LocalWire.PHASE.value] == Phase.SUCCESS.value:
+            zip_name = self.input.get(RemoteWire.RESULTS_ZIP.value)
             if zip_name:
                 src = os.path.join(
                     self.state.get("baseDirectory", "."), f"{zip_name}.zip"

@@ -21,7 +21,7 @@ import shutil
 import traceback
 
 from .. import config, utils
-from ..config.keys import AggEngine, GatherMode, Key, Mode, Phase
+from ..config.keys import AggEngine, GatherMode, Key, LocalWire, Mode, Phase, RemoteWire
 from ..data import EmptyDataHandle
 from ..parallel import COINNReducer, DADReducer, PowerSGDReducer
 from ..utils import logger
@@ -44,8 +44,8 @@ class COINNRemote:
         self.cache.setdefault("verbose", verbose)
         if not self.cache.get(Key.ARGS_CACHED) and self.input:
             site = next(iter(self.input.values()))
-            if "shared_args" in site:
-                self.cache.update(**site["shared_args"])
+            if LocalWire.SHARED_ARGS.value in site:
+                self.cache.update(**site[LocalWire.SHARED_ARGS.value])
                 self.cache[Key.ARGS_CACHED.value] = True
 
     # ---------------------------------------------------------- site dropout
@@ -120,7 +120,7 @@ class COINNRemote:
         self.cache.setdefault("all_sites", sorted(self.input.keys()))
         self.cache[Key.GLOBAL_TEST_SERIALIZABLE.value] = []
         self.cache["data_size"] = {
-            site: site_vars.get("data_size")
+            site: site_vars.get(LocalWire.DATA_SIZE.value)
             for site, site_vars in self.input.items()
         }
         self.cache["folds"] = [
@@ -208,7 +208,7 @@ class COINNRemote:
 
     def _save_if_better(self, **info):
         score = info["val_metrics"].extract(self.cache.get("monitor_metric", "f1"))
-        self.out["save_current_as_best"] = performance_improved_(
+        self.out[RemoteWire.SAVE_CURRENT_AS_BEST.value] = performance_improved_(
             self.cache["epoch"], score, self.cache
         )
 
@@ -256,11 +256,11 @@ class COINNRemote:
             self.cache, log_dir=task_dir, file_keys=["global_test_metrics"]
         )
         stamp = "_".join(str(datetime.datetime.now()).split(" "))
-        out["results_zip"] = (
+        out[RemoteWire.RESULTS_ZIP.value] = (
             f"{self.cache['task_id']}_{self.cache.get('agg_engine')}_{stamp}"
         )
         shutil.make_archive(
-            os.path.join(self.state.get("transferDirectory", "."), out["results_zip"]),
+            os.path.join(self.state.get("transferDirectory", "."), out[RemoteWire.RESULTS_ZIP.value]),
             "zip",
             task_dir,
         )
@@ -268,7 +268,7 @@ class COINNRemote:
 
     def _set_mode(self, mode=None):
         return {
-            site: (mode if mode else site_vars.get("mode", "N/A"))
+            site: (mode if mode else site_vars.get(LocalWire.MODE.value, "N/A"))
             for site, site_vars in self.input.items()
         }
 
@@ -276,18 +276,18 @@ class COINNRemote:
         """Broadcast the pretrain site's weights (≙ ref ``:205-215``)."""
         out = {}
         for site, site_vars in self.input.items():
-            if site_vars.get("weights_file"):
+            if site_vars.get(LocalWire.WEIGHTS_FILE.value):
                 src = os.path.join(
                     self.state.get("baseDirectory", "."), site,
-                    site_vars["weights_file"],
+                    site_vars[LocalWire.WEIGHTS_FILE.value],
                 )
                 if os.path.exists(src):
-                    out["pretrained_weights"] = f"pretrained_{config.weights_file}"
+                    out[RemoteWire.PRETRAINED_WEIGHTS.value] = f"pretrained_{config.weights_file}"
                     shutil.copy(
                         src,
                         os.path.join(
                             self.state.get("transferDirectory", "."),
-                            out["pretrained_weights"],
+                            out[RemoteWire.PRETRAINED_WEIGHTS.value],
                         ),
                     )
                 break
@@ -311,46 +311,46 @@ class COINNRemote:
                 cache=self.cache, input=self.input, state=self.state
             ),
         )
-        self.out["phase"] = self.input.get("phase", Phase.INIT_RUNS.value)
+        self.out[RemoteWire.PHASE.value] = self.input.get(LocalWire.PHASE.value, Phase.INIT_RUNS.value)
         self._check_quorum()
 
-        if check(all, "phase", Phase.INIT_RUNS.value, self.input):
+        if check(all, LocalWire.PHASE.value, Phase.INIT_RUNS.value, self.input):
             self._init_runs()
-            self.out["global_runs"] = self._next_run(trainer)
-            self.out["phase"] = Phase.NEXT_RUN.value
+            self.out[RemoteWire.GLOBAL_RUNS.value] = self._next_run(trainer)
+            self.out[RemoteWire.PHASE.value] = Phase.NEXT_RUN.value
 
-        if check(all, "phase", Phase.PRE_COMPUTATION.value, self.input):
+        if check(all, LocalWire.PHASE.value, Phase.PRE_COMPUTATION.value, self.input):
             self.out.update(**self._pre_compute())
-            self.out["phase"] = Phase.PRE_COMPUTATION.value
+            self.out[RemoteWire.PHASE.value] = Phase.PRE_COMPUTATION.value
 
-        self.out["global_modes"] = self._set_mode()
-        if check(all, "phase", Phase.COMPUTATION.value, self.input):
+        self.out[RemoteWire.GLOBAL_MODES.value] = self._set_mode()
+        if check(all, LocalWire.PHASE.value, Phase.COMPUTATION.value, self.input):
             reducer = self._get_reducer_cls(reducer_cls)(
                 trainer=trainer, mp_pool=mp_pool
             )
-            self.out["phase"] = Phase.COMPUTATION.value
-            if check(all, "reduce", True, self.input):
+            self.out[RemoteWire.PHASE.value] = Phase.COMPUTATION.value
+            if check(all, LocalWire.REDUCE.value, True, self.input):
                 self.out.update(**reducer.reduce())
 
-            if check(all, "mode", Mode.VALIDATION_WAITING.value, self.input):
+            if check(all, LocalWire.MODE.value, Mode.VALIDATION_WAITING.value, self.input):
                 self.cache["epoch"] += 1
                 if self.cache["epoch"] % int(self.cache.get("validation_epochs", 1)) == 0:
-                    self.out["global_modes"] = self._set_mode(Mode.VALIDATION.value)
+                    self.out[RemoteWire.GLOBAL_MODES.value] = self._set_mode(Mode.VALIDATION.value)
                 else:
-                    self.out["global_modes"] = self._set_mode(Mode.TRAIN.value)
+                    self.out[RemoteWire.GLOBAL_MODES.value] = self._set_mode(Mode.TRAIN.value)
 
-            if check(all, "mode", Mode.TRAIN_WAITING.value, self.input):
+            if check(all, LocalWire.MODE.value, Mode.TRAIN_WAITING.value, self.input):
                 info = self._on_epoch_end(trainer)
-                self.out["global_modes"] = self._set_mode(self._next_epoch(**info))
+                self.out[RemoteWire.GLOBAL_MODES.value] = self._set_mode(self._next_epoch(**info))
 
-        if check(all, "phase", Phase.NEXT_RUN_WAITING.value, self.input):
+        if check(all, LocalWire.PHASE.value, Phase.NEXT_RUN_WAITING.value, self.input):
             self._on_run_end(trainer)
             if self.cache["folds"]:
-                self.out["global_runs"] = self._next_run(trainer)
-                self.out["phase"] = Phase.NEXT_RUN.value
+                self.out[RemoteWire.GLOBAL_RUNS.value] = self._next_run(trainer)
+                self.out[RemoteWire.PHASE.value] = Phase.NEXT_RUN.value
             else:
                 self.out.update(**self._send_global_scores(trainer))
-                self.out["phase"] = Phase.SUCCESS.value
+                self.out[RemoteWire.PHASE.value] = Phase.SUCCESS.value
         return self.out
 
     def __call__(self, *a, **kw):
@@ -359,7 +359,7 @@ class COINNRemote:
                 self.compute(*a, **kw)
             return {
                 "output": self.out,
-                "success": check(all, "phase", Phase.SUCCESS.value, self.input),
+                "success": check(all, LocalWire.PHASE.value, Phase.SUCCESS.value, self.input),
                 # JSON-able cache for fresh-process engines (see COINNLocal)
                 "cache": utils.clean_recursive({
                     k: v for k, v in dict(self.cache).items()

@@ -47,6 +47,8 @@ try:  # soft import: CPU-only deployments fall back to impl='xla'
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
+    # renamed TPUCompilerParams (0.4.x) -> CompilerParams (>=0.7)
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
     _HAVE_PALLAS = True
 except Exception:  # noqa: BLE001
     _HAVE_PALLAS = False
@@ -178,7 +180,7 @@ def _flash_pallas(q, k, v, aux, scale, causal, block_q, block_k, interpret):
         # online-softmax state across j and the output is written only at
         # j == nk-1.  TPU grids default to sequential execution, but pin it
         # so the compiler can never parallelize the carried axis.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -347,7 +349,7 @@ def _flash_bwd_pallas(q, k, v, aux, out, lse, g_out, g_lse, scale, causal,
     kspec_j = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
     cspec_i = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
     smem = pl.BlockSpec((1, 3), lambda b, i, j: (0, 0), memory_space=pltpu.SMEM)
-    seq = pltpu.CompilerParams(
+    seq = _CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"),
     )
 

@@ -32,6 +32,15 @@ try:  # soft import — CPU-only deployments use the numpy path
 except Exception:  # noqa: BLE001
     _HAVE_PALLAS = False
 
+# the kernel's stochastic rounding uses the pltpu prng, which off-TPU only the
+# TPU-flavored interpreter (pltpu.InterpretParams, JAX >= 0.7) implements;
+# plain interpret=True has no lowering for 'prng_seed' on cpu
+if _HAVE_PALLAS and hasattr(pltpu, "InterpretParams"):
+    _TPU_INTERPRET_PARAMS = pltpu.InterpretParams
+else:
+    _TPU_INTERPRET_PARAMS = None
+_HAVE_TPU_INTERPRET = _TPU_INTERPRET_PARAMS is not None
+
 GROUP = 128  # elements per scale group = VPU lane width
 
 
@@ -84,7 +93,7 @@ def _quantize_pallas(groups, seed, interpret):
             jax.ShapeDtypeStruct((rows, 1), jnp.float32),
         ],
         # the TPU-flavored interpreter implements pltpu prng on CPU
-        interpret=pltpu.InterpretParams() if interpret else False,
+        interpret=_TPU_INTERPRET_PARAMS() if interpret else False,
     )(jnp.asarray([seed], jnp.int32), groups)
 
 
@@ -108,6 +117,12 @@ def quantize_int8(x, seed=0, impl=None):
     """
     if impl is None:
         impl = "pallas" if jax.default_backend() == "tpu" else "numpy"
+    if impl == "pallas_interpret" and not _HAVE_TPU_INTERPRET:
+        raise NotImplementedError(
+            "impl='pallas_interpret' needs the TPU-flavored Pallas "
+            "interpreter (pltpu.InterpretParams, JAX >= 0.7); this JAX has "
+            "no CPU lowering for the pltpu prng — use impl='numpy'"
+        )
     seed = int(seed) % (2 ** 31)  # callers may pass crc+counter sums ≥ int32 max
     shape = tuple(np.shape(x))
     flat = np.asarray(x, np.float32).reshape(-1) if impl == "numpy" else \

@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import orthogonalize
+from ..utils.jax_compat import shard_map
 
 
 from .powersgd import _aslist  # msgpack list/dict normalization (shared)
@@ -339,7 +340,7 @@ class MeshFederation:
 
         @functools.partial(jax.jit, donate_argnums=donate)
         def step(ts, stacked, comm):
-            ts, aux = jax.shard_map(
+            ts, aux = shard_map(
                 site_step,
                 mesh=mesh,
                 in_specs=(P(), batch_spec),
@@ -495,7 +496,7 @@ class MeshFederation:
 
         @functools.partial(jax.jit, donate_argnums=donate)
         def step(ts, stacked, comm):
-            return jax.shard_map(
+            return shard_map(
                 site_step,
                 mesh=mesh,
                 in_specs=(P(), batch_spec, comm_spec),
@@ -597,7 +598,7 @@ class MeshFederation:
 
         @jax.jit
         def ev(ts, batch):
-            return jax.shard_map(
+            return shard_map(
                 site_eval,
                 mesh=mesh,
                 in_specs=(P(), eval_spec),

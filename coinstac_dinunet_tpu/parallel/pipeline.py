@@ -45,6 +45,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import flash_attention
+from ..utils.jax_compat import shard_map
 from .sequence import _layernorm, transformer_block
 
 __all__ = ["build_pp_mesh", "stack_layers", "make_pp_train_step",
@@ -184,7 +185,7 @@ def make_pp_train_step(cfg, mesh, lr=1e-3, num_microbatches=None):
     @jax.jit
     def step(params, x, y):
         specs = p_specs(params)
-        return jax.shard_map(
+        return shard_map(
             sharded_step,
             mesh=mesh,
             in_specs=(specs, P("dp"), P("dp")),

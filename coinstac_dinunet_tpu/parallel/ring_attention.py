@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.flash_attention import _NEG, flash_attention
+from ..utils.jax_compat import axis_size
 
 
 def _merge(acc, lse, o_new, lse_new):
@@ -60,8 +61,11 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None, impl=None,
     Returns:
       ``(batch, heads, T_local, head_dim)`` local output block, ``q.dtype``.
     """
-    n = lax.axis_size(axis_name)
-    r = lax.axis_index(axis_name)
+    n = axis_size(axis_name)
+    # only the causal mask consumes global positions; an unconsumed
+    # axis_index would leave a dangling partition-id instruction that 0.4.x
+    # XLA's CPU SPMD partitioner rejects
+    r = lax.axis_index(axis_name) if causal else 0
     t_local = q.shape[2]
     b, h = q.shape[0], q.shape[1]
 
@@ -107,7 +111,7 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None, impl=None,
     T_local, head_dim)`` shards under ``shard_map``); ``heads`` must be
     divisible by the axis size.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     b, h, t_local, d = q.shape
     if h % n != 0:
         raise ValueError(f"heads {h} must divide the '{axis_name}' axis size {n}")

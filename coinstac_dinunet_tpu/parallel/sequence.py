@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.jax_compat import shard_map
 from .ring_attention import ring_attention
 
 __all__ = ["TSPConfig", "build_tsp_mesh", "init_tsp_params", "shard_tsp_params",
@@ -242,7 +243,7 @@ def tsp_forward(params, x, cfg, mesh):
     h = constrain(h, P("dp", "sp", None))
 
     qkv_spec = P("dp", "tp", "sp", None)
-    ring = jax.shard_map(
+    ring = shard_map(
         partial(
             ring_attention, axis_name="sp", causal=cfg.causal,
             impl=cfg.attn_impl,

@@ -1,0 +1,37 @@
+"""Version-portable JAX surface.
+
+The package targets the modern top-level API (``jax.shard_map`` with the
+``check_vma`` kwarg, JAX >= 0.6) but must also run on the pinned 0.4.x line
+where ``shard_map`` still lives in ``jax.experimental.shard_map`` and the
+replication check is spelled ``check_rep``.  Every module imports
+:func:`shard_map` from here instead of touching ``jax.shard_map`` directly —
+the ``jax-api-drift`` rule of :mod:`coinstac_dinunet_tpu.analysis` enforces
+this (a bare ``jax.shard_map`` reference is an ``AttributeError`` at trace
+time on 0.4.x, which is exactly how the seed lost 57 tier-1 tests).
+"""
+import jax
+from jax import lax
+
+__all__ = ["shard_map", "axis_size"]
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *args, **kwargs):
+        """0.4.x fallback: ``check_vma`` (>=0.6 spelling) maps to
+        ``check_rep``; all other arguments pass through unchanged."""
+        if "check_vma" in kwargs:
+            kwargs.setdefault("check_rep", kwargs.pop("check_vma"))
+        return _experimental_shard_map(f, *args, **kwargs)
+
+
+if hasattr(lax, "axis_size"):
+    axis_size = lax.axis_size
+else:
+    def axis_size(axis_name):
+        """0.4.x fallback: ``psum`` of the Python constant 1 over a named
+        axis constant-folds to the axis size as a static int — the pre-
+        ``lax.axis_size`` idiom, so shape arithmetic stays trace-static."""
+        return lax.psum(1, axis_name)

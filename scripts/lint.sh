@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# The repo's static gate: ruff (style/correctness lints, when installed)
+# + dinulint (JAX-hazard and wire-protocol analysis, always) against the
+# checked-in baseline.  Mirrors tests/test_analysis_selfcheck.py so the
+# same check runs pre-commit and inside tier-1.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+status=0
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check (config: pyproject.toml [tool.ruff]) =="
+    ruff check coinstac_dinunet_tpu tests scripts || status=1
+else
+    # the pinned CI container bakes its own toolchain; ruff is optional
+    echo "== ruff not installed; skipping (pip install ruff to enable) =="
+fi
+
+echo "== dinulint (python -m coinstac_dinunet_tpu.analysis) =="
+python -m coinstac_dinunet_tpu.analysis coinstac_dinunet_tpu \
+    --baseline dinulint_baseline.json || status=1
+
+exit "$status"

@@ -1,0 +1,610 @@
+"""dinulint rule engine: fixture-driven tests per rule family.
+
+Each fixture is a small synthetic source string; rules run on its parsed
+AST directly (``Module`` + ``visit_module``/``finalize``), so these tests
+never touch the real package tree (``test_analysis_selfcheck.py`` does
+that) and stay in the low milliseconds.
+"""
+import ast
+import json
+import textwrap
+
+from coinstac_dinunet_tpu.analysis import (
+    Finding,
+    JaxApiDriftRule,
+    Module,
+    ProtocolConformanceRule,
+    filter_baselined,
+    load_baseline,
+    run_lint,
+    symbol_status,
+    write_baseline,
+)
+from coinstac_dinunet_tpu.analysis.trace_hazards import (
+    HostSyncRule,
+    ImpureCallRule,
+    PyControlFlowRule,
+    SetIterationRule,
+)
+
+
+def _module(source, path="fixture.py"):
+    source = textwrap.dedent(source)
+    return Module(path, source, ast.parse(source))
+
+
+def _messages(findings):
+    return [f.message for f in findings]
+
+
+# ------------------------------------------------------------ jax-api-drift
+def test_drift_flags_jax_shard_map_at_0437():
+    """The seed's defining breakage: jax.shard_map doesn't exist at 0.4.37."""
+    mod = _module(
+        """
+        import jax
+
+        def build(mesh):
+            return jax.shard_map(lambda x: x, mesh=mesh)
+        """
+    )
+    findings = JaxApiDriftRule(jax_version="0.4.37").visit_module(mod)
+    assert len(findings) == 1
+    assert "jax.shard_map does not exist in jax 0.4.37" in findings[0].message
+    assert "jax_compat" in findings[0].message  # points at the shim
+
+
+def test_drift_clean_on_the_compat_fix():
+    """The sanctioned fix — importing the shim — produces no findings."""
+    mod = _module(
+        """
+        from coinstac_dinunet_tpu.utils.jax_compat import shard_map
+
+        def build(mesh):
+            return shard_map(lambda x: x, mesh=mesh)
+        """
+    )
+    assert JaxApiDriftRule(jax_version="0.4.37").visit_module(mod) == []
+
+
+def test_drift_same_symbol_fine_on_newer_jax():
+    mod = _module("import jax\nstep = jax.shard_map\n")
+    assert JaxApiDriftRule(jax_version="0.6.2").visit_module(mod) == []
+
+
+def test_drift_resolves_import_aliases():
+    mod = _module(
+        """
+        from jax import lax
+
+        def size(name):
+            return lax.axis_size(name)
+        """
+    )
+    findings = JaxApiDriftRule(jax_version="0.4.37").visit_module(mod)
+    assert len(findings) == 1
+    assert "jax.lax.axis_size" in findings[0].message
+
+
+def test_drift_flags_removed_and_deprecated_symbols():
+    mod = _module("import jax\nleaves = jax.tree_leaves(tree)\n")
+    dep = JaxApiDriftRule(jax_version="0.4.37").visit_module(mod)
+    assert len(dep) == 1 and "deprecated" in dep[0].message
+    gone = JaxApiDriftRule(jax_version="0.6.0").visit_module(mod)
+    assert len(gone) == 1 and "does not exist" in gone[0].message
+
+
+def test_drift_hasattr_guard_sanctions_the_reference():
+    """References under ``if hasattr(...)`` ARE the version-portability
+    idiom (utils/jax_compat.py) — never reported; the same reference
+    outside the guard body still is."""
+    mod = _module(
+        """
+        import jax
+        from jax import lax
+
+        if hasattr(jax, "shard_map"):
+            shard_map = jax.shard_map
+        else:
+            shard_map = None
+
+        if hasattr(lax, "axis_size"):
+            axis_size = lax.axis_size
+
+        unguarded = jax.shard_map
+        """
+    )
+    findings = JaxApiDriftRule(jax_version="0.4.37").visit_module(mod)
+    assert len(findings) == 1
+    assert findings[0].line == mod.source.splitlines().index(
+        "unguarded = jax.shard_map"
+    ) + 1
+
+
+def test_drift_hasattr_else_branch_is_exempt():
+    """The complement branch of a hasattr guard only runs on the other
+    version line — its old-API fallback (utils/jax_compat.py's shape) must
+    not be flagged on modern JAX, where jax.experimental.shard_map is
+    deprecated."""
+    mod = _module(
+        """
+        import jax
+
+        if hasattr(jax, "shard_map"):
+            shard_map = jax.shard_map
+        else:
+            from jax.experimental.shard_map import shard_map
+        """
+    )
+    assert JaxApiDriftRule(jax_version="0.6.2").visit_module(mod) == []
+    assert JaxApiDriftRule(jax_version="0.4.37").visit_module(mod) == []
+
+
+def test_drift_getattr_or_fallback_is_exempt():
+    """The getattr shim the rule's own hints recommend (ops/flash_attention
+    uses it for the 0.7 TPUCompilerParams rename): operands after the probe
+    only evaluate when the probe came back None."""
+    mod = _module(
+        """
+        from jax.experimental.pallas import tpu as pltpu
+
+        _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+        unguarded = pltpu.TPUCompilerParams
+        """
+    )
+    findings = JaxApiDriftRule(jax_version="0.7.0").visit_module(mod)
+    assert len(findings) == 1
+    assert findings[0].line == mod.source.splitlines().index(
+        "unguarded = pltpu.TPUCompilerParams"
+    ) + 1
+
+
+def test_py_control_mixed_static_dynamic_boolop_fires():
+    """`x is None or x.sum() > 0` still concretizes the traced half — a
+    static operand must not silence the whole condition; an all-static
+    combination stays exempt."""
+    mixed = _module(
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x is None or x.sum() > 0:
+                return x
+            return -x
+        """
+    )
+    findings = PyControlFlowRule().visit_module(mixed)
+    assert len(findings) == 1 and "Python `if` on `x`" in findings[0].message
+    all_static = _module(
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x is None or x.shape[0] > 2:
+                return x
+            return -x
+        """
+    )
+    assert PyControlFlowRule().visit_module(all_static) == []
+
+
+def test_symbol_status_longest_prefix_match():
+    status, sym, _ = symbol_status("jax.experimental.maps.Mesh", "0.4.37")
+    assert (status, sym) == ("missing", "jax.experimental.maps")
+    assert symbol_status("jax.numpy.sum", "0.4.37")[0] == "ok"
+
+
+# ------------------------------------------------------------ trace hazards
+def test_host_sync_item_inside_jit():
+    mod = _module(
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.sum().item()
+        """
+    )
+    findings = HostSyncRule().visit_module(mod)
+    assert len(findings) == 1
+    assert ".item()" in findings[0].message
+
+
+def test_host_sync_ignores_untr_host_functions():
+    mod = _module(
+        """
+        def host_metrics(x):
+            return float(x.sum().item())
+        """
+    )
+    assert HostSyncRule().visit_module(mod) == []
+
+
+def test_impure_time_inside_build_step_idiom():
+    """`_build_*` + inner `*_step` is how every trainer builds its compiled
+    step — time.time() in there is frozen at compile time."""
+    mod = _module(
+        """
+        import time
+
+        def _build_train_step(model):
+            def train_step(state, batch):
+                t0 = time.time()
+                return state, t0
+            return train_step
+        """
+    )
+    findings = ImpureCallRule().visit_module(mod)
+    assert len(findings) == 1
+    assert "time.time" in findings[0].message
+    assert "inner step of _build_train_step" in findings[0].message
+
+
+def test_py_control_on_traced_arg():
+    mod = _module(
+        """
+        import jax
+
+        @jax.jit
+        def step(x, y):
+            if x > 0:
+                return y
+            return -y
+        """
+    )
+    findings = PyControlFlowRule().visit_module(mod)
+    assert len(findings) == 1
+    assert "Python `if` on `x`" in findings[0].message
+
+
+def test_py_control_static_argnames_are_exempt():
+    """static_argnames/static_argnums params stay Python values under jit —
+    branching on them is the sanctioned pattern (ops/power_iteration.py)."""
+    mod = _module(
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("rank",))
+        def compress(B, rank=10):
+            if B.shape[0] <= rank:
+                return B
+            if rank > 4:
+                return B[:rank]
+            return B
+
+        def inner(x, n):
+            return x * n
+
+        def build():
+            return jax.jit(inner, static_argnums=(1,))
+        """
+    )
+    assert PyControlFlowRule().visit_module(mod) == []
+
+
+def test_py_control_shape_tests_are_static():
+    mod = _module(
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            if x.ndim == 2:
+                return x.sum()
+            if x is None:
+                return 0
+            return x
+        """
+    )
+    assert PyControlFlowRule().visit_module(mod) == []
+
+
+def test_set_iteration_under_tracing():
+    mod = _module(
+        """
+        import jax
+
+        @jax.jit
+        def step(tree):
+            return [tree[k] for k in set(tree)]
+        """
+    )
+    findings = SetIterationRule().visit_module(mod)
+    assert len(findings) == 1
+    assert "ordering varies across processes" in findings[0].message
+
+
+def test_function_passed_to_shard_map_is_traced():
+    mod = _module(
+        """
+        def psum_step(x):
+            return int(x)
+
+        def build(mesh, spec):
+            from coinstac_dinunet_tpu.utils.jax_compat import shard_map
+            return shard_map(psum_step, mesh=mesh, in_specs=spec)
+        """
+    )
+    findings = HostSyncRule().visit_module(mod)
+    assert len(findings) == 1
+    assert "`int()`" in findings[0].message
+
+
+# ---------------------------------------------------- protocol conformance
+_KEYS_FIXTURE = """
+class LocalWire:
+    PHASE = "phase"
+    GRADS = "grads_file"
+
+class RemoteWire:
+    PHASE = "phase"
+    UPDATE = "update"
+
+ENGINE_PROVIDED_KEYS = ("task_id",)
+"""
+
+
+def _protocol_findings(local_src, remote_src, keys_source=_KEYS_FIXTURE):
+    rule = ProtocolConformanceRule(
+        keys_source=textwrap.dedent(keys_source),
+        protocol_files={"nodes/local.py": "site", "nodes/remote.py": "agg"},
+    )
+    modules = [
+        _module(local_src, "pkg/nodes/local.py"),
+        _module(remote_src, "pkg/nodes/remote.py"),
+    ]
+    return rule.finalize(modules)
+
+
+def test_protocol_matched_handshake_is_clean():
+    findings = _protocol_findings(
+        """
+        def compute(out, input):
+            out["phase"] = input.get("phase", "init")
+            out["grads_file"] = "g.npz"
+            up = input["update"]
+            task = input["task_id"]
+            return up, task
+        """,
+        """
+        def compute(out, input):
+            out["update"] = True
+            out["phase"] = input.get("phase")
+            check(all, "grads_file", input)
+            return out
+        """,
+    )
+    assert findings == []
+
+
+def test_protocol_reports_unmatched_and_undeclared_keys():
+    findings = _protocol_findings(
+        """
+        def compute(out, input):
+            out["phase"] = "done"
+            out["grads_fil"] = "g.npz"       # typo'd producer
+            return input["update"]
+        """,
+        """
+        def compute(out, input):
+            out["update"] = True
+            out["phase"] = input.get("phase")
+            check(all, "grads_file", input)  # consumer of the intended key
+            return out
+        """,
+    )
+    msgs = _messages(findings)
+    assert any(
+        "'grads_fil' is produced but never consumed" in m for m in msgs
+    )
+    assert any(
+        "'grads_file' is consumed but never produced" in m for m in msgs
+    )
+    assert any(
+        "'grads_fil' is not declared" in m for m in msgs
+    )
+
+
+def test_protocol_declared_but_unused_vocabulary_key():
+    findings = _protocol_findings(
+        """
+        def compute(out, input):
+            out["phase"] = "x"
+            return input["update"]
+        """,
+        """
+        def compute(out, input):
+            out["update"] = True
+            out["phase"] = input.get("phase")
+            return out
+        """,
+    )
+    msgs = _messages(findings)
+    assert any("'grads_file' is declared but never" in m for m in msgs)
+
+
+def test_protocol_resolves_enum_references_and_sides_per_class():
+    findings = _protocol_findings(
+        """
+        from config.keys import LocalWire
+
+        class XLearner:
+            def step(self):
+                return {LocalWire.GRADS.value: "g.npz"}
+        """,
+        """
+        class XReducer:
+            def reduce(self):
+                check(all, "grads_file", self.input)
+                return {"update": True}
+
+        class COINNRemote:
+            def compute(self):
+                self.out["phase"] = self.input.get("phase")
+        """,
+        keys_source="""
+        class LocalWire:
+            PHASE = "phase"
+            GRADS = "grads_file"
+
+        class RemoteWire:
+            PHASE = "phase"
+            UPDATE = "update"
+
+        ENGINE_PROVIDED_KEYS = ()
+        """,
+    )
+    # local produces phase? no — only remote reads it; so 'phase' consumed but
+    # never produced on the LocalWire direction, and RemoteWire 'update'
+    # produced but never consumed.  Both must be reported.
+    msgs = _messages(findings)
+    assert any("LocalWire key 'phase' is consumed" in m for m in msgs)
+    assert any("RemoteWire key 'update' is produced" in m for m in msgs)
+    # the enum-written grads_file matched the string-read consumer exactly
+    assert not any("grads_file" in m for m in msgs)
+
+
+def test_protocol_gather_over_nested_payloads_is_not_consumption():
+    findings = _protocol_findings(
+        """
+        def compute(out, input):
+            out["phase"] = "x"
+            out["grads_file"] = "g"
+            return input["update"]
+        """,
+        """
+        def compute(out, input):
+            out["update"] = True
+            out["phase"] = input.get("phase")
+            check(all, "grads_file", input)
+            pairs = gather(["averages", "metrics"], payloads)
+            return pairs
+        """,
+    )
+    assert not any("averages" in m or "metrics" in m for m in _messages(findings))
+
+
+def test_protocol_skips_partial_scans():
+    """Producer/consumer matching needs both sides in scope: a single-file
+    lint (`dinulint nodes/local.py`) must yield no protocol findings instead
+    of reporting every key on the unscanned side as unmatched."""
+    rule = ProtocolConformanceRule(
+        keys_source=textwrap.dedent(_KEYS_FIXTURE),
+        protocol_files={"nodes/local.py": "site", "nodes/remote.py": "agg"},
+    )
+    local_only = [_module(
+        """
+        def compute(out, input):
+            out["phase"] = "x"
+            return input["update"]
+        """,
+        "pkg/nodes/local.py",
+    )]
+    assert rule.finalize(local_only) == []
+
+
+# ------------------------------------------------- baseline + suppressions
+def test_baseline_roundtrip_and_new_finding_detection(tmp_path):
+    f1 = Finding("r", "a.py", 3, 0, "legacy problem")
+    f2 = Finding("r", "a.py", 9, 4, "fresh problem")
+    path = str(tmp_path / "baseline.json")
+    write_baseline(path, [f1])
+    counts = load_baseline(path)
+    new, baselined = filter_baselined([f1, f2], counts)
+    assert [f.message for f in baselined] == ["legacy problem"]
+    assert [f.message for f in new] == ["fresh problem"]
+    # fingerprints are line-free: the same finding at a shifted line matches
+    moved = Finding("r", "a.py", 77, 0, "legacy problem")
+    new, baselined = filter_baselined([moved], counts)
+    assert new == [] and baselined == [moved]
+    # counts cap duplicates: two instances against a count-1 baseline -> 1 new
+    new, _ = filter_baselined([f1, moved], counts)
+    assert len(new) == 1
+
+
+def test_inline_and_file_suppressions(tmp_path):
+    hit = tmp_path / "hit.py"
+    hit.write_text(
+        "import jax\n"
+        "a = jax.shard_map\n"
+        "b = jax.shard_map  # dinulint: disable=jax-api-drift\n"
+    )
+    silenced = tmp_path / "silenced.py"
+    silenced.write_text(
+        "# dinulint: disable-file=jax-api-drift\n"
+        "import jax\n"
+        "a = jax.shard_map\n"
+    )
+    rules = [JaxApiDriftRule(jax_version="0.4.37")]
+    findings, errors = run_lint([str(hit), str(silenced)], rules=rules)
+    assert errors == []
+    assert len(findings) == 1 and findings[0].line == 2
+
+
+def test_suppression_in_string_literal_is_inert(tmp_path):
+    """Only real comment tokens activate suppressions — a docstring that
+    merely documents the ``# dinulint: disable-file=...`` syntax (as
+    docs/ANALYSIS.md and core.py's own docstring do) must not silently
+    disable the rule for the file."""
+    documented = tmp_path / "documented.py"
+    documented.write_text(
+        '"""Escape hatch: ``# dinulint: disable-file=jax-api-drift``."""\n'
+        "import jax\n"
+        "a = jax.shard_map\n"
+    )
+    rules = [JaxApiDriftRule(jax_version="0.4.37")]
+    findings, errors = run_lint([str(documented)], rules=rules)
+    assert errors == []
+    assert len(findings) == 1 and findings[0].line == 3
+
+
+def test_run_lint_reports_parse_errors_without_crashing(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    nul = tmp_path / "nul.py"  # ast.parse raises ValueError on NUL bytes
+    nul.write_bytes(b"import jax\x00\n")
+    findings, errors = run_lint([str(bad), str(nul)])
+    assert findings == []
+    assert len(errors) == 2
+    assert any("SyntaxError" in e for _, e in errors)
+    assert any("ValueError" in e for _, e in errors)
+
+
+def test_run_lint_scans_explicit_files_regardless_of_extension(tmp_path):
+    """An explicitly listed file is always linted — silently skipping an
+    extensionless script would report exit 0 for a path that never ran."""
+    script = tmp_path / "tool"
+    script.write_text("import jax\na = jax.shard_map\n")
+    findings, errors = run_lint(
+        [str(script)], rules=[JaxApiDriftRule(jax_version="0.4.37")]
+    )
+    assert errors == []
+    assert len(findings) == 1
+
+
+def test_cli_json_format_and_exit_codes(tmp_path, capsys):
+    from coinstac_dinunet_tpu.analysis.__main__ import main
+
+    src = tmp_path / "drift.py"
+    src.write_text("import jax\nstep = jax.shard_map\n")
+
+    rc = main([str(src), "--format", "json", "--jax-version", "0.4.37"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert len(payload["new"]) == 1
+    assert payload["new"][0]["rule"] == "jax-api-drift"
+
+    # write a baseline, then the same findings gate to exit 0
+    baseline = tmp_path / "baseline.json"
+    rc = main([str(src), "--jax-version", "0.4.37",
+               "--write-baseline", "--baseline", str(baseline)])
+    capsys.readouterr()
+    assert rc == 0
+    rc = main([str(src), "--jax-version", "0.4.37",
+               "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 new finding(s), 1 baselined" in out

@@ -1,0 +1,89 @@
+"""dinulint self-check: the whole package lints clean against the
+checked-in baseline, and the headline rules demonstrably fire.
+
+This is the tier-1 CI gate (ISSUE 1 acceptance): a regression that
+reintroduces ``jax.shard_map``-class drift, a trace hazard, or an
+unmatched wire key anywhere in ``coinstac_dinunet_tpu/`` fails HERE in
+milliseconds, not 40 s into the pytest sweep (or worse, on a TPU).
+"""
+import os
+
+from coinstac_dinunet_tpu.analysis import (
+    filter_baselined,
+    load_baseline,
+    run_lint,
+)
+from coinstac_dinunet_tpu.analysis.__main__ import main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "coinstac_dinunet_tpu")
+BASELINE = os.path.join(REPO, "dinulint_baseline.json")
+
+
+def test_package_lints_clean_against_checked_in_baseline():
+    findings, errors = run_lint([PACKAGE])
+    assert errors == [], f"unparseable package files: {errors}"
+    new, _ = filter_baselined(findings, load_baseline(BASELINE))
+    assert new == [], (
+        "dinulint found NEW findings (fix them, or if intentional refresh "
+        "dinulint_baseline.json — see docs/ANALYSIS.md):\n"
+        + "\n".join(f.render() for f in new)
+    )
+
+
+def test_cli_exits_zero_on_the_package(capsys):
+    rc = main([PACKAGE, "--baseline", BASELINE])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_drift_rule_fires_on_seed_style_breakage(tmp_path):
+    """Acceptance fixture: bare ``jax.shard_map`` under the pinned 0.4.37
+    symbol table is reported; the ``jax.experimental`` spelling is not."""
+    broken = tmp_path / "broken.py"
+    broken.write_text(
+        "import jax\n"
+        "def build(mesh):\n"
+        "    return jax.shard_map(lambda x: x, mesh=mesh)\n"
+    )
+    fixed = tmp_path / "fixed.py"
+    fixed.write_text(
+        "from jax.experimental.shard_map import shard_map\n"
+        "def build(mesh):\n"
+        "    return shard_map(lambda x: x, mesh=mesh)\n"
+    )
+    rc_broken = main([str(broken), "--jax-version", "0.4.37"])
+    rc_fixed = main([str(fixed), "--jax-version", "0.4.37"])
+    assert (rc_broken, rc_fixed) == (1, 0)
+
+
+def test_write_baseline_refuses_partial_rule_set(capsys):
+    """--write-baseline over a filtered rule set would silently drop every
+    other rule's baselined findings — the CLI refuses the combination."""
+    rc = main([PACKAGE, "--rules", "jax-api-drift", "--write-baseline"])
+    assert rc == 2
+    assert "full rule set" in capsys.readouterr().err
+
+
+def test_protocol_rule_reports_zero_unmatched_wire_keys():
+    """nodes/local.py <-> nodes/remote.py (plus the learner/reducer modules)
+    agree on every statically-resolvable wire key, both ways."""
+    findings, _ = run_lint([PACKAGE], rule_ids=["protocol-conformance"])
+    unmatched = [
+        f for f in findings
+        if "never produced" in f.message or "never consumed" in f.message
+    ]
+    assert unmatched == [], "\n".join(f.render() for f in unmatched)
+
+
+def test_trace_rules_cover_the_package_without_noise():
+    """The trace-hazard families run over the real package: everything they
+    report (if anything) must be baselined — no unreviewed hazards ride in."""
+    findings, _ = run_lint(
+        [PACKAGE],
+        rule_ids=[
+            "trace-host-sync", "trace-impure",
+            "trace-py-control", "trace-set-iter",
+        ],
+    )
+    new, _ = filter_baselined(findings, load_baseline(BASELINE))
+    assert new == [], "\n".join(f.render() for f in new)

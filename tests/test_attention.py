@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from coinstac_dinunet_tpu.utils.jax_compat import shard_map
 from coinstac_dinunet_tpu.ops import flash_attention
 from coinstac_dinunet_tpu.parallel import ring_attention
 from coinstac_dinunet_tpu.parallel.ring_attention import ulysses_attention
@@ -154,7 +155,7 @@ def _ring_vs_full(causal, n_ranks=4, t_local=16):
         return ring_attention(q, k, v, "sp", causal=causal, impl="xla")
 
     ringed = jax.jit(
-        jax.shard_map(
+        shard_map(
             local, mesh=mesh,
             in_specs=(P(None, None, "sp"), P(None, None, "sp"), P(None, None, "sp")),
             out_specs=P(None, None, "sp"),
@@ -187,7 +188,7 @@ def test_ulysses_attention_matches_full(causal):
         return ulysses_attention(q, k, v, "sp", causal=causal, impl="xla")
 
     out = jax.jit(
-        jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+        shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                       out_specs=spec)
     )(q, k, v)
     full = flash_attention(q, k, v, causal=causal, impl="xla")
@@ -207,7 +208,7 @@ def test_ulysses_attention_grads_match_full():
             o = ulysses_attention(q, k, v, "sp", causal=True, impl="xla")
             return jax.lax.psum(jnp.sum(o ** 2), "sp")
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P()
         )(q, k, v)
 
@@ -230,7 +231,7 @@ def test_ulysses_rejects_indivisible_heads():
         return ulysses_attention(q, k, v, "sp", impl="xla")
 
     with pytest.raises(ValueError, match="heads"):
-        jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+        shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                       out_specs=spec)(q, k, v)
 
 
@@ -247,7 +248,7 @@ def test_ring_attention_grads_match_full():
             o = ring_attention(q, k, v, "sp", causal=True, impl="xla")
             return jax.lax.psum(jnp.sum(o ** 2), "sp")
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=P()
         )(q, k, v)
 

@@ -112,7 +112,9 @@ def test_evaluation_feeds_prob_to_auc(tmp_path):
         labels.append(int(item["labels"]))
     expect = _rank_sum_auc(probs, labels)
     assert abs(metrics.auc - expect) < 1e-4  # .auc rounds to 5dp
-    assert 0.0 < metrics.auc <= 1.0
+    # the untrained fixture net may perfectly anti-order this split (AUC 0.0
+    # exactly); a broken prob pipe is caught by the exactness assert above
+    assert 0.0 <= metrics.auc <= 1.0
 
 
 def test_auc_monitor_file_transport_lifecycle(tmp_path):

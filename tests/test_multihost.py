@@ -19,6 +19,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
 
+from coinstac_dinunet_tpu.utils.jax_compat import shard_map
 from coinstac_dinunet_tpu.parallel import hosts
 
 assert hosts.initialize_multihost(f"127.0.0.1:{port}", n, pid) is True
@@ -41,7 +42,7 @@ def site_sum(x):
     local = jax.lax.psum(x, "device")
     return jax.lax.psum(local, "site")
 
-fn = jax.jit(jax.shard_map(
+fn = jax.jit(shard_map(
     site_sum, mesh=mesh, in_specs=P("site", "device"), out_specs=P("site", "device"),
 ))
 # global value [[0,1],[2,3]] laid over (site, device); build it per-process

@@ -1,0 +1,84 @@
+"""Golden-file test of the local<->remote wire-key handshake (SURVEY §4 gap).
+
+``test_analysis_selfcheck.py`` proves the protocol statically (AST
+producer/consumer matching); this file proves it dynamically: one
+InProcessEngine run, asserting the EXACT key set each side puts on the wire
+at every protocol phase.  A key added, dropped, or renamed on either side —
+even one the static extractor can't resolve — changes these sets and fails
+here with a readable diff.
+"""
+import os
+
+from coinstac_dinunet_tpu.config.keys import LocalWire, RemoteWire
+from coinstac_dinunet_tpu.engine import InProcessEngine
+
+from test_trainer import XorDataset, XorTrainer
+
+# golden per-phase wire vocabularies, straight from the protocol design
+# (docs/ANALYSIS.md "protocol-conformance"): round 1 is the INIT_RUNS
+# handshake, round 2 the first dSGD train round.
+GOLDEN_SITE_ROUND1 = {"data_size", "mode", "phase", "shared_args"}
+GOLDEN_REMOTE_ROUND1 = {"global_modes", "global_runs", "phase"}
+GOLDEN_SITE_TRAIN = {"grad_weight", "grads_file", "mode", "phase", "reduce"}
+GOLDEN_REMOTE_TRAIN = {"avg_grads_file", "global_modes", "phase", "update"}
+
+
+def _engine(tmp_path, n_sites=2, per_site=16, **args):
+    base = dict(
+        task_id="xor", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+        batch_size=8, epochs=2, validation_epochs=1, learning_rate=5e-2,
+        input_shape=(2,), seed=11, patience=50,
+    )
+    base.update(args)
+    eng = InProcessEngine(
+        tmp_path, n_sites=n_sites, trainer_cls=XorTrainer,
+        dataset_cls=XorDataset, **base,
+    )
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(per_site):
+            with open(os.path.join(d, f"s_{i * per_site + j}"), "w") as f:
+                f.write("x")
+    return eng
+
+
+def test_handshake_golden_key_sets_per_round(tmp_path):
+    eng = _engine(tmp_path)
+
+    site_outs, remote_out = eng.step_round()
+    for s, out in site_outs.items():
+        assert set(out) == GOLDEN_SITE_ROUND1, f"{s} INIT_RUNS keys drifted"
+    assert set(remote_out) == GOLDEN_REMOTE_ROUND1
+
+    site_outs, remote_out = eng.step_round()
+    for s, out in site_outs.items():
+        assert set(out) == GOLDEN_SITE_TRAIN, f"{s} train-round keys drifted"
+    assert set(remote_out) == GOLDEN_REMOTE_TRAIN
+
+
+def test_every_wire_key_is_in_the_declared_vocabulary(tmp_path):
+    """Drive a full run to SUCCESS; every key either side ever produced must
+    be declared in config/keys.py (LocalWire/RemoteWire) — the same single
+    source of truth the static protocol-conformance rule enforces."""
+    eng = _engine(tmp_path)
+    local_vocab = {k.value for k in LocalWire}
+    remote_vocab = {k.value for k in RemoteWire}
+    seen_site, seen_remote = set(), set()
+    while not eng.success and eng.rounds < 200:
+        site_outs, remote_out = eng.step_round()
+        for out in site_outs.values():
+            seen_site |= set(out)
+        seen_remote |= set(remote_out)
+
+    assert eng.success, f"no SUCCESS after {eng.rounds} rounds"
+    assert seen_site <= local_vocab, (
+        f"undeclared site->aggregator keys: {sorted(seen_site - local_vocab)}"
+    )
+    assert seen_remote <= remote_vocab, (
+        f"undeclared aggregator->site keys: "
+        f"{sorted(seen_remote - remote_vocab)}"
+    )
+    # the run actually exercised the full protocol surface, not a fast-path
+    assert {"test_serializable", "train_serializable",
+            "validation_serializable"} <= seen_site
+    assert {"results_zip", "save_current_as_best"} <= seen_remote

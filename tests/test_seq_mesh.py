@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from coinstac_dinunet_tpu.utils.jax_compat import shard_map
 from coinstac_dinunet_tpu.engine import MeshEngine
 from coinstac_dinunet_tpu.models import SeqTrainer, SyntheticSeqDataset
 from coinstac_dinunet_tpu.models.transformer import SeqClassifier
@@ -59,7 +60,7 @@ def test_sp_model_matches_unsharded():
     msp = SeqClassifier(d_model=32, num_heads=4, num_layers=2, max_len=128,
                         sp_axis="sp")
     mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda p, xx: msp.apply(p, xx), mesh=mesh,
         in_specs=(P(), P(None, "sp", None)), out_specs=P(), check_vma=False,
     ))(params, jnp.asarray(x))
@@ -75,7 +76,7 @@ def test_sp_model_matches_unsharded():
         # shard_map grads come out sp× (replicated loss); pmean is exact
         return jax.tree_util.tree_map(lambda a: jax.lax.pmean(a, "sp"), g)
 
-    gsp = jax.jit(jax.shard_map(
+    gsp = jax.jit(shard_map(
         sp_grads, mesh=mesh, in_specs=(P(), P(None, "sp", None)),
         out_specs=P(), check_vma=False,
     ))(params, jnp.asarray(x))

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from coinstac_dinunet_tpu.utils.jax_compat import shard_map
 from coinstac_dinunet_tpu.engine import MeshEngine
 from coinstac_dinunet_tpu.models import SeqTrainer, SyntheticSeqDataset
 from coinstac_dinunet_tpu.models.transformer import SeqClassifier, TPDense
@@ -84,7 +85,7 @@ def test_tp_model_matches_unsharded():
         mtp = SeqClassifier(d_model=32, num_heads=4, num_layers=2,
                             max_len=64, tp_axis="tp")
         mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             lambda p, xx: mtp.apply(p, xx), mesh=mesh,
             in_specs=(P(), P()), out_specs=P(), check_vma=False,
         ))(params, jnp.asarray(x))
@@ -96,7 +97,7 @@ def test_tp_model_matches_unsharded():
             return jax.tree_util.tree_map(
                 lambda a: jax.lax.pmean(a, "tp"), g)
 
-        gtp = jax.jit(jax.shard_map(
+        gtp = jax.jit(shard_map(
             tp_grads, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
             check_vma=False,
         ))(params, jnp.asarray(x))
