@@ -10,6 +10,14 @@ Rule families (see ``docs/ANALYSIS.md``):
   nondeterministic-set hazards inside jit/shard_map-traced functions.
 - ``protocol-conformance`` — producer/consumer agreement of the
   local↔remote wire keys against the ``config/keys.py`` vocabulary.
+- ``sharding-unknown-axis`` / ``sharding-mesh-arity`` /
+  ``sharding-spec-arity`` / ``sharding-collective-scope`` /
+  ``sharding-axis-literal`` — mesh/axis/PartitionSpec conformance against
+  the ``config/keys.py`` ``MeshAxis`` vocabulary.
+- ``deep-*`` (opt-in ``--deep``) — an abstract-interpretation tier:
+  ``jax.eval_shape`` traces of registered entry points on the 8-device
+  virtual CPU platform (``analysis/deepcheck.py``; the only part of the
+  analyzer that imports JAX, and only when asked).
 
 CLI::
 
@@ -34,6 +42,14 @@ from .core import (  # noqa: F401
 )
 from .jax_api import JaxApiDriftRule, SYMBOL_TABLE, symbol_status  # noqa: F401
 from .protocol import ProtocolConformanceRule, load_vocabulary  # noqa: F401
+from .sharding import (  # noqa: F401
+    AxisLiteralRule,
+    CollectiveScopeRule,
+    MeshArityRule,
+    SpecArityRule,
+    UnknownAxisRule,
+    load_mesh_axes,
+)
 from .trace_hazards import (  # noqa: F401
     HostSyncRule,
     ImpureCallRule,
@@ -47,4 +63,6 @@ __all__ = [
     "filter_baselined", "JaxApiDriftRule", "SYMBOL_TABLE", "symbol_status",
     "ProtocolConformanceRule", "load_vocabulary", "HostSyncRule",
     "ImpureCallRule", "PyControlFlowRule", "SetIterationRule",
+    "UnknownAxisRule", "MeshArityRule", "SpecArityRule",
+    "CollectiveScopeRule", "AxisLiteralRule", "load_mesh_axes",
 ]

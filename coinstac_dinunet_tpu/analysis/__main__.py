@@ -23,7 +23,10 @@ def build_parser():
     )
     p.add_argument("paths", nargs="*", default=["coinstac_dinunet_tpu"],
                    help="files or directories to lint (default: the package)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default="text",
+                   help="'github' renders new findings as ::error workflow "
+                        "annotations (plus the text summary)")
     p.add_argument("--baseline", default=None,
                    help=f"baseline file (default: ./{DEFAULT_BASELINE} if present)")
     p.add_argument("--write-baseline", action="store_true",
@@ -36,7 +39,23 @@ def build_parser():
     p.add_argument("--list-rules", action="store_true")
     p.add_argument("--show-baselined", action="store_true",
                    help="also print findings matched by the baseline")
+    p.add_argument("--deep", action="store_true",
+                   help="also run the eval_shape deep-check pass over the "
+                        "registered entry points on an 8-device virtual CPU "
+                        "platform (imports JAX; see docs/ANALYSIS.md)")
+    p.add_argument("--deep-entries", default=None,
+                   help="comma-separated entry-point names for --deep "
+                        "(default: all registered)")
+    p.add_argument("--list-deep", action="store_true",
+                   help="list the registered deep-check entry points")
     return p
+
+
+def _github_escape(text):
+    """Escape a message for a GitHub workflow-command data section."""
+    return (
+        text.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    )
 
 
 def main(argv=None):
@@ -53,6 +72,43 @@ def main(argv=None):
         for r in sorted(rules, key=lambda r: r.id):
             print(f"{r.id}: {r.doc}")
         return 0
+    if args.list_deep:
+        from .deepcheck import list_entry_points
+
+        for name, path in list_entry_points().items():
+            print(f"{name}: {path}")
+        return 0
+    if args.deep_entries and not args.deep:
+        print("--deep-entries requires --deep", file=sys.stderr)
+        return 2
+
+    deep_names = None
+    if args.deep_entries:
+        # validate BEFORE the static scan runs — a typo'd entry name is a
+        # usage error and shouldn't cost a whole-package lint first.
+        # (importing deepcheck only loads the registry; the builders defer
+        # their JAX imports until run_deepcheck calls them)
+        from .deepcheck import list_entry_points
+
+        deep_names = [n.strip() for n in args.deep_entries.split(",") if n.strip()]
+        if not deep_names:
+            # an empty list would fall through as falsy and run the FULL
+            # registry — the opposite of the narrowing the user asked for
+            print("--deep-entries given but no entry names parsed",
+                  file=sys.stderr)
+            return 2
+        known = set(list_entry_points())
+        unknown = sorted(set(deep_names) - known)
+        if unknown:
+            print(f"unknown deep entry point(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+    if args.write_baseline and deep_names is not None:
+        print("--write-baseline with --deep-entries would drop the other "
+              "entry points' baselined deep findings; refresh over the full "
+              "registry (--deep without --deep-entries) instead",
+              file=sys.stderr)
+        return 2
 
     rule_ids = args.rules.split(",") if args.rules else None
     if rule_ids:
@@ -74,14 +130,39 @@ def main(argv=None):
 
     findings, errors = run_lint(args.paths, rules=rules, rule_ids=rule_ids)
 
+    if args.deep:
+        # lazy import: only --deep pays the JAX import (and it sets up the
+        # 8-device virtual CPU platform itself when the backend is fresh)
+        from .deepcheck import run_deepcheck
+
+        findings = findings + run_deepcheck(deep_names)
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
     baseline_path = args.baseline
     if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
         baseline_path = DEFAULT_BASELINE
 
     if args.write_baseline:
         out = baseline_path or DEFAULT_BASELINE
-        write_baseline(out, findings)
-        print(f"wrote {len(findings)} finding(s) to {out}")
+        if args.deep and any(f.rule == "deep-config" for f in findings):
+            # the deep tier never actually ran — writing now would drop its
+            # accepted entries AND baseline the platform misconfiguration
+            print("--write-baseline refused: the deep tier could not run "
+                  "(deep-config: virtual device platform unavailable) — fix "
+                  "XLA_FLAGS or refresh without --deep", file=sys.stderr)
+            return 2
+        extra = ()
+        if not args.deep and os.path.exists(out):
+            # the deep tier didn't run, so this refresh knows nothing about
+            # its findings — carry the accepted deep-* entries over instead
+            # of silently dropping them from the rewritten file
+            with open(out, "r", encoding="utf-8") as f:
+                old = json.load(f)
+            extra = [e for e in old.get("findings", [])
+                     if e.get("rule", "").startswith("deep-")]
+        write_baseline(out, findings, extra_entries=extra)
+        kept = f" (+{len(extra)} deep-* entr{'y' if len(extra) == 1 else 'ies'} kept)" if extra else ""
+        print(f"wrote {len(findings)} finding(s) to {out}{kept}")
         return 0
 
     baseline_counts = {}
@@ -96,6 +177,18 @@ def main(argv=None):
             "errors": [{"path": p, "error": e} for p, e in errors],
         }
         print(json.dumps(payload, indent=2))
+    elif args.format == "github":
+        # workflow annotations: GitHub surfaces these inline on the PR diff
+        for f in new:
+            print(f"::error file={f.path},line={f.line},col={f.col + 1},"
+                  f"title=dinulint {f.rule}::{_github_escape(f.message)}")
+        for path, err in errors:
+            print(f"::error file={path},line=1,title=dinulint parse error::"
+                  f"{_github_escape(err)}")
+        summary = f"{len(new)} new finding(s), {len(baselined)} baselined"
+        if errors:
+            summary += f", {len(errors)} parse error(s)"
+        print(summary)
     else:
         for f in new:
             print(f.render())

@@ -138,7 +138,7 @@ def register_rule(cls):
 def default_rules():
     """Fresh instances of every registered rule, importing the built-in rule
     modules on first use (registration happens at import)."""
-    from . import jax_api, protocol, trace_hazards  # noqa: F401 (register)
+    from . import jax_api, protocol, sharding, trace_hazards  # noqa: F401 (register)
 
     return [cls() for _, cls in sorted(_REGISTRY.items())]
 
@@ -228,10 +228,17 @@ def load_baseline(path):
     return counts
 
 
-def write_baseline(path, findings):
+def write_baseline(path, findings, extra_entries=()):
+    """Write the baseline for ``findings``; ``extra_entries`` are existing
+    baseline entry dicts to carry over verbatim — the CLI uses this to
+    preserve a tier's accepted findings when a refresh didn't run that tier
+    (a static-only ``--write-baseline`` must not drop ``deep-*`` entries)."""
     grouped = {}
     for f in findings:
         grouped[f.fingerprint()] = grouped.get(f.fingerprint(), 0) + 1
+    for entry in extra_entries:
+        fp = (entry["rule"], entry["path"], entry["message"])
+        grouped[fp] = grouped.get(fp, 0) + int(entry.get("count", 1))
     entries = [
         {"rule": rule, "path": p, "message": msg, "count": n}
         for (rule, p, msg), n in sorted(grouped.items())

@@ -1,0 +1,486 @@
+"""deep-*: the opt-in abstract-interpretation tier (``--deep``).
+
+Pure AST can prove an axis name exists — it cannot prove that the trainer's
+compiled step actually *traces*: that every matmul's shapes agree, that the
+``shard_map`` specs divide the arrays they shard, that a reducer's factor
+shapes survive the collective round-trip.  This tier closes that gap without
+ever running a real computation: each registered **entry point** builds one
+of the package's compiled functions and traces it with
+:func:`jax.eval_shape` against :class:`jax.ShapeDtypeStruct` inputs on the
+same 8-device virtual CPU platform tier-1 uses
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``) — milliseconds of
+abstract interpretation instead of minutes of compilation, and no TPU.
+
+Reported findings (ordinary :class:`~.core.Finding` objects, so they flow
+through the same baseline/suppression machinery as the static tiers):
+
+- ``deep-entry-build`` — the entry point's builder raised: the public
+  constructor path itself is broken (import error, bad config plumbing).
+- ``deep-eval-shape`` — ``jax.eval_shape`` raised: a shape/dtype/sharding
+  error somewhere in the traced computation.
+- ``deep-recompile`` — tracing the SAME abstract inputs twice produced
+  different output structures: the function bakes mutable host state into
+  its trace, so every real call under jit is a cache miss (recompilation)
+  — and under multi-controller, a cross-host program divergence.
+- ``deep-config`` — the platform could not provide the required virtual
+  device count (reported, never crashes the run).
+
+This is the only module in ``analysis/`` that imports JAX, and it is only
+imported when ``--deep`` is requested — the static tiers stay JAX-free and
+millisecond-fast.
+
+Registering an entry point::
+
+    from coinstac_dinunet_tpu.analysis.deepcheck import register_entry_point
+
+    @register_entry_point("my-step", "coinstac_dinunet_tpu/foo/bar.py")
+    def _entry_my_step():
+        fn = build_my_step(...)                 # the callable under test
+        args = (jax.ShapeDtypeStruct(...), ...)  # abstract inputs
+        return fn, args
+
+The builder runs lazily inside ``run_deepcheck``; raising is itself a
+finding, not a crash.
+"""
+import dataclasses
+
+from .core import Finding
+
+#: virtual devices the registry's meshes assume (= the tier-1 test platform)
+REQUIRED_DEVICES = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    """One registered deep-check target."""
+
+    name: str
+    path: str      # repo-relative source path findings anchor to
+    build: object  # () -> (fn, args) with args abstract ShapeDtypeStructs
+
+
+DEEP_REGISTRY = {}
+
+
+def register_entry_point(name, path):
+    """Decorator registering ``build`` under ``name``; findings anchor to
+    ``path`` (the module whose compiled artifact the entry exercises)."""
+
+    def deco(build):
+        DEEP_REGISTRY[name] = EntryPoint(name, path, build)
+        return build
+
+    return deco
+
+
+def ensure_virtual_devices(n=REQUIRED_DEVICES):
+    """Force the n-device virtual CPU platform (same stand-in tier-1 uses).
+
+    Effective only while the JAX backend is still uninitialized —
+    ``XLA_FLAGS`` is read at backend creation, not at import — so the CLI
+    can set it up itself; under pytest the conftest has already done both.
+    Returns the live device count.
+    """
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    import jax
+
+    try:
+        # the pinned container force-registers a TPU plugin via
+        # sitecustomize; re-pin to pure CPU (no-op if already initialized)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — backend already up: verify below
+        pass
+    return len(jax.devices())
+
+
+def _first_line(exc, limit=240):
+    text = f"{type(exc).__name__}: {exc}"
+    line = text.splitlines()[0] if text.splitlines() else text
+    return line[:limit] + ("…" if len(line) > limit else "")
+
+
+def _structure_signature(tree):
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (
+        str(treedef),
+        tuple((tuple(l.shape), str(l.dtype)) for l in leaves),
+    )
+
+
+def run_deepcheck(names=None):
+    """eval_shape-trace the registered entry points; returns findings.
+
+    ``names`` filters the registry (None = all).  Every failure mode is a
+    finding — the runner itself never raises.
+    """
+    _register_builtin_entries()
+    findings = []
+    have = ensure_virtual_devices()
+    if have < REQUIRED_DEVICES:
+        findings.append(Finding(
+            rule="deep-config", path="coinstac_dinunet_tpu/analysis/deepcheck.py",
+            line=1, col=0,
+            message=f"deep check needs {REQUIRED_DEVICES} virtual devices but "
+                    f"the initialized JAX backend has {have} — set XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=8 before anything "
+                    "imports jax",
+        ))
+        return findings
+    import jax
+
+    # the live jit wrapper type (version-portable: compare against what the
+    # installed jax.jit actually returns)
+    jit_type = type(jax.jit(lambda: None))
+
+    def unjit(fn):
+        """Peel TOP-LEVEL jit wrappers so both traces are real: a jit
+        object's own trace cache (keyed on the jit object + avals) would
+        serve the second trace from the first, hiding host-state
+        dependence.  Only actual jit objects are peeled — shard_map-wrapped
+        functions also carry ``__wrapped__``, and peeling those would trace
+        the unsharded body with its collectives unbound."""
+        while isinstance(fn, jit_type) and hasattr(fn, "__wrapped__"):
+            fn = fn.__wrapped__
+        return fn
+
+    wanted = set(names) if names else None
+    for name in sorted(DEEP_REGISTRY):
+        if wanted is not None and name not in wanted:
+            continue
+        ep = DEEP_REGISTRY[name]
+        try:
+            fn, args = ep.build()
+        except Exception as exc:  # noqa: BLE001 — any build failure is a finding
+            findings.append(Finding(
+                rule="deep-entry-build", path=ep.path, line=1, col=0,
+                message=f"entry '{name}': builder raised {_first_line(exc)}",
+            ))
+            continue
+        fn = unjit(fn)
+        # each trace goes through a FRESH wrapper: eval_shape rides the jit
+        # trace cache (keyed on function identity), so tracing the same fn
+        # object twice would silently reuse the first trace and hide any
+        # host-state dependence the second trace is meant to expose
+        try:
+            out = jax.eval_shape(lambda *a: fn(*a), *args)
+        except Exception as exc:  # noqa: BLE001 — trace errors are the product
+            findings.append(Finding(
+                rule="deep-eval-shape", path=ep.path, line=1, col=0,
+                message=f"entry '{name}': eval_shape failed with "
+                        f"{_first_line(exc)}",
+            ))
+            continue
+        try:
+            out2 = jax.eval_shape(lambda *a: fn(*a), *args)
+        except Exception as exc:  # noqa: BLE001
+            findings.append(Finding(
+                rule="deep-recompile", path=ep.path, line=1, col=0,
+                message=f"entry '{name}': second trace of identical inputs "
+                        f"raised {_first_line(exc)} — the function consumes "
+                        "host state across traces",
+            ))
+            continue
+        if _structure_signature(out) != _structure_signature(out2):
+            findings.append(Finding(
+                rule="deep-recompile", path=ep.path, line=1, col=0,
+                message=f"entry '{name}': two traces of identical inputs "
+                        "produced different output structures — every jit "
+                        "call will miss the cache (and multi-host programs "
+                        "diverge)",
+            ))
+    return findings
+
+
+def list_entry_points():
+    """name -> path of every registered entry (builtin registration forced)."""
+    _register_builtin_entries()
+    return {name: ep.path for name, ep in sorted(DEEP_REGISTRY.items())}
+
+
+# --------------------------------------------------------------------------
+# Built-in registry: the package's compiled surfaces.
+# --------------------------------------------------------------------------
+_BUILTINS_DONE = False
+
+
+def _sds(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+
+def _abstract_tree(tree):
+    """Concrete pytree -> matching ShapeDtypeStruct pytree."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), tree
+    )
+
+
+def _make_deep_trainer():
+    """Minimal concrete NNTrainer (MLP classifier, no data handle) — enough
+    state for the step builders to close over."""
+    import flax.linen as fnn
+    import jax.numpy as jnp
+
+    from ..metrics import cross_entropy
+    from ..nn import NNTrainer
+
+    class _MLP(fnn.Module):
+        @fnn.compact
+        def __call__(self, x):
+            x = fnn.relu(fnn.Dense(8)(x))
+            return fnn.Dense(2)(x)
+
+    class _DeepTrainer(NNTrainer):
+        def _init_nn_model(self):
+            self.nn["net"] = _MLP()
+
+        def iteration(self, params, batch, rng=None):
+            logits = self.nn["net"].apply(params["net"], batch["inputs"])
+            mask = batch.get("_mask")
+            loss = cross_entropy(logits, batch["labels"], mask=mask)
+            pred = jnp.argmax(logits, axis=-1)
+            return {"loss": loss, "pred": pred, "true": batch["labels"]}
+
+    trainer = _DeepTrainer(cache={
+        "input_shape": (4,), "learning_rate": 1e-2, "seed": 0,
+        "donate_buffers": False, "local_data_parallel": False,
+    })
+    trainer.init_nn()
+    return trainer
+
+
+def _register_builtin_entries():
+    global _BUILTINS_DONE
+    if _BUILTINS_DONE:
+        return
+    _BUILTINS_DONE = True
+
+    @register_entry_point(
+        "trainer-train-step", "coinstac_dinunet_tpu/nn/basetrainer.py"
+    )
+    def _entry_trainer_train():
+        trainer = _make_deep_trainer()
+        metrics_shell, averages_shell = trainer._metrics_shell()
+
+        def step(ts, stacked):
+            grads, aux = trainer._grads_uncompiled(
+                ts, stacked, metrics_shell, averages_shell
+            )
+            ts = trainer._apply_updates(ts, grads)
+            return ts, aux
+
+        ts = _abstract_tree(trainer.train_state)
+        stacked = {  # k=2 micro-batches exercises the grad-accumulation scan
+            "inputs": _sds((2, 4, 4), "float32"),
+            "labels": _sds((2, 4), "int32"),
+        }
+        return step, (ts, stacked)
+
+    @register_entry_point(
+        "trainer-eval-step", "coinstac_dinunet_tpu/nn/basetrainer.py"
+    )
+    def _entry_trainer_eval():
+        trainer = _make_deep_trainer()
+        metrics_shell, averages_shell = trainer._metrics_shell()
+
+        def ev(ts, batch):
+            it = trainer.iteration(ts.params, batch, None)
+            return trainer._step_outputs(it, batch, metrics_shell, averages_shell)
+
+        ts = _abstract_tree(trainer.train_state)
+        batch = {
+            "inputs": _sds((4, 4), "float32"),
+            "labels": _sds((4,), "int32"),
+        }
+        return ev, (ts, batch)
+
+    @register_entry_point(
+        "trainer-dp-train-step", "coinstac_dinunet_tpu/nn/basetrainer.py"
+    )
+    def _entry_trainer_dp():
+        trainer = _make_deep_trainer()
+        step = trainer._build_dp_step(
+            REQUIRED_DEVICES, apply_updates=True, donate=()
+        )
+        ts = _abstract_tree(trainer.train_state)
+        stacked = {  # batch dim shards over the 8-device axis
+            "inputs": _sds((1, 8, 4), "float32"),
+            "labels": _sds((1, 8), "int32"),
+        }
+        return step, (ts, stacked)
+
+    @register_entry_point(
+        "mesh-federation-dsgd-step", "coinstac_dinunet_tpu/parallel/mesh.py"
+    )
+    def _entry_mesh_dsgd():
+        import jax
+
+        from ..parallel.mesh import MeshFederation
+
+        trainer = _make_deep_trainer()
+        fed = MeshFederation(
+            trainer, n_sites=REQUIRED_DEVICES,
+            devices=jax.devices()[:REQUIRED_DEVICES],
+        )
+        step = fed._build_step()
+        ts = _abstract_tree(trainer.train_state)
+        stacked = {  # (site, k, B, F)
+            "inputs": _sds((8, 1, 4, 4), "float32"),
+            "labels": _sds((8, 1, 4), "int32"),
+        }
+        return step, (ts, stacked, {})
+
+    @register_entry_point(
+        "powersgd-reducer", "coinstac_dinunet_tpu/parallel/powersgd.py"
+    )
+    def _entry_powersgd():
+        from ..ops import orthogonalize
+        from ..parallel.powersgd import compress_P, compress_Q, reconstruct
+
+        def round_trip(M, Q):
+            phat = orthogonalize(compress_P(M, Q))
+            qn = compress_Q(M, phat)
+            return reconstruct(phat, qn)
+
+        return round_trip, (_sds((64, 32), "float32"), _sds((32, 4), "float32"))
+
+    @register_entry_point(
+        "rankdad-reducer", "coinstac_dinunet_tpu/parallel/rankdad.py"
+    )
+    def _entry_rankdad():
+        import functools
+
+        from ..ops import power_iteration_BC
+
+        fn = functools.partial(power_iteration_BC, rank=4, iterations=3)
+        return fn, (
+            _sds((32, 16), "float32"),
+            _sds((32, 8), "float32"),
+            _sds((2,), "uint32"),  # PRNG key
+        )
+
+    @register_entry_point(
+        "ring-attention", "coinstac_dinunet_tpu/parallel/ring_attention.py"
+    )
+    def _entry_ring():
+        import functools
+
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from ..config.keys import MeshAxis
+        from ..parallel.ring_attention import ring_attention
+        from ..utils.jax_compat import shard_map
+
+        mesh = Mesh(
+            np.array(jax.devices()[:REQUIRED_DEVICES]), (MeshAxis.SP,)
+        )
+        spec = P(None, None, MeshAxis.SP, None)
+        fn = shard_map(
+            functools.partial(
+                ring_attention, axis_name=MeshAxis.SP, causal=True
+            ),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        )
+        q = _sds((2, 4, 64, 8), "float32")
+        return fn, (q, q, q)
+
+    @register_entry_point(
+        "ulysses-attention", "coinstac_dinunet_tpu/parallel/ring_attention.py"
+    )
+    def _entry_ulysses():
+        import functools
+
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from ..config.keys import MeshAxis
+        from ..parallel.ring_attention import ulysses_attention
+        from ..utils.jax_compat import shard_map
+
+        mesh = Mesh(
+            np.array(jax.devices()[:REQUIRED_DEVICES]), (MeshAxis.SP,)
+        )
+        spec = P(None, None, MeshAxis.SP, None)
+        fn = shard_map(
+            functools.partial(ulysses_attention, axis_name=MeshAxis.SP),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        )
+        q = _sds((2, 8, 64, 8), "float32")  # heads divisible by the 8 ranks
+        return fn, (q, q, q)
+
+    @register_entry_point(
+        "pipeline-train-step", "coinstac_dinunet_tpu/parallel/pipeline.py"
+    )
+    def _entry_pipeline():
+        from ..parallel.pipeline import build_pp_mesh, make_pp_train_step
+        from ..parallel.sequence import TSPConfig, init_tsp_params
+        import jax
+
+        from ..parallel.pipeline import stack_layers
+
+        cfg = TSPConfig(num_features=4, num_classes=2, d_model=16,
+                        num_heads=4, num_layers=4, max_len=64)
+        mesh = build_pp_mesh(pp=4, dp=2)
+        step = make_pp_train_step(cfg, mesh)
+        params = _abstract_tree(jax.eval_shape(
+            lambda k: stack_layers(init_tsp_params(k, cfg)),
+            jax.random.PRNGKey(0),
+        ))
+        # per-dp-rank batch 4 divides the 4 microbatches exactly
+        return step, (params, _sds((8, 16, 4), "float32"), _sds((8,), "int32"))
+
+    @register_entry_point(
+        "tsp-train-step", "coinstac_dinunet_tpu/parallel/sequence.py"
+    )
+    def _entry_tsp():
+        import jax
+
+        from ..parallel.sequence import (
+            TSPConfig, build_tsp_mesh, init_tsp_params, make_tsp_train_step,
+        )
+
+        cfg = TSPConfig(num_features=4, num_classes=2, d_model=16,
+                        num_heads=4, num_layers=2, max_len=64)
+        mesh = build_tsp_mesh(dp=2, tp=2, sp=2, ep=1)
+        step = make_tsp_train_step(cfg, mesh)
+        params = _abstract_tree(
+            jax.eval_shape(lambda k: init_tsp_params(k, cfg),
+                           jax.random.PRNGKey(0))
+        )
+        return step, (params, _sds((4, 16, 4), "float32"), _sds((4,), "int32"))
+
+    @register_entry_point(
+        "tsp-moe-train-step", "coinstac_dinunet_tpu/parallel/sequence.py"
+    )
+    def _entry_tsp_moe():
+        import jax
+
+        from ..parallel.sequence import (
+            TSPConfig, build_tsp_mesh, init_tsp_params, make_tsp_train_step,
+        )
+
+        cfg = TSPConfig(num_features=4, num_classes=2, d_model=16,
+                        num_heads=4, num_layers=1, max_len=64, num_experts=2)
+        mesh = build_tsp_mesh(dp=2, tp=1, sp=2, ep=2)
+        step = make_tsp_train_step(cfg, mesh)
+        params = _abstract_tree(
+            jax.eval_shape(lambda k: init_tsp_params(k, cfg),
+                           jax.random.PRNGKey(0))
+        )
+        return step, (params, _sds((4, 16, 4), "float32"), _sds((4,), "int32"))

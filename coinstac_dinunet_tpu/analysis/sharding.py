@@ -1,0 +1,638 @@
+"""sharding-*: mesh/axis/PartitionSpec conformance across the parallel layer.
+
+Axis names ("site", "sp", "pp", …) and PartitionSpecs are the package's
+second wire protocol: a mesh defines the vocabulary on one line of
+``parallel/*.py`` and dozens of consumers — ``PartitionSpec`` literals,
+collective ``axis_name`` arguments, ``shard_map`` ``in_specs``/``out_specs``
+— must agree with it.  Nothing at runtime checks the agreement early: a
+typo'd axis or a collective outside its ``shard_map`` only surfaces at trace
+time on a multi-device mesh, often only on the real pod.  These rules make
+the whole class a millisecond-scale static finding:
+
+- ``sharding-unknown-axis`` — an axis name (in a mesh definition, spec,
+  collective, or ``*_axis``/``axis_name`` kwarg) that the
+  :class:`~coinstac_dinunet_tpu.config.keys.MeshAxis` vocabulary does not
+  declare: the typo case.
+- ``sharding-mesh-arity`` — a ``Mesh(arr.reshape(a, b), names)`` whose
+  axis-name tuple length differs from the device-array rank, or a mesh
+  naming the same axis twice.
+- ``sharding-spec-arity`` — a ``PartitionSpec`` that uses one axis twice
+  (JAX rejects it at trace time), or combines axes that no mesh defined
+  anywhere in the scanned project defines together (a spec that can never
+  match its constructing mesh).
+- ``sharding-collective-scope`` — a collective (``psum``/``all_gather``/
+  ``ppermute``/…) over a *statically known* axis name inside a function
+  that is not (module-locally) connected to any ``shard_map``/``pmap``:
+  outside a binding context the axis is unbound and the call raises at
+  trace time.
+- ``sharding-axis-literal`` — a bare string literal in an axis position
+  where the :class:`MeshAxis` constant exists: the vocabulary is only a
+  single source of truth if call sites actually go through it.
+
+Axis resolution understands both spellings — ``"site"`` (a *bare* literal)
+and ``MeshAxis.SITE`` (the sanctioned constant, resolved by *parsing*
+``config/keys.py``, never importing it) — so the conformance rules keep
+checking migrated call sites while the literal rule enforces the migration.
+
+Scope analysis for ``sharding-collective-scope`` is deliberately
+conservative (module-local, so no import graph is needed):
+
+- functions passed to ``shard_map``/``pmap``/``pallas_call`` (directly,
+  via ``functools.partial``, or as a decorator) are *connected*;
+- connectivity propagates through module-local name references — a helper
+  a connected function mentions (``_site_mean``, ``self._site_weight``)
+  is connected too, as is anything nested inside a connected body;
+- a function *returned* by its enclosing function escapes local analysis
+  (the hook-factory idiom: ``_intra_grad_reduce`` returning
+  ``sp_grad_reduce``) and is never flagged;
+- collectives whose axis is dynamic (a parameter, ``self.tp_axis``) are
+  never flagged — the binding obligation is the caller's.
+"""
+import ast
+import os
+
+from .core import Finding, ProjectRule, register_rule, dotted_name
+
+MESH_AXIS_CLASS = "MeshAxis"
+
+#: callables that *define* a mesh: last dotted component -> index of the
+#: axis-names argument.
+_MESH_CTORS = {"Mesh": 1, "make_mesh": 1}
+
+#: callables that *consume* axis names as their n-th positional argument
+#: (kwarg spelling: ``axis_name``).
+_COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "all_gather": 1,
+    "ppermute": 1, "all_to_all": 1, "psum_scatter": 1, "pshuffle": 1,
+    "pswapaxes": 1, "axis_index": 0, "axis_size": 0,
+}
+
+_SPEC_CTORS = {"P", "PartitionSpec"}
+_TRACER_SEEDS = {"shard_map", "pmap", "pallas_call"}
+_AXIS_KWARGS = {"axis_name", "axis_names"}
+
+
+def _keys_module_path():
+    return os.path.normpath(
+        os.path.join(os.path.dirname(__file__), "..", "config", "keys.py")
+    )
+
+
+def load_mesh_axes(keys_source=None):
+    """Parse ``config/keys.py`` (source text or the package's own copy) into
+    the ``{member: value}`` map of the :class:`MeshAxis` vocabulary."""
+    if keys_source is None:
+        with open(_keys_module_path(), "r", encoding="utf-8") as f:
+            keys_source = f.read()
+    tree = ast.parse(keys_source)
+    axes = {}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == MESH_AXIS_CLASS:
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)
+                ):
+                    axes[stmt.targets[0].id] = stmt.value.value
+    return axes
+
+
+def _last(name):
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+class _AxisUse:
+    """One statically-resolved axis name at a source position."""
+
+    __slots__ = ("value", "node", "kind", "bare")
+
+    def __init__(self, value, node, kind, bare):
+        self.value = value    # resolved axis string
+        self.node = node      # ast node carrying the position
+        self.kind = kind      # 'mesh' | 'spec' | 'collective' | 'axis-kwarg'
+        self.bare = bare      # True for a raw string literal
+
+
+class _MeshDef:
+    __slots__ = ("axes", "node", "ctor_rank")
+
+    def __init__(self, axes, node, ctor_rank):
+        self.axes = axes          # tuple of resolved names (None = dynamic)
+        self.node = node
+        self.ctor_rank = ctor_rank  # device-array rank when inferable
+
+
+class _SpecUse:
+    __slots__ = ("entries", "node", "partial")
+
+    def __init__(self, entries, node, partial):
+        self.entries = entries    # flat list of resolved axis names
+        self.node = node
+        self.partial = partial    # True when some entries were dynamic
+
+    def axes(self):
+        return [e for e in self.entries if e is not None]
+
+
+class _CollectiveUse:
+    __slots__ = ("axes", "node", "fn_chain")
+
+    def __init__(self, axes, node, fn_chain):
+        self.axes = axes          # resolved axis names (may be empty)
+        self.node = node
+        self.fn_chain = fn_chain  # enclosing FunctionDef nodes, innermost first
+
+
+class _ModuleShardingInfo:
+    def __init__(self):
+        self.uses = []            # [_AxisUse]
+        self.meshes = []          # [_MeshDef]
+        self.specs = []           # [_SpecUse]
+        self.collectives = []     # [_CollectiveUse]
+        self.connected = set()    # FunctionDef nodes reachable from shard_map
+        self.escaped = set()      # FunctionDef nodes returned by their parent
+
+
+def _resolve_axis(node, axis_members):
+    """expr -> (axis string, bare) or None when dynamic/unresolvable.
+
+    Accepts a raw string literal or a ``MeshAxis.X`` attribute chain (any
+    prefix: ``keys.MeshAxis.X`` resolves too).
+    """
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, str):
+            return node.value, True
+        return None
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    parts.reverse()
+    if len(parts) >= 2 and parts[-2] == MESH_AXIS_CLASS:
+        value = axis_members.get(parts[-1])
+        if value is not None:
+            return value, False
+    return None
+
+
+def _resolve_axis_seq(node, axis_members):
+    """Tuple/List of axis exprs -> (entries, fully_resolved).
+
+    Entries are resolved ``_resolve_axis`` results (``(value, bare)``) or
+    ``None`` placeholders for ``None`` constants; dynamic entries flip
+    ``fully_resolved`` off but keep the resolvable neighbors.
+    """
+    entries, full = [], True
+    for elt in node.elts:
+        if isinstance(elt, ast.Constant) and elt.value is None:
+            entries.append(None)
+            continue
+        if isinstance(elt, (ast.Tuple, ast.List)):
+            sub, sub_full = _resolve_axis_seq(elt, axis_members)
+            entries.extend(sub)
+            full = full and sub_full
+            continue
+        hit = _resolve_axis(elt, axis_members)
+        if hit is None:
+            full = False
+            entries.append(None)
+        else:
+            entries.append(hit)
+    return entries, full
+
+
+class _Collector(ast.NodeVisitor):
+    def __init__(self, axis_members):
+        self.axis_members = axis_members
+        self.info = _ModuleShardingInfo()
+        self.fn_stack = []
+        self.defs_by_name = {}
+
+    # ------------------------------------------------------------- structure
+    def visit_FunctionDef(self, node):
+        self.defs_by_name.setdefault(node.name, []).append(node)
+        self.fn_stack.append(node)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Return(self, node):
+        # the hook-factory escape: a def whose name is returned by its
+        # enclosing function leaves local analysis
+        if node.value is not None and self.fn_stack:
+            parent = self.fn_stack[-1]
+            names = {
+                n.id for n in ast.walk(node.value) if isinstance(n, ast.Name)
+            }
+            for name in names:
+                for d in self.defs_by_name.get(name, []):
+                    if d is not parent:
+                        self.info.escaped.add(d)
+        self.generic_visit(node)
+
+    # ----------------------------------------------------------------- calls
+    def _record_use(self, resolved, node, kind):
+        value, bare = resolved
+        self.info.uses.append(_AxisUse(value, node, kind, bare))
+
+    def _record_axis_arg(self, arg, kind):
+        """One axis argument: a single name or a tuple of names; uses anchor
+        to the innermost resolvable node.  Returns the resolved axis
+        strings."""
+        out = []
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            for elt in arg.elts:
+                hit = _resolve_axis(elt, self.axis_members)
+                if hit is not None:
+                    self._record_use(hit, elt, kind)
+                    out.append(hit[0])
+        else:
+            hit = _resolve_axis(arg, self.axis_members)
+            if hit is not None:
+                self._record_use(hit, arg, kind)
+                out.append(hit[0])
+        return out
+
+    def _handle_mesh(self, call, axes_ix):
+        axes_arg = None
+        if len(call.args) > axes_ix:
+            axes_arg = call.args[axes_ix]
+        else:
+            for kw in call.keywords:
+                if kw.arg == "axis_names":
+                    axes_arg = kw.value
+        if axes_arg is None:
+            return
+        if isinstance(axes_arg, (ast.Tuple, ast.List)):
+            axes = []
+            for elt in axes_arg.elts:
+                hit = _resolve_axis(elt, self.axis_members)
+                if hit is None:
+                    axes.append(None)
+                else:
+                    self._record_use(hit, elt, "mesh")
+                    axes.append(hit[0])
+            self.info.meshes.append(
+                _MeshDef(tuple(axes), call, self._ctor_rank(call))
+            )
+        else:
+            hit = _resolve_axis(axes_arg, self.axis_members)
+            if hit is not None:  # Mesh(devs, "x") single-axis spelling
+                self._record_use(hit, axes_arg, "mesh")
+                self.info.meshes.append(
+                    _MeshDef((hit[0],), call, self._ctor_rank(call))
+                )
+
+    @staticmethod
+    def _ctor_rank(call):
+        """Rank of the device array when the first argument is a visible
+        ``....reshape(a, b, ...)`` call (or ``make_mesh``'s shape tuple)."""
+        if not call.args:
+            return None
+        first = call.args[0]
+        if _last(dotted_name(call.func, require_name_root=False)) == "make_mesh":
+            if isinstance(first, (ast.Tuple, ast.List)):
+                return len(first.elts)
+            return None
+        if (
+            isinstance(first, ast.Call)
+            and isinstance(first.func, ast.Attribute)
+            and first.func.attr == "reshape"
+        ):
+            args = first.args
+            if len(args) == 1 and isinstance(args[0], (ast.Tuple, ast.List)):
+                return len(args[0].elts)
+            if args and all(not isinstance(a, ast.Starred) for a in args):
+                return len(args)
+        return None
+
+    def _handle_spec(self, call):
+        entries, full = [], True
+        for arg in call.args:
+            if isinstance(arg, ast.Starred):
+                # P(*dynamic): record whatever axis tokens are visible, skip
+                # the structural checks
+                for sub in ast.walk(arg):
+                    hit = _resolve_axis(sub, self.axis_members)
+                    if hit is not None:
+                        self._record_use(hit, sub, "spec")
+                full = False
+                continue
+            if isinstance(arg, ast.Constant) and arg.value is None:
+                entries.append(None)
+                continue
+            if isinstance(arg, (ast.Tuple, ast.List)):
+                sub, sub_full = _resolve_axis_seq(arg, self.axis_members)
+                for hit in sub:
+                    if hit is not None:
+                        self._record_use(hit, arg, "spec")
+                        entries.append(hit[0])
+                full = full and sub_full
+                continue
+            hit = _resolve_axis(arg, self.axis_members)
+            if hit is None:
+                entries.append(None)
+                full = False
+            else:
+                self._record_use(hit, arg, "spec")
+                entries.append(hit[0])
+        self.info.specs.append(_SpecUse(entries, call, not full))
+
+    def _handle_collective(self, call, axis_ix):
+        arg = None
+        if len(call.args) > axis_ix:
+            arg = call.args[axis_ix]
+        else:
+            for kw in call.keywords:
+                if kw.arg == "axis_name":
+                    arg = kw.value
+        if arg is None:
+            return
+        axes = self._record_axis_arg(arg, "collective")
+        self.info.collectives.append(
+            _CollectiveUse(axes, call, tuple(reversed(self.fn_stack)))
+        )
+
+    def visit_Call(self, call):
+        name = dotted_name(call.func, require_name_root=False)
+        last = _last(name)
+        consumed = set()  # kwargs the dedicated handlers already recorded
+        if last in _MESH_CTORS:
+            self._handle_mesh(call, _MESH_CTORS[last])
+            consumed.add("axis_names")
+        elif last in _SPEC_CTORS:
+            self._handle_spec(call)
+        elif last in _COLLECTIVES:
+            self._handle_collective(call, _COLLECTIVES[last])
+            consumed.add("axis_name")
+        for kw in call.keywords:
+            if kw.arg in consumed:
+                continue
+            if kw.arg and (kw.arg in _AXIS_KWARGS or kw.arg.endswith("_axis")):
+                if isinstance(kw.value, (ast.Constant, ast.Tuple, ast.List)) \
+                        or isinstance(kw.value, ast.Attribute):
+                    # ints (jnp axis=0) resolve to None and are ignored
+                    self._record_axis_arg(kw.value, "axis-kwarg")
+        self.generic_visit(call)
+
+
+def _connected_defs(tree, defs_by_name):
+    """FunctionDef nodes (module-locally) connected to a shard_map/pmap."""
+    seeds = set()
+
+    def add_target(expr):
+        if isinstance(expr, ast.Name):
+            seeds.update(defs_by_name.get(expr.id, []))
+        elif isinstance(expr, ast.Call) and _last(
+            dotted_name(expr.func, require_name_root=False)
+        ) == "partial":
+            if expr.args and isinstance(expr.args[0], ast.Name):
+                seeds.update(defs_by_name.get(expr.args[0].id, []))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            last = _last(dotted_name(node.func, require_name_root=False))
+            if last in _TRACER_SEEDS and node.args:
+                add_target(node.args[0])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                names = {
+                    _last(dotted_name(d, require_name_root=False))
+                    for d in ast.walk(dec)
+                    if isinstance(d, (ast.Name, ast.Attribute))
+                }
+                if names & _TRACER_SEEDS:
+                    seeds.add(node)
+
+    connected = set()
+    frontier = list(seeds)
+    while frontier:
+        fn = frontier.pop()
+        if fn in connected:
+            continue
+        connected.add(fn)
+        for sub in ast.walk(fn):
+            ref = None
+            if isinstance(sub, ast.Name):
+                ref = sub.id
+            elif isinstance(sub, ast.Attribute):
+                ref = sub.attr
+            if ref:
+                for d in defs_by_name.get(ref, []):
+                    if d not in connected:
+                        frontier.append(d)
+    return connected
+
+
+def _collect(module, axis_members):
+    """Per-module collection, cached on the Module object: all five
+    sharding rules share one AST walk + connectivity analysis per file
+    instead of each repeating it (the cache key carries the axis
+    vocabulary, so fixture rules with a custom ``keys_source`` never see a
+    stale vocabulary's info)."""
+    key = tuple(sorted(axis_members.items()))
+    cache = getattr(module, "_sharding_info_cache", None)
+    if cache is None:
+        cache = module._sharding_info_cache = {}
+    info = cache.get(key)
+    if info is not None:
+        return info
+    collector = _Collector(axis_members)
+    collector.visit(module.tree)
+    info = collector.info
+    info.connected = _connected_defs(module.tree, collector.defs_by_name)
+    cache[key] = info
+    return info
+
+
+class _ShardingBase(ProjectRule):
+    """Shared collection; subclasses own one finding family each.
+
+    ``keys_source`` overrides the ``config/keys.py`` source for fixture
+    tests (mirroring :class:`~.protocol.ProtocolConformanceRule`).
+    """
+
+    def __init__(self, keys_source=None):
+        self._keys_source = keys_source
+        self._axis_members = None
+        self._infos = []  # [(module, info)] in scan order
+
+    def axis_members(self):
+        if self._axis_members is None:
+            self._axis_members = load_mesh_axes(self._keys_source)
+        return self._axis_members
+
+    def vocab(self):
+        return set(self.axis_members().values())
+
+    def member_for(self, value):
+        for member, v in self.axis_members().items():
+            if v == value:
+                return member
+        return None
+
+    def visit_module(self, module):
+        info = _collect(module, self.axis_members())
+        self._infos.append((module, info))
+        return self.module_findings(module, info)
+
+    def module_findings(self, module, info):
+        return []
+
+    def finalize(self, modules):
+        return []
+
+    def _finding(self, module, node, message):
+        return Finding(
+            rule=self.id, path=module.path, line=node.lineno,
+            col=node.col_offset, message=message,
+        )
+
+
+@register_rule
+class UnknownAxisRule(_ShardingBase):
+    id = "sharding-unknown-axis"
+    doc = ("Axis names in mesh definitions, PartitionSpecs, collectives, or "
+           "*_axis kwargs that the config/keys.py MeshAxis vocabulary does "
+           "not declare (typos).")
+
+    def module_findings(self, module, info):
+        vocab = self.vocab()
+        findings = []
+        for use in info.uses:
+            if use.value not in vocab:
+                findings.append(self._finding(
+                    module, use.node,
+                    f"axis name '{use.value}' ({use.kind}) is not declared "
+                    "in the config/keys.py MeshAxis vocabulary "
+                    f"(known: {', '.join(sorted(vocab))})",
+                ))
+        return findings
+
+
+@register_rule
+class MeshArityRule(_ShardingBase):
+    id = "sharding-mesh-arity"
+    doc = ("Mesh definitions whose axis-name tuple cannot match the device "
+           "array (reshape rank != axis count, or a duplicated axis name).")
+
+    def module_findings(self, module, info):
+        findings = []
+        for mesh in info.meshes:
+            named = [a for a in mesh.axes if a is not None]
+            dupes = sorted({a for a in named if named.count(a) > 1})
+            for a in dupes:
+                findings.append(self._finding(
+                    module, mesh.node,
+                    f"mesh names axis '{a}' more than once",
+                ))
+            if mesh.ctor_rank is not None and mesh.ctor_rank != len(mesh.axes):
+                findings.append(self._finding(
+                    module, mesh.node,
+                    f"mesh axis tuple has {len(mesh.axes)} name(s) but the "
+                    f"device array is reshaped to rank {mesh.ctor_rank}",
+                ))
+        return findings
+
+
+@register_rule
+class SpecArityRule(_ShardingBase):
+    id = "sharding-spec-arity"
+    doc = ("PartitionSpecs that repeat an axis, or combine axes no mesh "
+           "defined in the scanned project defines together.")
+
+    def module_findings(self, module, info):
+        findings = []
+        for spec in info.specs:
+            axes = spec.axes()
+            dupes = sorted({a for a in axes if axes.count(a) > 1})
+            for a in dupes:
+                findings.append(self._finding(
+                    module, spec.node,
+                    f"PartitionSpec uses axis '{a}' more than once (JAX "
+                    "rejects a spec that repeats a mesh axis)",
+                ))
+        return findings
+
+    def finalize(self, modules):
+        mesh_axis_sets = []
+        for _, info in self._infos:
+            for mesh in info.meshes:
+                named = frozenset(a for a in mesh.axes if a is not None)
+                if named and None not in mesh.axes:
+                    mesh_axis_sets.append(named)
+        if not mesh_axis_sets:
+            # partial scan (no mesh in sight): a combo check would flood
+            return []
+        findings = []
+        vocab = self.vocab()
+        for module, info in self._infos:
+            for spec in info.specs:
+                axes = frozenset(spec.axes())
+                if len(axes) < 2 or not axes <= vocab:
+                    continue  # unknown axes are UnknownAxisRule's report
+                if not any(axes <= m for m in mesh_axis_sets):
+                    combos = ", ".join(
+                        "(" + ", ".join(sorted(m)) + ")"
+                        for m in sorted(mesh_axis_sets, key=sorted)
+                    )
+                    findings.append(self._finding(
+                        module, spec.node,
+                        "PartitionSpec combines axes "
+                        f"({', '.join(sorted(axes))}) that no mesh defines "
+                        f"together (meshes: {combos})",
+                    ))
+        return findings
+
+
+@register_rule
+class CollectiveScopeRule(_ShardingBase):
+    id = "sharding-collective-scope"
+    doc = ("Collectives over a statically-known axis name in functions not "
+           "connected (module-locally) to any shard_map/pmap — the axis is "
+           "unbound there and the call fails at trace time.")
+
+    def module_findings(self, module, info):
+        findings = []
+        for use in info.collectives:
+            if not use.axes:
+                continue  # dynamic axis: the caller owns the binding
+            chain = use.fn_chain
+            if any(fn in info.connected for fn in chain):
+                continue
+            if any(fn in info.escaped for fn in chain):
+                continue  # returned hook: escapes local analysis
+            where = (f"`{chain[0].name}`" if chain else "module level")
+            findings.append(self._finding(
+                module, use.node,
+                f"collective over axis '{', '.join(use.axes)}' at {where} "
+                "is not connected to any shard_map/pmap in this module — "
+                "the axis name is unbound outside a mapped context",
+            ))
+        return findings
+
+
+@register_rule
+class AxisLiteralRule(_ShardingBase):
+    id = "sharding-axis-literal"
+    doc = ("Bare mesh-axis string literals in axis positions — use the "
+           "MeshAxis constants from config/keys.py (the single source of "
+           "truth the sharding rules check against).")
+
+    def module_findings(self, module, info):
+        findings = []
+        for use in info.uses:
+            if use.bare and use.value in self.vocab():
+                member = self.member_for(use.value)
+                findings.append(self._finding(
+                    module, use.node,
+                    f"bare mesh-axis literal '{use.value}' ({use.kind}) — "
+                    f"use MeshAxis.{member} from config/keys.py",
+                ))
+        return findings

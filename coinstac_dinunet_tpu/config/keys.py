@@ -106,6 +106,40 @@ class RemoteWire(_StrEnum):
     DAD_REST_FILE = "dad_rest_file"
 
 
+class MeshAxis:
+    """Mesh axis-name vocabulary — the single source of truth for every
+    logical device-mesh axis in the package.
+
+    These are plain ``str`` constants (not an Enum): axis names flow into
+    ``jax.sharding.Mesh``/``PartitionSpec``/collective ``axis_name``
+    arguments, where a bare string is the canonical spelling — the constant
+    only pins WHICH string.  Mirroring :class:`LocalWire`/:class:`RemoteWire`
+    for the wire protocol, the ``sharding-*`` rule family of
+    :mod:`coinstac_dinunet_tpu.analysis` statically cross-checks every mesh
+    definition and every axis consumer (specs, collectives, ``shard_map``
+    kwargs) against this vocabulary; an axis literal that bypasses these
+    constants is a lint error (``sharding-axis-literal``), and an axis name
+    absent from this class is a typo (``sharding-unknown-axis``).
+
+    Axes:
+    - ``SITE``   — one rank per federated site (``parallel/mesh.py``).
+    - ``DEVICE`` — intra-site data parallelism over a site's chips.
+    - ``DP``     — batch data parallelism (``parallel/{sequence,pipeline}.py``).
+    - ``TP``     — tensor parallelism: attention heads / MLP hidden dim.
+    - ``SP``     — sequence parallelism (ring/Ulysses attention).
+    - ``EP``     — expert parallelism (switch-MoE expert dim).
+    - ``PP``     — pipeline parallelism (GPipe stages).
+    """
+
+    SITE = "site"
+    DEVICE = "device"
+    DP = "dp"
+    TP = "tp"
+    SP = "sp"
+    EP = "ep"
+    PP = "pp"
+
+
 # Keys a node reads from ``input`` that the ENGINE/compspec injects on the
 # first invocation (not part of the local↔remote handshake); the
 # protocol-conformance rule treats reads of these as engine-provided rather

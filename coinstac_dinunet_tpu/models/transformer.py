@@ -119,14 +119,19 @@ class MultiHeadSelfAttention(nn.Module):
     this rank's heads, row-parallel output projection) — see
     ``parallel/tp_mesh.py``.  Parameters are identical in every mode, so one
     checkpoint serves all of them.
+
+    Axis names passed into ``sp_axis``/``tp_axis`` must come from the
+    :class:`~..config.keys.MeshAxis` vocabulary (``MeshAxis.SP`` /
+    ``MeshAxis.TP``) — the mesh transports bind exactly those names, and the
+    ``sharding-*`` lint family cross-checks every literal against them.
     """
 
     num_heads: int
     causal: bool = False
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = None  # None → platform default (pallas on TPU)
-    sp_axis: str = None  # sequence-parallel mesh axis (ring attention)
-    tp_axis: str = None  # tensor-parallel mesh axis (head sharding)
+    sp_axis: str = None  # sequence-parallel mesh axis (MeshAxis.SP)
+    tp_axis: str = None  # tensor-parallel mesh axis (MeshAxis.TP)
 
     @nn.compact
     def __call__(self, x):

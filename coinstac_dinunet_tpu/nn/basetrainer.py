@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import optax
 
 from .. import config
-from ..config.keys import Key, Mode
+from ..config.keys import Key, MeshAxis, Mode
 from ..metrics import COINNAverages, Prf1a
 from ..utils import atomic_write, logger
 from ..utils.jax_compat import shard_map
@@ -563,18 +563,18 @@ class NNTrainer:
     def _dp_mesh(self, n):
         from jax.sharding import Mesh
 
-        return Mesh(np.array(jax.devices()[:n]), ("device",))
+        return Mesh(np.array(jax.devices()[:n]), (MeshAxis.DEVICE,))
 
     def _reduce_dp_aux(self, aux, stacked):
         aux = dict(aux)
         if aux.get("metrics") is not None:
-            aux["metrics"] = jax.lax.psum(aux["metrics"], "device")
+            aux["metrics"] = jax.lax.psum(aux["metrics"], MeshAxis.DEVICE)
         if "host_scores" in aux:
             aux["host_scores"] = jax.tree_util.tree_map(
-                lambda x: jax.lax.all_gather(x, "device", axis=0, tiled=True),
+                lambda x: jax.lax.all_gather(x, MeshAxis.DEVICE, axis=0, tiled=True),
                 aux["host_scores"],
             )
-        aux["averages"] = jax.lax.psum(aux["averages"], "device")
+        aux["averages"] = jax.lax.psum(aux["averages"], MeshAxis.DEVICE)
         # weight the reported loss by each shard's real-sample count; for a
         # single micro-batch this reproduces the single-device full-batch
         # masked mean exactly (with grad accumulation the per-micro-batch
@@ -583,11 +583,11 @@ class NNTrainer:
         mask = stacked.get("_mask")
         if mask is not None:
             n = jnp.sum(jnp.asarray(mask, jnp.float32))
-            aux["loss"] = jax.lax.psum(aux["loss"] * n, "device") / jnp.maximum(
-                jax.lax.psum(n, "device"), 1.0
+            aux["loss"] = jax.lax.psum(aux["loss"] * n, MeshAxis.DEVICE) / jnp.maximum(
+                jax.lax.psum(n, MeshAxis.DEVICE), 1.0
             )
         else:
-            aux["loss"] = jax.lax.pmean(aux["loss"], "device")
+            aux["loss"] = jax.lax.pmean(aux["loss"], MeshAxis.DEVICE)
         return aux
 
     @staticmethod
@@ -655,12 +655,12 @@ class NNTrainer:
         from jax.sharding import PartitionSpec as P
 
         metrics_shell, averages_shell = self._metrics_shell()
-        grad_reduce = self.make_grad_reduce("device")
+        grad_reduce = self.make_grad_reduce(MeshAxis.DEVICE)
 
         def shard_step(ts, stacked):
             orig_rng = ts.rng
             ts = ts.replace(
-                rng=jax.random.fold_in(orig_rng, jax.lax.axis_index("device"))
+                rng=jax.random.fold_in(orig_rng, jax.lax.axis_index(MeshAxis.DEVICE))
             )
             grads, aux = self._grads_uncompiled(
                 ts, stacked, metrics_shell, averages_shell,
@@ -677,7 +677,7 @@ class NNTrainer:
         return jax.jit(
             shard_map(
                 shard_step, mesh=self._dp_mesh(n),
-                in_specs=(P(), P(None, "device")), out_specs=(P(), P()),
+                in_specs=(P(), P(None, MeshAxis.DEVICE)), out_specs=(P(), P()),
                 check_vma=False,
             ),
             donate_argnums=donate,
@@ -850,8 +850,8 @@ class NNTrainer:
                     it, batch, metrics_shell, averages_shell
                 )
                 if m_state is not None:
-                    m_state = jax.lax.psum(m_state, "device")
-                a_state = jax.lax.psum(a_state, "device")
+                    m_state = jax.lax.psum(m_state, MeshAxis.DEVICE)
+                a_state = jax.lax.psum(a_state, MeshAxis.DEVICE)
                 # carry the FULL it dict through (the hook's contract is
                 # "anything else is carried through"): per-sample arrays
                 # gather back into full-batch order (host-side AUC +
@@ -862,10 +862,10 @@ class NNTrainer:
                     arr = jnp.asarray(v)
                     if arr.ndim >= 1 and arr.shape[0] == shard_b:
                         out_it[k] = jax.lax.all_gather(
-                            arr, "device", axis=0, tiled=True
+                            arr, MeshAxis.DEVICE, axis=0, tiled=True
                         )
                     elif arr.ndim == 0:
-                        out_it[k] = jax.lax.pmean(arr, "device")
+                        out_it[k] = jax.lax.pmean(arr, MeshAxis.DEVICE)
                     else:
                         out_it[k] = arr  # replicated (e.g. per-class stats)
                 return m_state, a_state, out_it
@@ -873,7 +873,7 @@ class NNTrainer:
             fn = self._compiled[("eval_dp", n)] = jax.jit(
                 shard_map(
                     shard_eval, mesh=self._dp_mesh(n),
-                    in_specs=(P(), P("device")), out_specs=(P(), P(), P()),
+                    in_specs=(P(), P(MeshAxis.DEVICE)), out_specs=(P(), P(), P()),
                     check_vma=False,
                 )
             )
@@ -1052,7 +1052,7 @@ class NNTrainer:
             if n_dp > 1:
                 from jax.sharding import NamedSharding, PartitionSpec
 
-                shard = NamedSharding(self._dp_mesh(n_dp), PartitionSpec("device"))
+                shard = NamedSharding(self._dp_mesh(n_dp), PartitionSpec(MeshAxis.DEVICE))
             batch_iter = iter(loader)
             cast = self._input_cast_dtype()
             if cast is not None:

@@ -20,6 +20,7 @@ import os
 import jax
 import numpy as np
 
+from ..config.keys import MeshAxis
 from .mesh import build_site_mesh
 
 
@@ -76,5 +77,5 @@ def host_aligned_site_mesh(n_sites, devices_per_site=None):
             arr = np.array(ordered[:need]).reshape(n_sites, devices_per_site)
             from jax.sharding import Mesh
 
-            return Mesh(arr, ("site", "device"))
+            return Mesh(arr, (MeshAxis.SITE, MeshAxis.DEVICE))
     return build_site_mesh(n_sites, devices, devices_per_site)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..config.keys import MeshAxis
 from ..ops import orthogonalize
 from ..utils.jax_compat import shard_map
 
@@ -50,7 +51,7 @@ def build_site_mesh(n_sites, devices=None, devices_per_site=None):
             f"only {len(devices)} available."
         )
     arr = np.array(devices[:need]).reshape(n_sites, devices_per_site)
-    return Mesh(arr, ("site", "device"))
+    return Mesh(arr, (MeshAxis.SITE, MeshAxis.DEVICE))
 
 
 class MeshFederation:
@@ -192,7 +193,7 @@ class MeshFederation:
         def place(x):
             host = np.asarray(jax.device_get(x))
             s = NamedSharding(
-                self.mesh, P(*(["site"] + [None] * (host.ndim - 1)))
+                self.mesh, P(*([MeshAxis.SITE] + [None] * (host.ndim - 1)))
             )
             return jax.make_array_from_callback(
                 host.shape, s, lambda idx, a=host: a[idx]
@@ -295,12 +296,12 @@ class MeshFederation:
             mask = batch.get("_mask")
             w = ((jnp.sum(jnp.asarray(mask, jnp.float32)) > 0).astype(jnp.float32)
                  if mask is not None else jnp.float32(1))
-            wsum = jnp.maximum(jax.lax.psum(w, "site"), 1.0)
+            wsum = jnp.maximum(jax.lax.psum(w, MeshAxis.SITE), 1.0)
             leaves, treedef = jax.tree_util.tree_flatten(vgrads)
             flat = list(leaves)
             for lk in layer_keys:
-                B_all = jax.lax.all_gather(Brs[lk] * w, "site", axis=0, tiled=True)
-                C_all = jax.lax.all_gather(Crs[lk], "site", axis=0, tiled=True)
+                B_all = jax.lax.all_gather(Brs[lk] * w, MeshAxis.SITE, axis=0, tiled=True)
+                C_all = jax.lax.all_gather(Crs[lk], MeshAxis.SITE, axis=0, tiled=True)
                 G = (C_all.T @ B_all) / wsum  # (din[+1], dout)
                 kern_ix, bias_ix = leaf_map[lk]
                 if bias_ix is not None:
@@ -309,27 +310,27 @@ class MeshFederation:
                 else:
                     flat[kern_ix] = G.astype(leaves[kern_ix].dtype)
             for i in rest_ix:
-                flat[i] = jax.lax.psum(leaves[i] * w, "site") / wsum
+                flat[i] = jax.lax.psum(leaves[i] * w, MeshAxis.SITE) / wsum
             grads = jax.tree_util.tree_unflatten(treedef, flat)
             ts = trainer._apply_updates(ts, grads)
             ts = ts.replace(rng=rng_next)
             m_state, a_state = trainer._step_outputs(
                 it, batch, metrics_shell, averages_shell
             )
-            aux = {"loss": jax.lax.pmean(loss, "site"), "rng": ts.rng}
+            aux = {"loss": jax.lax.pmean(loss, MeshAxis.SITE), "rng": ts.rng}
             if m_state is not None:
-                aux["metrics"] = jax.lax.psum(m_state, "site")
+                aux["metrics"] = jax.lax.psum(m_state, MeshAxis.SITE)
             elif not getattr(metrics_shell, "jit_safe", True):
                 hs = trainer.host_scores_payload(it, batch)
                 if hs is not None:
                     aux["host_scores"] = jax.tree_util.tree_map(
-                        lambda x: jax.lax.all_gather(x, "site", axis=0, tiled=True),
+                        lambda x: jax.lax.all_gather(x, MeshAxis.SITE, axis=0, tiled=True),
                         hs,
                     )
-            aux["averages"] = jax.lax.psum(a_state, "site")
+            aux["averages"] = jax.lax.psum(a_state, MeshAxis.SITE)
             return ts, aux
 
-        batch_spec = P("site", None, "device")
+        batch_spec = P(MeshAxis.SITE, None, MeshAxis.DEVICE)
         mesh = self.mesh
         donate = (
             (0,)
@@ -368,7 +369,7 @@ class MeshFederation:
         """Per-micro-batch gradient reduction over the intra-site axis."""
         # mask-weighted mean over the batch shards (exact masked-mean even
         # when the padded tail splits unevenly across devices)
-        return self.trainer.make_grad_reduce("device")
+        return self.trainer.make_grad_reduce(MeshAxis.DEVICE)
 
     def _site_weight(self, stacked):
         """1 iff this site's round carried any unmasked sample."""
@@ -376,19 +377,19 @@ class MeshFederation:
         if mask is None:
             return jnp.float32(1)
         n_site = jax.lax.psum(
-            jnp.sum(jnp.asarray(mask, jnp.float32)), "device"
+            jnp.sum(jnp.asarray(mask, jnp.float32)), MeshAxis.DEVICE
         )
         return (n_site > 0).astype(jnp.float32)
 
     def _aux_axes(self):
         """Mesh axes the aux outputs (metrics/averages/loss) reduce over —
         every axis whose shards carry DISTINCT samples."""
-        return ("site", "device")
+        return (MeshAxis.SITE, MeshAxis.DEVICE)
 
     def _train_batch_specs(self):
         """in_specs entry for the stacked (site, k, B, ...) batch pytree —
         a single spec, or a per-key dict (see :meth:`_spec_for`)."""
-        return P("site", None, "device")
+        return P(MeshAxis.SITE, None, MeshAxis.DEVICE)
 
     @staticmethod
     def _spec_for(spec, k):
@@ -409,7 +410,7 @@ class MeshFederation:
             round carried no unmasked samples contributes nothing AND is
             excluded from the denominator (file-transport parity — a site
             that never ships grads is absent from the reducer's average)."""
-            return jax.lax.psum(x * w, "site") / wsum
+            return jax.lax.psum(x * w, MeshAxis.SITE) / wsum
 
         def _powersgd_exchange(grads, comm, w, wsum):
             """Both PowerSGD wire rounds as in-step collectives, built from
@@ -445,13 +446,13 @@ class MeshFederation:
             stacked = jax.tree_util.tree_map(lambda x: x[0], stacked)
             orig_rng = ts.rng
             # per-site decorrelated randomness for the forward pass…
-            ts = ts.replace(rng=jax.random.fold_in(orig_rng, jax.lax.axis_index("site")))
+            ts = ts.replace(rng=jax.random.fold_in(orig_rng, jax.lax.axis_index(MeshAxis.SITE)))
             grads, aux = trainer._grads_uncompiled(
                 ts, stacked, metrics_shell, averages_shell,
                 grad_reduce=intra_grad_reduce, iteration_fn=iteration_fn,
             )
             w = self._site_weight(stacked)
-            wsum = jnp.maximum(jax.lax.psum(w, "site"), 1.0)
+            wsum = jnp.maximum(jax.lax.psum(w, MeshAxis.SITE), 1.0)
             if engine == "powerSGD":
                 grads, comm = _powersgd_exchange(grads, comm, w, wsum)
             else:
@@ -481,7 +482,7 @@ class MeshFederation:
             aux["rng"] = ts.rng
             return ts, aux, comm
 
-        comm_spec = jax.tree_util.tree_map(lambda _: P("site"), self.comm_state)
+        comm_spec = jax.tree_util.tree_map(lambda _: P(MeshAxis.SITE), self.comm_state)
         batch_spec = self._train_batch_specs()
         mesh = self.mesh
 
@@ -560,7 +561,7 @@ class MeshFederation:
     # ------------------------------------------------------------- evaluation
     def _eval_batch_specs(self):
         """in_specs entry for the (site, B, ...) eval batch pytree."""
-        return P("site", "device")
+        return P(MeshAxis.SITE, MeshAxis.DEVICE)
 
     def _build_eval(self):
         trainer = self.trainer
@@ -654,7 +655,7 @@ class ReplicatedBatchFederation(MeshFederation):
 
     def _aux_axes(self):
         # reducing over the intra axis too would multi-count every sample
-        return ("site",)
+        return (MeshAxis.SITE,)
 
 
 def lockstep_batches(n_sites, site_sizes, batch_size):

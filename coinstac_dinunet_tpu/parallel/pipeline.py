@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..config.keys import MeshAxis
 from ..ops import flash_attention
 from ..utils.jax_compat import shard_map
 from .sequence import _layernorm, transformer_block
@@ -57,7 +58,7 @@ def build_pp_mesh(pp=2, dp=1, devices=None):
     need = pp * dp
     if need > len(devices):
         raise ValueError(f"need {need} devices, have {len(devices)}")
-    return Mesh(np.array(devices[:need]).reshape(pp, dp), ("pp", "dp"))
+    return Mesh(np.array(devices[:need]).reshape(pp, dp), (MeshAxis.PP, MeshAxis.DP))
 
 
 def stack_layers(params):
@@ -71,7 +72,7 @@ def stack_layers(params):
 
 def _pp_specs(params):
     def spec_for(path, leaf):
-        return P("pp") if any(
+        return P(MeshAxis.PP) if any(
             getattr(p, "key", None) == "layers" for p in path
         ) else P()
     return jax.tree_util.tree_map_with_path(spec_for, params)
@@ -86,8 +87,8 @@ def shard_pp_params(params, mesh):
 
 
 def shard_pp_batch(x, y, mesh):
-    x = jax.device_put(x, NamedSharding(mesh, P("dp")))
-    y = jax.device_put(y, NamedSharding(mesh, P("dp")))
+    x = jax.device_put(x, NamedSharding(mesh, P(MeshAxis.DP)))
+    y = jax.device_put(y, NamedSharding(mesh, P(MeshAxis.DP)))
     return x, y
 
 
@@ -106,13 +107,13 @@ def make_pp_train_step(cfg, mesh, lr=1e-3, num_microbatches=None):
 
     ``num_microbatches`` defaults to the pp size (minimum that fills the
     pipe; raise it to shrink the bubble)."""
-    pp = mesh.shape["pp"]
+    pp = mesh.shape[MeshAxis.PP]
     M = int(num_microbatches or pp)
     assert cfg.num_experts == 0, "pipeline path uses the dense-FFN layers"
 
     def local_loss(params, x, y):
         # x: (B_local, T, F) — this dp rank's batch, replicated across pp
-        r = lax.axis_index("pp")
+        r = lax.axis_index(MeshAxis.PP)
         b = x.shape[0]
         assert b % M == 0, f"batch {b} must divide microbatches {M}"
         mb = b // M
@@ -142,7 +143,7 @@ def make_pp_train_step(cfg, mesh, lr=1e-3, num_microbatches=None):
                 jnp.clip(j, 0, M - 1), 0,
             )
             h = lax.ppermute(
-                h, "pp", perm=[(k, (k + 1) % pp) for k in range(pp)]
+                h, MeshAxis.PP, perm=[(k, (k + 1) % pp) for k in range(pp)]
             )
             return (h, outs), None
 
@@ -171,13 +172,13 @@ def make_pp_train_step(cfg, mesh, lr=1e-3, num_microbatches=None):
         grads = jax.tree_util.tree_map_with_path(
             lambda path, g: g if any(
                 getattr(p, "key", None) == "layers" for p in path
-            ) else lax.psum(g, "pp"),
+            ) else lax.psum(g, MeshAxis.PP),
             grads,
         )
         # global loss = mean over dp of per-rank ce → grads average over dp
-        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, "dp"), grads)
+        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, MeshAxis.DP), grads)
         params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
-        loss = lax.pmean(lax.psum(local, "pp"), "dp")
+        loss = lax.pmean(lax.psum(local, MeshAxis.PP), MeshAxis.DP)
         return params, loss
 
     p_specs = _pp_specs  # resolved per-call against the actual pytree
@@ -188,7 +189,7 @@ def make_pp_train_step(cfg, mesh, lr=1e-3, num_microbatches=None):
         return shard_map(
             sharded_step,
             mesh=mesh,
-            in_specs=(specs, P("dp"), P("dp")),
+            in_specs=(specs, P(MeshAxis.DP), P(MeshAxis.DP)),
             out_specs=(specs, P()),
             check_vma=False,
         )(params, x, y)

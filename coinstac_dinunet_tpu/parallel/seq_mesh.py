@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..config.keys import MeshAxis
 from .mesh import ReplicatedBatchFederation
 
 __all__ = ["SeqMeshFederation"]
@@ -56,14 +57,14 @@ class SeqMeshFederation(ReplicatedBatchFederation):
             devices_per_site=self.sp,
         )
         # same device grid, but the intra-site axis is the sequence axis
-        self.mesh = Mesh(self.mesh.devices, ("site", "sp"))
+        self.mesh = Mesh(self.mesh.devices, (MeshAxis.SITE, MeshAxis.SP))
 
     # ---- intra-site axis hooks (see MeshFederation._build_step) ----------
     def _iteration_fn(self):
         trainer = self.trainer
 
         def sp_iteration(params, batch, rng):
-            return trainer.iteration_sharded(params, batch, rng, sp_axis="sp")
+            return trainer.iteration_sharded(params, batch, rng, sp_axis=MeshAxis.SP)
 
         return sp_iteration
 
@@ -71,7 +72,7 @@ class SeqMeshFederation(ReplicatedBatchFederation):
         # see module docstring: replicated loss → uniform sp× grads → pmean
         def sp_grad_reduce(g, batch):
             return jax.tree_util.tree_map(
-                lambda x: jax.lax.pmean(x, "sp"), g
+                lambda x: jax.lax.pmean(x, MeshAxis.SP), g
             )
 
         return sp_grad_reduce
@@ -84,14 +85,14 @@ class SeqMeshFederation(ReplicatedBatchFederation):
         carry no sequence axis and stay replicated within the site."""
         keys = self._sample_batch_keys or ("inputs",)
         return {
-            k: (P("site", None, None, "sp") if k == "inputs" else P("site"))
+            k: (P(MeshAxis.SITE, None, None, MeshAxis.SP) if k == "inputs" else P(MeshAxis.SITE))
             for k in keys
         }
 
     def _eval_batch_specs(self):
         keys = self._sample_batch_keys or ("inputs",)
         return {
-            k: (P("site", None, "sp") if k == "inputs" else P("site"))
+            k: (P(MeshAxis.SITE, None, MeshAxis.SP) if k == "inputs" else P(MeshAxis.SITE))
             for k in keys
         }
 

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..config.keys import MeshAxis
 from ..utils.jax_compat import shard_map
 from .ring_attention import ring_attention
 
@@ -72,7 +73,7 @@ def build_tsp_mesh(dp=1, tp=1, sp=1, ep=1, devices=None):
     if need > len(devices):
         raise ValueError(f"need {need} devices, have {len(devices)}")
     arr = np.array(devices[:need]).reshape(dp, tp, sp, ep)
-    return Mesh(arr, ("dp", "tp", "sp", "ep"))
+    return Mesh(arr, (MeshAxis.DP, MeshAxis.TP, MeshAxis.SP, MeshAxis.EP))
 
 
 def init_tsp_params(key, cfg):
@@ -121,16 +122,16 @@ def _param_specs(params):
     def spec_for(path, leaf):
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
         return {
-            "wqkv": P(None, None, "tp", None),  # (3, d, h/tp, hd)
-            "wo": P("tp", None, None),          # (h/tp, hd, d)
-            "w1": P(None, "tp"),                # (d, ff/tp)
-            "b1": P("tp"),
-            "w2": P("tp", None),                # (ff/tp, d)
+            "wqkv": P(None, None, MeshAxis.TP, None),  # (3, d, h/tp, hd)
+            "wo": P(MeshAxis.TP, None, None),          # (h/tp, hd, d)
+            "w1": P(None, MeshAxis.TP),                # (d, ff/tp)
+            "b1": P(MeshAxis.TP),
+            "w2": P(MeshAxis.TP, None),                # (ff/tp, d)
             # MoE: experts over ep, each expert's hidden dim over tp
-            "w1e": P("ep", None, "tp"),         # (E/ep, d, ff/tp)
-            "b1e": P("ep", "tp"),
-            "w2e": P("ep", "tp", None),         # (E/ep, ff/tp, d)
-            "b2e": P("ep", None),
+            "w1e": P(MeshAxis.EP, None, MeshAxis.TP),         # (E/ep, d, ff/tp)
+            "b1e": P(MeshAxis.EP, MeshAxis.TP),
+            "w2e": P(MeshAxis.EP, MeshAxis.TP, None),         # (E/ep, ff/tp, d)
+            "b2e": P(MeshAxis.EP, None),
         }.get(name, P())
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
@@ -165,20 +166,20 @@ def transformer_block(h, lp, cfg, attn_fn, constrain=_no_constrain):
     dtype = cfg.dtype
     z = _layernorm(h, lp["ln1"]).astype(dtype)
     qkv = jnp.einsum("btd,cdhe->cbhte", z, lp["wqkv"].astype(dtype))
-    qkv = constrain(qkv, P(None, "dp", "tp", "sp", None))
+    qkv = constrain(qkv, P(None, MeshAxis.DP, MeshAxis.TP, MeshAxis.SP, None))
     attn = attn_fn(qkv[0], qkv[1], qkv[2])
     o = jnp.einsum("bhte,hed->btd", attn, lp["wo"].astype(dtype))
-    h = h + constrain(o, P("dp", "sp", None))
+    h = h + constrain(o, P(MeshAxis.DP, MeshAxis.SP, None))
 
     z = _layernorm(h, lp["ln2"]).astype(dtype)
     if cfg.num_experts > 0:
         m, aux = _switch_moe(z, lp, cfg, constrain)
-        h = h + constrain(m, P("dp", "sp", None))
+        h = h + constrain(m, P(MeshAxis.DP, MeshAxis.SP, None))
         return h, aux
     m = jax.nn.gelu(z @ lp["w1"].astype(dtype) + lp["b1"].astype(dtype))
-    m = constrain(m, P("dp", "sp", "tp"))
+    m = constrain(m, P(MeshAxis.DP, MeshAxis.SP, MeshAxis.TP))
     h = h + constrain(m @ lp["w2"].astype(dtype) + lp["b2"].astype(dtype),
-                      P("dp", "sp", None))
+                      P(MeshAxis.DP, MeshAxis.SP, None))
     return h, jnp.zeros((), jnp.float32)
 
 
@@ -210,17 +211,17 @@ def _switch_moe(z, lp, cfg, constrain):
             jnp.clip(pos, 0, cap - 1).astype(jnp.int32), cap, dtype=jnp.float32
         ),
     )  # (N, E, C)
-    dispatch = constrain(dispatch, P(None, "ep", None))
+    dispatch = constrain(dispatch, P(None, MeshAxis.EP, None))
     xe = jnp.einsum("nec,nd->ecd", dispatch, zf.astype(jnp.float32))
-    xe = constrain(xe, P("ep", None, None)).astype(cfg.dtype)
+    xe = constrain(xe, P(MeshAxis.EP, None, None)).astype(cfg.dtype)
     h = jax.nn.gelu(
         jnp.einsum("ecd,edf->ecf", xe, lp["w1e"].astype(cfg.dtype))
         + lp["b1e"][:, None].astype(cfg.dtype)
     )
-    h = constrain(h, P("ep", None, "tp"))
+    h = constrain(h, P(MeshAxis.EP, None, MeshAxis.TP))
     ye = (jnp.einsum("ecf,efd->ecd", h, lp["w2e"].astype(cfg.dtype))
           + lp["b2e"][:, None].astype(cfg.dtype))
-    ye = constrain(ye.astype(jnp.float32), P("ep", None, None))
+    ye = constrain(ye.astype(jnp.float32), P(MeshAxis.EP, None, None))
     gate_val = jnp.sum(gates * onehot, axis=-1, keepdims=True)  # (N, 1)
     out = jnp.einsum("nec,ecd->nd", dispatch, ye) * gate_val
     # switch load-balancing auxiliary loss
@@ -240,12 +241,12 @@ def tsp_forward(params, x, cfg, mesh):
     constrain = lambda a, s: lax.with_sharding_constraint(a, NamedSharding(mesh, s))
 
     h = x @ params["in_proj"].astype(dtype) + params["pos"][:t].astype(dtype)
-    h = constrain(h, P("dp", "sp", None))
+    h = constrain(h, P(MeshAxis.DP, MeshAxis.SP, None))
 
-    qkv_spec = P("dp", "tp", "sp", None)
+    qkv_spec = P(MeshAxis.DP, MeshAxis.TP, MeshAxis.SP, None)
     ring = shard_map(
         partial(
-            ring_attention, axis_name="sp", causal=cfg.causal,
+            ring_attention, axis_name=MeshAxis.SP, causal=cfg.causal,
             impl=cfg.attn_impl,
         ),
         mesh=mesh,
@@ -286,6 +287,6 @@ def make_tsp_train_step(cfg, mesh, lr=1e-3):
 
 
 def shard_tsp_batch(x, y, mesh):
-    x = jax.device_put(x, NamedSharding(mesh, P("dp", "sp", None)))
-    y = jax.device_put(y, NamedSharding(mesh, P("dp")))
+    x = jax.device_put(x, NamedSharding(mesh, P(MeshAxis.DP, MeshAxis.SP, None)))
+    y = jax.device_put(y, NamedSharding(mesh, P(MeshAxis.DP)))
     return x, y

@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..config.keys import MeshAxis
 from .mesh import ReplicatedBatchFederation
 
 __all__ = ["TPMeshFederation"]
@@ -65,14 +66,14 @@ class TPMeshFederation(ReplicatedBatchFederation):
             devices_per_site=self.tp,
         )
         # same device grid, but the intra-site axis is the tensor axis
-        self.mesh = Mesh(self.mesh.devices, ("site", "tp"))
+        self.mesh = Mesh(self.mesh.devices, (MeshAxis.SITE, MeshAxis.TP))
 
     # ---- intra-site axis hooks (see MeshFederation._build_step) ----------
     def _iteration_fn(self):
         trainer = self.trainer
 
         def tp_iteration(params, batch, rng):
-            return trainer.iteration_tp(params, batch, rng, tp_axis="tp")
+            return trainer.iteration_tp(params, batch, rng, tp_axis=MeshAxis.TP)
 
         return tp_iteration
 
@@ -81,7 +82,7 @@ class TPMeshFederation(ReplicatedBatchFederation):
         # see the module docstring's cotangent derivation
         def tp_grad_reduce(g, batch):
             return jax.tree_util.tree_map(
-                lambda x: jax.lax.pmean(x, "tp"), g
+                lambda x: jax.lax.pmean(x, MeshAxis.TP), g
             )
 
         return tp_grad_reduce
@@ -93,11 +94,11 @@ class TPMeshFederation(ReplicatedBatchFederation):
         """(site, k, B, ...) — replicated within the site: every tp rank
         needs the whole batch (activations shard by FEATURE, not sample)."""
         keys = self._sample_batch_keys or ("inputs",)
-        return {k: P("site") for k in keys}
+        return {k: P(MeshAxis.SITE) for k in keys}
 
     def _eval_batch_specs(self):
         keys = self._sample_batch_keys or ("inputs",)
-        return {k: P("site") for k in keys}
+        return {k: P(MeshAxis.SITE) for k in keys}
 
     # batching: inherited — MeshFederation.stack_site_batches resolves the
     # per-key placement through _train_batch_specs in BOTH the single- and

@@ -8,6 +8,13 @@ replication check is spelled ``check_rep``.  Every module imports
 the ``jax-api-drift`` rule of :mod:`coinstac_dinunet_tpu.analysis` enforces
 this (a bare ``jax.shard_map`` reference is an ``AttributeError`` at trace
 time on 0.4.x, which is exactly how the seed lost 57 tier-1 tests).
+
+Supported range: **JAX >= 0.4.30** (the ``pyproject.toml`` floor; the
+oldest line this shim bridges — ``jax.experimental.shard_map`` with
+``check_rep`` and a ``lax``-only ``axis_size``) through the current
+top-level-API releases.  ``tests/test_jax_floor.py`` asserts the installed
+JAX satisfies the declared floor, so the two can't silently drift apart
+again.
 """
 import jax
 from jax import lax

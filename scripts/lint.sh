@@ -17,7 +17,13 @@ else
 fi
 
 echo "== dinulint (python -m coinstac_dinunet_tpu.analysis) =="
+# Under GitHub Actions, emit ::error workflow annotations so findings land
+# inline on the PR diff; plain text everywhere else.
+fmt="text"
+if [ "${GITHUB_ACTIONS:-}" = "true" ]; then
+    fmt="github"
+fi
 python -m coinstac_dinunet_tpu.analysis coinstac_dinunet_tpu \
-    --baseline dinulint_baseline.json || status=1
+    --baseline dinulint_baseline.json --format "$fmt" || status=1
 
 exit "$status"

@@ -1,0 +1,255 @@
+"""The --deep tier: eval_shape abstract interpretation over the registry.
+
+Fixture entries are registered into a snapshot/restored ``DEEP_REGISTRY``
+so the built-in registry is untouched; the conftest's 8-device virtual CPU
+platform is the same one the CLI's ``--deep`` sets up for itself.
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from coinstac_dinunet_tpu.analysis import deepcheck
+from coinstac_dinunet_tpu.analysis.deepcheck import (
+    REQUIRED_DEVICES,
+    list_entry_points,
+    register_entry_point,
+    run_deepcheck,
+)
+
+
+@pytest.fixture
+def registry():
+    # materialize the lazy builtins FIRST so the snapshot includes them —
+    # otherwise restoring would wipe entries registered mid-test while the
+    # one-shot _BUILTINS_DONE flag stays set
+    deepcheck._register_builtin_entries()
+    saved = dict(deepcheck.DEEP_REGISTRY)
+    yield deepcheck.DEEP_REGISTRY
+    deepcheck.DEEP_REGISTRY.clear()
+    deepcheck.DEEP_REGISTRY.update(saved)
+
+
+def _sds(shape, dtype="float32"):
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+
+def test_platform_provides_the_virtual_devices():
+    assert len(jax.devices()) >= REQUIRED_DEVICES
+
+
+def test_deep_catches_mis_shaped_entry(registry):
+    """ISSUE 2 acceptance: a deliberately mis-shaped entry point is flagged
+    (contracting dims 8 vs 4 can never matmul)."""
+
+    @register_entry_point("fixture-bad-matmul", "pkg/fixture.py")
+    def _bad():
+        def f(a, b):
+            return a @ b
+
+        return f, (_sds((4, 8)), _sds((4, 8)))
+
+    findings = run_deepcheck(["fixture-bad-matmul"])
+    assert [f.rule for f in findings] == ["deep-eval-shape"]
+    assert findings[0].path == "pkg/fixture.py"
+    assert "fixture-bad-matmul" in findings[0].message
+
+
+def test_deep_broken_builder_is_a_finding_not_a_crash(registry):
+    @register_entry_point("fixture-broken-build", "pkg/fixture.py")
+    def _boom():
+        raise RuntimeError("constructor exploded")
+
+    findings = run_deepcheck(["fixture-broken-build"])
+    assert [f.rule for f in findings] == ["deep-entry-build"]
+    assert "RuntimeError: constructor exploded" in findings[0].message
+
+
+def test_deep_recompile_hazard_mutable_host_state(registry):
+    """A function whose trace depends on mutable host state yields a
+    different output structure on every trace — a guaranteed jit cache miss
+    (and a cross-host program divergence under multi-controller)."""
+
+    @register_entry_point("fixture-recompile", "pkg/fixture.py")
+    def _rec():
+        state = {"n": 0}
+
+        def f(a):
+            state["n"] += 1
+            return jnp.zeros((state["n"],))
+
+        return f, (_sds((2,)),)
+
+    findings = run_deepcheck(["fixture-recompile"])
+    assert [f.rule for f in findings] == ["deep-recompile"]
+    assert "different output structures" in findings[0].message
+
+
+def test_deep_recompile_hazard_survives_a_jit_wrapper(registry):
+    """A jit-wrapped entry carries its own trace cache on the jit object —
+    run_deepcheck must peel it, or the second trace is a silent replay and
+    the hazard is invisible on exactly the package's compiled surfaces."""
+
+    @register_entry_point("fixture-jit-recompile", "pkg/fixture.py")
+    def _rec():
+        state = {"n": 0}
+
+        @jax.jit
+        def f(a):
+            state["n"] += 1
+            return jnp.zeros((state["n"],))
+
+        return f, (_sds((2,)),)
+
+    findings = run_deepcheck(["fixture-jit-recompile"])
+    assert [f.rule for f in findings] == ["deep-recompile"]
+
+
+def test_deep_jit_of_shard_map_entry_still_traces(registry):
+    """Peeling must stop at the jit layer: jit(shard_map(...)) entries trace
+    the sharded body (unsharding it would leave the collective unbound)."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from coinstac_dinunet_tpu.config.keys import MeshAxis
+    from coinstac_dinunet_tpu.utils.jax_compat import shard_map
+
+    @register_entry_point("fixture-jit-shard", "pkg/fixture.py")
+    def _jit_shard():
+        mesh = Mesh(np.array(jax.devices()[:REQUIRED_DEVICES]), (MeshAxis.SP,))
+        fn = jax.jit(shard_map(
+            lambda x: jax.lax.psum(x, MeshAxis.SP), mesh=mesh,
+            in_specs=P(MeshAxis.SP), out_specs=P(),
+        ))
+        return fn, (_sds((8,)),)
+
+    assert run_deepcheck(["fixture-jit-shard"]) == []
+
+
+def test_deep_clean_entry_produces_no_findings(registry):
+    @register_entry_point("fixture-clean", "pkg/fixture.py")
+    def _ok():
+        def f(a, b):
+            return a @ b
+
+        return f, (_sds((4, 8)), _sds((8, 2)))
+
+    assert run_deepcheck(["fixture-clean"]) == []
+
+
+def test_deep_sharding_violation_in_shard_map_entry(registry):
+    """eval_shape sees through shard_map: an in_spec whose axis does not
+    divide the array is exactly the class of silent partitioning error the
+    deep tier exists to catch before a real mesh does."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from coinstac_dinunet_tpu.config.keys import MeshAxis
+    from coinstac_dinunet_tpu.utils.jax_compat import shard_map
+
+    @register_entry_point("fixture-bad-shard", "pkg/fixture.py")
+    def _bad_shard():
+        mesh = Mesh(np.array(jax.devices()[:REQUIRED_DEVICES]), (MeshAxis.SP,))
+        fn = shard_map(
+            lambda x: x * 2, mesh=mesh,
+            in_specs=P(MeshAxis.SP), out_specs=P(MeshAxis.SP),
+        )
+        return fn, (_sds((6,)),)  # 6 % 8 != 0: unshardable
+
+    findings = run_deepcheck(["fixture-bad-shard"])
+    assert [f.rule for f in findings] == ["deep-eval-shape"]
+
+
+def test_builtin_registry_covers_the_compiled_surfaces():
+    entries = list_entry_points()
+    for expected in (
+        "trainer-train-step", "trainer-eval-step", "trainer-dp-train-step",
+        "mesh-federation-dsgd-step", "powersgd-reducer", "rankdad-reducer",
+        "ring-attention", "ulysses-attention", "pipeline-train-step",
+        "tsp-train-step", "tsp-moe-train-step",
+    ):
+        assert expected in entries, f"missing deep entry '{expected}'"
+    # findings must anchor to real, committed source paths
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for name, path in entries.items():
+        if name.startswith("fixture-"):
+            continue
+        assert os.path.exists(os.path.join(repo, path)), (name, path)
+
+
+def test_deep_full_builtin_registry_is_clean():
+    """The live package's compiled surfaces all trace — the --deep gate."""
+    findings = run_deepcheck()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_deep_flag_validation(capsys, tmp_path):
+    from coinstac_dinunet_tpu.analysis.__main__ import main
+
+    rc = main(["--deep-entries", "x"])  # without --deep
+    assert rc == 2
+    rc = main(["--deep", "--deep-entries", "no-such-entry"])
+    assert rc == 2
+    assert "unknown deep entry point" in capsys.readouterr().err
+    # names are stripped, so a spaced list still resolves
+    src = tmp_path / "empty.py"
+    src.write_text("x = 1\n")
+    rc = main([str(src), "--deep",
+               "--deep-entries", " powersgd-reducer , rankdad-reducer "])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_empty_deep_entries_is_a_usage_error(capsys, tmp_path):
+    """',' / whitespace-only --deep-entries must not silently widen to the
+    full registry."""
+    from coinstac_dinunet_tpu.analysis.__main__ import main
+
+    src = tmp_path / "empty.py"
+    src.write_text("x = 1\n")
+    rc = main([str(src), "--deep", "--deep-entries", " , "])
+    assert rc == 2
+    assert "no entry names parsed" in capsys.readouterr().err
+
+
+def test_cli_write_baseline_refused_when_deep_tier_cannot_run(
+    capsys, tmp_path, monkeypatch
+):
+    """If --deep degraded to a deep-config finding (platform unavailable),
+    a baseline write would drop the tier's accepted entries and bless the
+    misconfiguration — it must be refused instead."""
+    from coinstac_dinunet_tpu.analysis.__main__ import main
+
+    monkeypatch.setattr(deepcheck, "REQUIRED_DEVICES", 10_000)
+    src = tmp_path / "empty.py"
+    src.write_text("x = 1\n")
+    baseline = tmp_path / "bl.json"
+    rc = main([str(src), "--deep", "--write-baseline",
+               "--baseline", str(baseline)])
+    assert rc == 2
+    assert "deep tier could not run" in capsys.readouterr().err
+    assert not baseline.exists()
+
+
+def test_cli_write_baseline_with_deep_entries_is_refused(capsys, tmp_path):
+    """A subset deep run can't refresh the baseline — it would drop every
+    other entry point's accepted deep findings (mirrors the --rules guard)."""
+    from coinstac_dinunet_tpu.analysis.__main__ import main
+
+    src = tmp_path / "empty.py"
+    src.write_text("x = 1\n")
+    rc = main([str(src), "--deep", "--deep-entries", "powersgd-reducer",
+               "--write-baseline", "--baseline", str(tmp_path / "bl.json")])
+    assert rc == 2
+    assert "--deep-entries" in capsys.readouterr().err
+    assert not (tmp_path / "bl.json").exists()
+
+
+def test_cli_list_deep(capsys):
+    from coinstac_dinunet_tpu.analysis.__main__ import main
+
+    rc = main(["--list-deep"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "trainer-train-step" in out

@@ -20,6 +20,14 @@ from coinstac_dinunet_tpu.analysis import (
     symbol_status,
     write_baseline,
 )
+from coinstac_dinunet_tpu.analysis.sharding import (
+    AxisLiteralRule,
+    CollectiveScopeRule,
+    MeshArityRule,
+    SpecArityRule,
+    UnknownAxisRule,
+    load_mesh_axes,
+)
 from coinstac_dinunet_tpu.analysis.trace_hazards import (
     HostSyncRule,
     ImpureCallRule,
@@ -608,3 +616,314 @@ def test_cli_json_format_and_exit_codes(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "0 new finding(s), 1 baselined" in out
+
+
+# ------------------------------------------------------------- sharding-*
+_MESH_KEYS_FIXTURE = """
+class MeshAxis:
+    SITE = "site"
+    DEVICE = "device"
+    SP = "sp"
+"""
+
+
+def _sharding(rule_cls, source, path="pkg/parallel/fixture.py"):
+    """Run one sharding rule (module pass + finalize) over a single fixture."""
+    rule = rule_cls(keys_source=textwrap.dedent(_MESH_KEYS_FIXTURE))
+    mod = _module(source, path)
+    return rule.visit_module(mod) + rule.finalize([mod])
+
+
+def test_sharding_unknown_axis_typo_fires():
+    """The seeded-bug acceptance fixture: a typo'd mesh axis is a finding."""
+    findings = _sharding(
+        UnknownAxisRule,
+        """
+        from jax.sharding import Mesh
+        mesh = Mesh(arr.reshape(2, 4), ("site", "devcie"))
+        """,
+    )
+    assert len(findings) == 1
+    assert "'devcie'" in findings[0].message
+    assert "MeshAxis" in findings[0].message
+
+
+def test_sharding_typo_in_collective_and_spec_fires_too():
+    findings = _sharding(
+        UnknownAxisRule,
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def helper(x):
+            return jax.lax.psum(x, "stie"), P("divice")
+        """,
+    )
+    assert sorted(f.message.split("'")[1] for f in findings) == ["divice", "stie"]
+
+
+def test_sharding_constants_and_known_literals_resolve():
+    """MeshAxis.X spellings (any attribute prefix) resolve against the
+    vocabulary and raise nothing from the unknown-axis rule."""
+    findings = _sharding(
+        UnknownAxisRule,
+        """
+        from jax.sharding import Mesh
+        from pkg.config.keys import MeshAxis
+        from pkg.config import keys
+
+        mesh = Mesh(arr.reshape(2, 4), (MeshAxis.SITE, keys.MeshAxis.DEVICE))
+        """,
+    )
+    assert findings == []
+
+
+def test_sharding_mesh_arity_reshape_mismatch():
+    findings = _sharding(
+        MeshArityRule,
+        """
+        from jax.sharding import Mesh
+        mesh = Mesh(arr.reshape(2, 4, 1), ("site", "device"))
+        """,
+    )
+    assert len(findings) == 1
+    assert "2 name(s)" in findings[0].message
+    assert "rank 3" in findings[0].message
+
+
+def test_sharding_mesh_duplicate_axis_and_clean_mesh():
+    findings = _sharding(
+        MeshArityRule,
+        """
+        from jax.sharding import Mesh
+        bad = Mesh(arr.reshape(2, 4), ("site", "site"))
+        good = Mesh(arr.reshape(2, 4), ("site", "device"))
+        """,
+    )
+    assert len(findings) == 1
+    assert "more than once" in findings[0].message
+
+
+def test_sharding_spec_repeated_axis():
+    findings = _sharding(
+        SpecArityRule,
+        """
+        from jax.sharding import PartitionSpec as P
+        spec = P("site", None, "site")
+        """,
+    )
+    assert len(findings) == 1
+    assert "more than once" in findings[0].message
+
+
+def test_sharding_spec_combo_no_mesh_defines():
+    """(site, sp) can never match a ("site", "device") mesh — the seeded
+    arity/combination acceptance fixture."""
+    findings = _sharding(
+        SpecArityRule,
+        """
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh = Mesh(arr.reshape(2, 4), ("site", "device"))
+        good = P("site", None, "device")
+        bad = P("site", "sp")
+        """,
+    )
+    assert len(findings) == 1
+    assert "(site, sp)" in findings[0].message
+    assert "no mesh defines" in findings[0].message
+
+
+def test_sharding_spec_combo_skipped_when_no_mesh_in_scan():
+    """A partial scan (spec-only file, no mesh anywhere) must not flood."""
+    findings = _sharding(
+        SpecArityRule,
+        """
+        from jax.sharding import PartitionSpec as P
+        spec = P("site", "sp")
+        """,
+    )
+    assert findings == []
+
+
+def test_sharding_collective_outside_shard_map_fires():
+    findings = _sharding(
+        CollectiveScopeRule,
+        """
+        import jax
+
+        def helper(x):
+            return jax.lax.psum(x, "site")
+        """,
+    )
+    assert len(findings) == 1
+    assert "`helper`" in findings[0].message
+    assert "unbound" in findings[0].message
+
+
+def test_sharding_collective_connected_via_partial_is_clean():
+    findings = _sharding(
+        CollectiveScopeRule,
+        """
+        import functools
+        import jax
+        from pkg.utils.jax_compat import shard_map
+
+        def body(x):
+            return _site_mean(x)
+
+        def _site_mean(x):
+            return jax.lax.pmean(x, "site")
+
+        def build(mesh):
+            return shard_map(functools.partial(body), mesh=mesh)
+        """,
+    )
+    assert findings == []
+
+
+def test_sharding_collective_returned_hook_escapes():
+    """The hook-factory idiom: a def returned to the caller leaves local
+    analysis — its shard_map lives in another module."""
+    findings = _sharding(
+        CollectiveScopeRule,
+        """
+        import jax
+
+        def _intra_grad_reduce(self):
+            def sp_grad_reduce(g, batch):
+                return jax.lax.pmean(g, "sp")
+            return sp_grad_reduce
+        """,
+    )
+    assert findings == []
+
+
+def test_sharding_collective_dynamic_axis_is_callers_problem():
+    findings = _sharding(
+        CollectiveScopeRule,
+        """
+        import jax
+
+        def reduce(x, axis_name):
+            return jax.lax.psum(x, axis_name)
+        """,
+    )
+    assert findings == []
+
+
+def test_sharding_axis_literal_flagged_constant_clean():
+    findings = _sharding(
+        AxisLiteralRule,
+        """
+        from jax.sharding import PartitionSpec as P
+        from pkg.config.keys import MeshAxis
+
+        legacy = P("site")
+        migrated = P(MeshAxis.SITE)
+        """,
+    )
+    assert len(findings) == 1
+    assert "MeshAxis.SITE" in findings[0].message
+
+
+def test_sharding_axis_kwarg_positions_are_checked():
+    """axis_name=/-suffixed *_axis kwargs are axis positions; int axes
+    (jnp.sum(axis=0)) are not."""
+    findings = _sharding(
+        AxisLiteralRule,
+        """
+        import jax.numpy as jnp
+
+        def f(model, x):
+            y = model(x, sp_axis="sp")
+            return jnp.sum(y, axis=0)
+        """,
+    )
+    assert len(findings) == 1
+    assert "'sp'" in findings[0].message
+
+
+def test_live_mesh_axis_vocabulary_matches_the_package():
+    """The real config/keys.py declares exactly the axes the parallel layer
+    meshes use — the sharding rules' single source of truth."""
+    axes = load_mesh_axes()
+    assert set(axes.values()) == {"site", "device", "dp", "tp", "sp", "ep", "pp"}
+
+
+def test_cli_github_format_annotations(tmp_path, capsys):
+    from coinstac_dinunet_tpu.analysis.__main__ import main
+
+    src = tmp_path / "drift.py"
+    src.write_text("import jax\nstep = jax.shard_map\n")
+
+    rc = main([str(src), "--format", "github", "--jax-version", "0.4.37"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "::error file=" in out
+    assert "title=dinulint jax-api-drift" in out
+    assert "1 new finding(s)" in out
+
+
+def test_sharding_kwarg_spelled_axis_reported_once():
+    """axis_name=/axis_names= kwargs are recorded by the dedicated mesh/
+    collective handlers — the generic *_axis kwarg sweep must not report
+    the same argument a second time."""
+    typo = _sharding(
+        UnknownAxisRule,
+        """
+        import jax
+        x = jax.lax.psum(x, axis_name="stie")
+        """,
+    )
+    assert len(typo) == 1
+    literal = _sharding(
+        AxisLiteralRule,
+        """
+        import jax
+        from jax.sharding import Mesh
+        mesh = Mesh(arr, axis_names=("site",))
+        y = jax.lax.psum(x, axis_name="site")
+        """,
+    )
+    assert len(literal) == 2  # one per call site, not two per call site
+
+
+def test_sharding_collection_is_shared_across_rules():
+    """All five rules reuse one cached AST walk per (module, vocabulary)."""
+    mod = _module(
+        """
+        from jax.sharding import Mesh
+        mesh = Mesh(arr.reshape(2, 4), ("site", "device"))
+        """
+    )
+    keys = textwrap.dedent(_MESH_KEYS_FIXTURE)
+    for cls in (UnknownAxisRule, MeshArityRule, SpecArityRule,
+                CollectiveScopeRule, AxisLiteralRule):
+        cls(keys_source=keys).visit_module(mod)
+    assert len(mod._sharding_info_cache) == 1
+
+
+def test_write_baseline_without_deep_preserves_deep_entries(tmp_path, capsys):
+    """A static-only --write-baseline refresh must carry accepted deep-*
+    entries over verbatim — that tier didn't run, so the refresh knows
+    nothing about them (docs/ANALYSIS.md 'The baseline workflow')."""
+    from coinstac_dinunet_tpu.analysis.__main__ import main
+
+    src = tmp_path / "drift.py"
+    src.write_text("import jax\nstep = jax.shard_map\n")
+    baseline = tmp_path / "bl.json"
+    baseline.write_text(json.dumps({
+        "findings": [
+            {"rule": "deep-eval-shape", "path": "pkg/entry.py",
+             "message": "entry 'x': eval_shape failed", "count": 1},
+        ],
+    }))
+
+    rc = main([str(src), "--jax-version", "0.4.37",
+               "--write-baseline", "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1 deep-* entry kept" in out
+    data = json.loads(baseline.read_text())
+    rules = sorted(e["rule"] for e in data["findings"])
+    assert rules == ["deep-eval-shape", "jax-api-drift"]
