@@ -28,6 +28,11 @@ Within such functions the rules flag:
   list/dict/pytree: set order varies across processes, so the resulting
   pytree structure (and therefore the compiled program) diverges between
   hosts of the same multi-controller run.
+- ``trace-telemetry`` — telemetry/Recorder/PhaseTimer calls: telemetry is
+  host-side wall-clock + file I/O; under tracing a span measures trace
+  time once and then vanishes from the compiled program (silently wrong
+  data), and any record emission is dead code at best.  Record around the
+  compiled call, never inside it.
 """
 import ast
 
@@ -51,6 +56,15 @@ _IMPURE_CALLS = {
 _IMPURE_PREFIXES = ("np.random.", "numpy.random.")
 _HOST_CASTS = {"float", "int", "bool"}
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+# telemetry surface (trace-telemetry rule): name segments that identify the
+# subsystem itself, recorder method names, and the variable-name convention
+# instrumented code uses for a bound recorder.  Roots are EXACT names —
+# a prefix match would flag e.g. `record.count(...)` (a plain list method
+# on an unlucky variable name) and fail the CI lint gate on clean code.
+_TELEMETRY_SEGMENTS = {"telemetry", "phasetimer", "get_active", "for_node"}
+_RECORDER_METHODS = {"span", "event", "wire", "count", "set_context", "flush"}
+_RECORDER_ROOTS = {"rec", "recorder", "telemetry", "tracer"}
 
 
 def _callable_name(node):
@@ -194,12 +208,40 @@ def _test_is_static(test):
     return False
 
 
+def _telemetry_call_name(node):
+    """Display name when ``node`` (a Call) hits the telemetry surface;
+    None otherwise.  Catches the module/class spellings
+    (``telemetry.get_active()``, ``Recorder.for_node(...)``,
+    ``PhaseTimer(cache)``), recorder-method calls on conventionally named
+    bindings (``rec.span(...)``, ``recorder.event(...)``) and chained calls
+    rooted at a factory (``get_active().count(...)``)."""
+    name = _callable_name(node.func)
+    segs = [s for s in (name or "").split(".") if s]
+    low = [s.lower() for s in segs]
+    if any(s in _TELEMETRY_SEGMENTS for s in low):
+        return name
+    if segs and low[-1] in _RECORDER_METHODS:
+        if low[0] in _RECORDER_ROOTS:
+            return name
+        inner = node.func.value if isinstance(node.func, ast.Attribute) else None
+        if isinstance(inner, ast.Call):
+            iname = _callable_name(inner.func) or ""
+            ilow = iname.lower()
+            if (
+                ilow.rsplit(".", 1)[-1] in _TELEMETRY_SEGMENTS
+                or "telemetry" in ilow
+            ):
+                return f"{iname}().{segs[-1]}"
+    return None
+
+
 class _TracedBodyChecker(ast.NodeVisitor):
     def __init__(self, rule_host, rule_impure, rule_ctl, rule_set,
-                 module, fn, reason, static_names=()):
+                 module, fn, reason, static_names=(), rule_tel=None):
         self.rh, self.ri, self.rc, self.rs = (
             rule_host, rule_impure, rule_ctl, rule_set
         )
+        self.rt = rule_tel
         self.module = module
         self.fn = fn
         self.reason = reason
@@ -223,6 +265,15 @@ class _TracedBodyChecker(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_Call(self, node):
+        if self.rt is not None:
+            tel = _telemetry_call_name(node)
+            if tel:
+                self._emit(
+                    self.rt, node,
+                    f"`{tel}()` is telemetry (host wall-clock + file I/O) — "
+                    "it is traced away in compiled code; record around the "
+                    "compiled call instead",
+                )
         name = _callable_name(node.func)
         last = name.rsplit(".", 1)[-1] if name else ""
         if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
@@ -297,7 +348,7 @@ class _TracedBodyChecker(ast.NodeVisitor):
 class _TraceHazardBase(Rule):
     """Shared machinery; subclasses pick which finding family they own."""
 
-    family = None  # 'host' | 'impure' | 'ctl' | 'set'
+    family = None  # 'host' | 'impure' | 'ctl' | 'set' | 'telemetry'
 
     def visit_module(self, module):
         findings = []
@@ -308,6 +359,7 @@ class _TraceHazardBase(Rule):
                 rule_impure=self.id if self.family == "impure" else None,
                 rule_ctl=self.id if self.family == "ctl" else None,
                 rule_set=self.id if self.family == "set" else None,
+                rule_tel=self.id if self.family == "telemetry" else None,
                 module=module, fn=fn, reason=reason,
                 static_names=static_names,
             )
@@ -346,3 +398,12 @@ class SetIterationRule(_TraceHazardBase):
     doc = ("set iteration feeding pytree construction under tracing — "
            "cross-host nondeterminism.")
     family = "set"
+
+
+@register_rule
+class TelemetryInTraceRule(_TraceHazardBase):
+    id = "trace-telemetry"
+    doc = ("telemetry/Recorder/PhaseTimer calls inside jit/shard_map bodies "
+           "— host-side I/O is traced away (spans measure trace time once, "
+           "records never emit); instrument around the compiled call.")
+    family = "telemetry"

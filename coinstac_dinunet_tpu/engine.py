@@ -22,7 +22,7 @@ import shutil
 
 import numpy as np
 
-from . import config, utils
+from . import config, telemetry, utils
 from .config.keys import Key, Mode, Phase
 from .data import COINNDataHandle
 from .nodes import COINNLocal, COINNRemote
@@ -137,6 +137,30 @@ class InProcessEngine:
         # (COINNRemote._init_runs setdefaults, so this wins)
         self.remote_cache["all_sites"] = list(self.site_ids)
 
+    # ------------------------------------------------------------- telemetry
+    def _recorder(self):
+        """The engine driver's own timeline lane (``telemetry.engine.jsonl``
+        in the workdir): per-round spans around every node invocation and
+        the file relay, so the merged Perfetto view shows where a federated
+        round's wall-clock actually goes.  Enabled when any arg channel
+        carries ``profile``/``telemetry`` (the same flags that enable the
+        node-side recorders); re-checks cheaply until enabled because
+        fresh-process engines only learn the flag from round 1's cache."""
+        rec = getattr(self, "_telemetry_rec", None)
+        if rec is not None:
+            return rec
+
+        def on(d):
+            return isinstance(d, dict) and (d.get("profile") or d.get("telemetry"))
+
+        chans = [self.args, *self.site_args.values(), *self.site_spec.values(),
+                 *self.site_caches.values()]
+        chans += list(getattr(self, "first_input", {}).values() or [])
+        if any(on(c) for c in chans):
+            self._telemetry_rec = telemetry.Recorder("engine", out_dir=self.workdir)
+            return self._telemetry_rec
+        return telemetry.NULL_RECORDER
+
     # --------------------------------------------------------- site dropout
     def _alive_site_ids(self):
         return [s for s in self.site_ids if s not in self.dead_sites]
@@ -175,6 +199,9 @@ class InProcessEngine:
             raise exc
         self.dead_sites.add(s)
         self.site_failures[s] = f"{type(exc).__name__}: {exc}"
+        self._recorder().event(
+            "site_died", cat="quorum", site=s, error=self.site_failures[s],
+        )
         logger.warn(
             f"site {s} died mid-run ({self.site_failures[s]}); "
             "excluded from the remaining rounds (site_quorum set)"
@@ -189,50 +216,58 @@ class InProcessEngine:
     def step_round(self):
         """One full engine round: every site computes, files relay to the
         aggregator, the aggregator computes, its output + files relay back."""
+        rec = self._recorder()
+        rec.set_context(round=self.rounds + 1)
         site_outs = {}
-        for s in self._alive_site_ids():
-            node = COINNLocal(
-                cache=self.site_caches[s],
-                input=self.site_inputs[s],
-                state=self.site_states[s],
-                **{**self.site_spec.get(s, {}), **self.args,
-                   **self.site_args.get(s, {})},
-            )
-            try:
-                result = node(
-                    trainer_cls=self.trainer_cls,
-                    dataset_cls=self.dataset_cls,
-                    datahandle_cls=self.datahandle_cls,
-                    learner_cls=self.learner_cls,
-                )
-            except Exception as exc:  # noqa: BLE001 — see _site_failure
-                self._site_failure(s, exc)
-                continue
-            site_outs[s] = result["output"]
-
-        if not site_outs:
-            raise RuntimeError(
-                "every site died; nothing to aggregate — failures: "
-                f"{self.site_failures}"
-            )
-        remote = COINNRemote(
-            cache=self.remote_cache, input=site_outs, state=self.remote_state
-        )
-        result = remote(
-            trainer_cls=self.remote_trainer_cls, reducer_cls=self.reducer_cls
-        )
-        remote_out = result["output"]
-        self.success = bool(result.get("success"))
-        self.last_remote_out = remote_out
-
-        # relay aggregator transfer files into every surviving site's inbox
-        xfer = self.remote_state["transferDirectory"]
-        for f in os.listdir(xfer):
+        with rec.span("engine:round", cat="engine"):
             for s in self._alive_site_ids():
-                shutil.copy(
-                    os.path.join(xfer, f),
-                    os.path.join(self.site_states[s]["baseDirectory"], f),
+                node = COINNLocal(
+                    cache=self.site_caches[s],
+                    input=self.site_inputs[s],
+                    state=self.site_states[s],
+                    **{**self.site_spec.get(s, {}), **self.args,
+                       **self.site_args.get(s, {})},
                 )
+                try:
+                    with rec.span(f"invoke:{s}", cat="invoke"):
+                        result = node(
+                            trainer_cls=self.trainer_cls,
+                            dataset_cls=self.dataset_cls,
+                            datahandle_cls=self.datahandle_cls,
+                            learner_cls=self.learner_cls,
+                        )
+                except Exception as exc:  # noqa: BLE001 — see _site_failure
+                    self._site_failure(s, exc)
+                    continue
+                site_outs[s] = result["output"]
+
+            if not site_outs:
+                raise RuntimeError(
+                    "every site died; nothing to aggregate — failures: "
+                    f"{self.site_failures}"
+                )
+            remote = COINNRemote(
+                cache=self.remote_cache, input=site_outs, state=self.remote_state
+            )
+            with rec.span("invoke:remote", cat="invoke"):
+                result = remote(
+                    trainer_cls=self.remote_trainer_cls,
+                    reducer_cls=self.reducer_cls,
+                )
+            remote_out = result["output"]
+            self.success = bool(result.get("success"))
+            self.last_remote_out = remote_out
+
+            # relay aggregator transfer files into every surviving site's inbox
+            with rec.span("engine:relay", cat="relay"):
+                xfer = self.remote_state["transferDirectory"]
+                for f in os.listdir(xfer):
+                    for s in self._alive_site_ids():
+                        shutil.copy(
+                            os.path.join(xfer, f),
+                            os.path.join(self.site_states[s]["baseDirectory"], f),
+                        )
+        rec.flush()
         self.site_inputs = {s: dict(remote_out) for s in self._alive_site_ids()}
         self.rounds += 1
         return site_outs, remote_out
@@ -320,44 +355,51 @@ class SubprocessEngine(InProcessEngine):
         )
 
     def step_round(self):
+        rec = self._recorder()
+        rec.set_context(round=self.rounds + 1)
         site_outs = {}
-        for s in self._alive_site_ids():
-            inp = dict(self.site_inputs[s])
-            if s not in self._first_done:
-                inp.update(self.first_input.get(s, {}))
-                self._first_done.add(s)
-            try:
-                res = self._invoke(self.local_script, {
-                    "cache": self.site_caches[s], "input": inp,
-                    "state": self.site_states[s],
-                })
-            except Exception as exc:  # noqa: BLE001 — see _site_failure
-                self._site_failure(s, exc)
-                continue
-            self.site_caches[s] = res.get("cache", {})
-            site_outs[s] = res["output"]
-
-        if not site_outs:
-            raise RuntimeError(
-                "every site died; nothing to aggregate — failures: "
-                f"{self.site_failures}"
-            )
-        res = self._invoke(self.remote_script, {
-            "cache": self.remote_cache, "input": site_outs,
-            "state": self.remote_state,
-        })
-        self.remote_cache = res.get("cache", {})
-        remote_out = res["output"]
-        self.success = bool(res.get("success"))
-        self.last_remote_out = remote_out
-
-        xfer = self.remote_state["transferDirectory"]
-        for f in os.listdir(xfer):
+        with rec.span("engine:round", cat="engine"):
             for s in self._alive_site_ids():
-                shutil.copy(
-                    os.path.join(xfer, f),
-                    os.path.join(self.site_states[s]["baseDirectory"], f),
+                inp = dict(self.site_inputs[s])
+                if s not in self._first_done:
+                    inp.update(self.first_input.get(s, {}))
+                    self._first_done.add(s)
+                try:
+                    with rec.span(f"invoke:{s}", cat="invoke"):
+                        res = self._invoke(self.local_script, {
+                            "cache": self.site_caches[s], "input": inp,
+                            "state": self.site_states[s],
+                        })
+                except Exception as exc:  # noqa: BLE001 — see _site_failure
+                    self._site_failure(s, exc)
+                    continue
+                self.site_caches[s] = res.get("cache", {})
+                site_outs[s] = res["output"]
+
+            if not site_outs:
+                raise RuntimeError(
+                    "every site died; nothing to aggregate — failures: "
+                    f"{self.site_failures}"
                 )
+            with rec.span("invoke:remote", cat="invoke"):
+                res = self._invoke(self.remote_script, {
+                    "cache": self.remote_cache, "input": site_outs,
+                    "state": self.remote_state,
+                })
+            self.remote_cache = res.get("cache", {})
+            remote_out = res["output"]
+            self.success = bool(res.get("success"))
+            self.last_remote_out = remote_out
+
+            with rec.span("engine:relay", cat="relay"):
+                xfer = self.remote_state["transferDirectory"]
+                for f in os.listdir(xfer):
+                    for s in self._alive_site_ids():
+                        shutil.copy(
+                            os.path.join(xfer, f),
+                            os.path.join(self.site_states[s]["baseDirectory"], f),
+                        )
+        rec.flush()
         self.site_inputs = {s: dict(remote_out) for s in self._alive_site_ids()}
         self.rounds += 1
         return site_outs, remote_out

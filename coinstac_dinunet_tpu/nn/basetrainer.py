@@ -33,6 +33,7 @@ import optax
 from .. import config
 from ..config.keys import Key, MeshAxis, Mode
 from ..metrics import COINNAverages, Prf1a
+from ..telemetry import get_active as _telemetry
 from ..utils import atomic_write, logger
 from ..utils.jax_compat import shard_map
 from ..utils.utils import performance_improved_, stop_training_
@@ -64,7 +65,7 @@ _VOLATILE_CACHE_KEYS = frozenset((
     "cursor", "epoch", "fold", "folds", "mode", "data_size",
     "splits", "split_ix", "split_dir", "split_file", "split_files",
     "skipped_sites", "global_test_metrics", "log_dir", "log_header",
-    "resume", "profile_stats", "weights_file", "train_log",
+    "resume", "profile_stats", "telemetry_round", "weights_file", "train_log",
     "validation_log", "test_log", "seed", "verbose",
     # Key.* bookkeeping the nodes append per round/fold (metrics rollups,
     # serialized score blobs, one-shot flags) — all host-side, never traced
@@ -394,7 +395,10 @@ class NNTrainer:
         path = full_path or self.checkpoint_path(name)
         # atomic: a crash mid-write can never truncate the previous good
         # checkpoint (these files are the crash-resume points)
-        atomic_write(path, flax.serialization.msgpack_serialize(payload))
+        with _telemetry().span(
+            "checkpoint:save", cat="io", file=os.path.basename(path)
+        ):
+            atomic_write(path, flax.serialization.msgpack_serialize(payload))
         return path
 
     def load_checkpoint(self, name=None, full_path=None, load_optimizer=True,
@@ -522,6 +526,17 @@ class NNTrainer:
     def _metrics_shell(self):
         return self.new_metrics(), self.new_averages()
 
+    def _note_jit_build(self, key):
+        """Telemetry marker: a compiled step is about to be (re)traced and
+        built — paired with the jax.monitoring compile-duration bridge this
+        is the per-round recompile counter.  Host-side only: this must
+        never be called from inside the traced function itself (the
+        ``trace-telemetry`` dinulint rule enforces it)."""
+        _telemetry().event(
+            "jit_build", cat="compile", fn=str(key),
+            trainer=type(self).__qualname__,
+        )
+
     # ---- local multi-device data parallelism ----------------------------
     # ≙ the reference's automatic torch.nn.DataParallel fan-out over a
     # site's GPUs (ref ``nn/basetrainer.py:62-74``): train/eval steps shard
@@ -631,6 +646,7 @@ class NNTrainer:
         This is the site-side half of a federated round (≙ learner.backward).
         With >1 local device the batch fans out over a ``device`` mesh axis
         (≙ ref DataParallel) and the returned grads are the exact masked-mean."""
+        _telemetry().count("grad_steps")
         n = self._dp_device_count(
             jax.tree_util.tree_leaves(stacked_batches)[0].shape[1]
         )
@@ -638,6 +654,7 @@ class NNTrainer:
             return self._compute_grads_dp(ts, stacked_batches, n)
         fn = self._compiled.get("grads")
         if fn is None:
+            self._note_jit_build("grads")
             metrics_shell, averages_shell = self._metrics_shell()
 
             def _grads(ts, stacked):
@@ -686,6 +703,7 @@ class NNTrainer:
     def _compute_grads_dp(self, ts, stacked_batches, n):
         fn = self._compiled.get(("grads_dp", n))
         if fn is None:
+            self._note_jit_build(f"grads_dp:{n}")
             fn = self._compiled[("grads_dp", n)] = self._build_dp_step(
                 n, apply_updates=False, donate=()
             )
@@ -696,6 +714,7 @@ class NNTrainer:
         gradients — the site-side apply half of a federated round."""
         fn = self._compiled.get("apply")
         if fn is None:
+            self._note_jit_build("apply")
             fn = self._compiled["apply"] = jax.jit(self._apply_updates)
         ts = fn(ts, grads)
         if new_rng is not None:
@@ -717,6 +736,7 @@ class NNTrainer:
         (≙ the reference's automatic DataParallel, ``nn/basetrainer.py:
         62-74``); the mask-weighted reduction keeps the update identical to
         the single-device step (up to per-shard dropout streams)."""
+        _telemetry().count("train_steps")
         n = self._dp_device_count(
             jax.tree_util.tree_leaves(stacked_batches)[0].shape[1]
         )
@@ -724,6 +744,7 @@ class NNTrainer:
             return self._train_step_dp(ts, stacked_batches, n)
         fn = self._compiled.get("train")
         if fn is None:
+            self._note_jit_build("train")
             metrics_shell, averages_shell = self._metrics_shell()
 
             def _full(ts, stacked):
@@ -747,6 +768,7 @@ class NNTrainer:
     def _train_step_dp(self, ts, stacked_batches, n):
         fn = self._compiled.get(("train_dp", n))
         if fn is None:
+            self._note_jit_build(f"train_dp:{n}")
             donate = (
                 (0,)
                 if jax.default_backend() != "cpu"
@@ -822,11 +844,13 @@ class NNTrainer:
         return grads, aux
 
     def eval_step(self, ts, batch):
+        _telemetry().count("eval_steps")
         n = self._dp_device_count(jax.tree_util.tree_leaves(batch)[0].shape[0])
         if n > 1:
             return self._eval_step_dp(ts, batch, n)
         fn = self._compiled.get("eval")
         if fn is None:
+            self._note_jit_build("eval")
             metrics_shell, averages_shell = self._metrics_shell()
 
             def _eval(ts, batch):
@@ -842,6 +866,7 @@ class NNTrainer:
 
         fn = self._compiled.get(("eval_dp", n))
         if fn is None:
+            self._note_jit_build(f"eval_dp:{n}")
             metrics_shell, averages_shell = self._metrics_shell()
 
             def shard_eval(ts, batch):

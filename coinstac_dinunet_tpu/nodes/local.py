@@ -18,12 +18,11 @@ import shutil
 import time
 import traceback
 
-from .. import config, utils
+from .. import config, telemetry, utils
 from ..config.keys import AggEngine, Key, LocalWire, Mode, Phase, RemoteWire
 from ..data import COINNDataHandle
 from ..parallel import COINNLearner, DADLearner, PowerSGDLearner
 from ..utils import logger
-from ..utils.profiling import PhaseTimer
 
 # engine/epoch state cleared on every fold transition
 _EPHEMERAL_KEYS = (
@@ -161,10 +160,13 @@ class COINNLocal:
             }
             self.cache.update(pretrain_args)
             self.cache["pretrain"] = True
-            trainer.train_local(
-                trainer.data_handle.get_train_dataset(),
-                trainer.data_handle.get_validation_dataset(),
-            )
+            with telemetry.get_active().span(
+                "local:pretrain", cat="train", epochs=epochs
+            ):
+                trainer.train_local(
+                    trainer.data_handle.get_train_dataset(),
+                    trainer.data_handle.get_validation_dataset(),
+                )
             self.cache.update({k: v for k, v in saved.items() if v is not None})
             # advertise the shipped best weights so the aggregator broadcasts
             if self.cache.get("weights_file"):
@@ -410,17 +412,20 @@ class COINNLocal:
         global_modes = self.input.get(RemoteWire.GLOBAL_MODES.value, {})
         self.out[LocalWire.MODE.value] = global_modes.get(client_id, self.cache.get("mode"))
 
+        rec = telemetry.get_active()
         if self.out[LocalWire.PHASE.value] == Phase.COMPUTATION.value:
             if self.input.get(RemoteWire.SAVE_CURRENT_AS_BEST.value):
                 trainer.save_checkpoint(name=self.cache["best_nn_state"])
 
             if self.input.get(RemoteWire.UPDATE.value):
-                self.out.update(**learner.step())
+                with rec.span("local:step", cat="update"):
+                    self.out.update(**learner.step())
 
             if any(m == Mode.TRAIN.value for m in global_modes.values()) or (
                 not global_modes and self.out[LocalWire.MODE.value] == Mode.TRAIN.value
             ):
-                self.out.update(**learner.to_reduce())
+                with rec.span("local:to_reduce", cat="backward"):
+                    self.out.update(**learner.to_reduce())
 
             if global_modes and all(
                 m == Mode.VALIDATION.value for m in global_modes.values()
@@ -467,12 +472,19 @@ class COINNLocal:
         return self.out
 
     def __call__(self, *a, **kw):
+        # telemetry: per-phase spans + wire accounting land in per-node
+        # JSONL (and cache['profile_stats'], dumped to logs.json) when
+        # cache['profile'] is set — the structured successor to the
+        # realtime profiling the reference delegates to its engine
+        # (SURVEY §5); see docs/TELEMETRY.md
+        phase = self.input.get(RemoteWire.PHASE.value, Phase.INIT_RUNS.value)
+        rec = telemetry.Recorder.for_node(
+            self.cache, self.state, node=self.state.get("clientId", "site")
+        )
+        rec.begin_invocation(phase=str(phase))
         try:
-            # per-phase wall-clock lands in cache['profile_stats'] (dumped to
-            # logs.json) when cache['profile'] is set — realtime per-site
-            # profiling the reference delegates to its engine (SURVEY §5)
-            with PhaseTimer(self.cache)(
-                f"local:{self.input.get('phase', Phase.INIT_RUNS.value)}"
+            with telemetry.activate(rec), rec.span(
+                f"local:{phase}", cat="node"
             ):
                 self.compute(*a, **kw)
             # "cache" carries the JSON-able node cache back to engines that
@@ -486,8 +498,14 @@ class COINNLocal:
                 }),
             }
         except Exception as exc:
+            rec.event(
+                "node_error", cat="error",
+                error=f"{type(exc).__name__}: {exc}",
+            )
             traceback.print_exc()
             raise RuntimeError(
                 f"Local node failed ({type(exc).__name__}: {exc}) with "
                 f"partial out: {self.out}"
             )
+        finally:
+            rec.flush()

@@ -20,13 +20,12 @@ import os
 import shutil
 import traceback
 
-from .. import config, utils
+from .. import config, telemetry, utils
 from ..config.keys import AggEngine, GatherMode, Key, LocalWire, Mode, Phase, RemoteWire
 from ..data import EmptyDataHandle
 from ..parallel import COINNReducer, DADReducer, PowerSGDReducer
 from ..utils import logger
 from ..utils.logger import lazy_debug
-from ..utils.profiling import PhaseTimer
 from ..utils.utils import performance_improved_, stop_training_
 from ..vision import plotter
 from . import check, gather
@@ -87,7 +86,17 @@ class COINNRemote:
             return
         self.cache["dropped_sites"] = dropped
         quorum = self.cache.get("site_quorum")
+        # every quorum decision is a timeline event: which sites vanished,
+        # who survives, what policy applied (docs/TELEMETRY.md schema)
+        telemetry.get_active().event(
+            "quorum:drop", cat="quorum", sites=sorted(set(dropped) - prev),
+            alive=sorted(alive), quorum=quorum,
+        )
         if not quorum:
+            telemetry.get_active().event(
+                "quorum:fail", cat="quorum", reason="no site_quorum policy",
+                dropped=dropped,
+            )
             raise RuntimeError(
                 f"sites {dropped} stopped reporting (round input has "
                 f"{sorted(alive)} of {roster}).  The default contract is "
@@ -99,11 +108,19 @@ class COINNRemote:
                 if 0 < float(quorum) <= 1 and not isinstance(quorum, int)
                 else int(quorum))
         if len(alive) < max(need, 1):
+            telemetry.get_active().event(
+                "quorum:fail", cat="quorum", reason="quorum unmet",
+                alive=sorted(alive), need=max(need, 1), dropped=dropped,
+            )
             raise RuntimeError(
                 f"quorum unmet: {len(alive)} sites alive "
                 f"({sorted(alive)}), quorum {quorum} of {len(roster)} "
                 f"requires >= {max(need, 1)}; dropped: {dropped}"
             )
+        telemetry.get_active().event(
+            "quorum:continue", cat="quorum", alive=sorted(alive),
+            dropped=dropped,
+        )
         logger.warn(
             f"sites {dropped} dropped; continuing with {sorted(alive)} "
             f"(quorum {quorum} satisfied) — aggregates are survivor-"
@@ -323,6 +340,7 @@ class COINNRemote:
             self.out.update(**self._pre_compute())
             self.out[RemoteWire.PHASE.value] = Phase.PRE_COMPUTATION.value
 
+        rec = telemetry.get_active()
         self.out[RemoteWire.GLOBAL_MODES.value] = self._set_mode()
         if check(all, LocalWire.PHASE.value, Phase.COMPUTATION.value, self.input):
             reducer = self._get_reducer_cls(reducer_cls)(
@@ -330,7 +348,12 @@ class COINNRemote:
             )
             self.out[RemoteWire.PHASE.value] = Phase.COMPUTATION.value
             if check(all, LocalWire.REDUCE.value, True, self.input):
-                self.out.update(**reducer.reduce())
+                with rec.span(
+                    "remote:reduce", cat="reduce",
+                    engine=str(self.cache.get("agg_engine")),
+                    sites=len(self.input),
+                ):
+                    self.out.update(**reducer.reduce())
 
             if check(all, LocalWire.MODE.value, Mode.VALIDATION_WAITING.value, self.input):
                 self.cache["epoch"] += 1
@@ -340,11 +363,13 @@ class COINNRemote:
                     self.out[RemoteWire.GLOBAL_MODES.value] = self._set_mode(Mode.TRAIN.value)
 
             if check(all, LocalWire.MODE.value, Mode.TRAIN_WAITING.value, self.input):
-                info = self._on_epoch_end(trainer)
+                with rec.span("remote:epoch_end", cat="barrier"):
+                    info = self._on_epoch_end(trainer)
                 self.out[RemoteWire.GLOBAL_MODES.value] = self._set_mode(self._next_epoch(**info))
 
         if check(all, LocalWire.PHASE.value, Phase.NEXT_RUN_WAITING.value, self.input):
-            self._on_run_end(trainer)
+            with rec.span("remote:run_end", cat="barrier"):
+                self._on_run_end(trainer)
             if self.cache["folds"]:
                 self.out[RemoteWire.GLOBAL_RUNS.value] = self._next_run(trainer)
                 self.out[RemoteWire.PHASE.value] = Phase.NEXT_RUN.value
@@ -354,8 +379,10 @@ class COINNRemote:
         return self.out
 
     def __call__(self, *a, **kw):
+        rec = telemetry.Recorder.for_node(self.cache, self.state, node="remote")
+        rec.begin_invocation()
         try:
-            with PhaseTimer(self.cache)("remote:round"):
+            with telemetry.activate(rec), rec.span("remote:round", cat="node"):
                 self.compute(*a, **kw)
             return {
                 "output": self.out,
@@ -367,8 +394,14 @@ class COINNRemote:
                 }),
             }
         except Exception as exc:
+            rec.event(
+                "node_error", cat="error",
+                error=f"{type(exc).__name__}: {exc}",
+            )
             traceback.print_exc()
             raise RuntimeError(
                 f"Remote node failed ({type(exc).__name__}: {exc}) with "
                 f"partial out: {self.out}"
             )
+        finally:
+            rec.flush()

@@ -22,6 +22,7 @@ import numpy as np
 
 from .. import config
 from ..ops import orthogonalize
+from ..telemetry import get_active as _telemetry
 from ..utils import tensorutils
 from .learner import COINNLearner
 from .reducer import COINNReducer
@@ -222,6 +223,24 @@ class PowerSGDLearner(COINNLearner):
         st.Ms = [M + e for M, e in zip(Ms, st.errors)]
         Ps = _compute_P(st.Ms, st.Qs)
         wire = config.wire_dtype(self.precision_bits)
+        rec = _telemetry()
+        if rec.enabled:
+            # rank compression accounting: what the full gradient would
+            # have weighed vs the factorized (P now, Q + rank-1 next
+            # round) payloads — wire events add the codec ratio on top
+            itemsize = np.dtype(wire).itemsize
+            full = sum(int(np.prod(s)) for s in st.shapes) + sum(
+                int(a.size) for a in st.rank1
+            )
+            factored = sum(
+                (int(s[0]) + int(np.prod(s[1:]))) * self.rank
+                for s in st.shapes
+            ) + sum(int(a.size) for a in st.rank1)
+            rec.event(
+                "powersgd:compress", cat="compress", rank=self.rank,
+                matrices=len(Ps), rank1=len(st.rank1),
+                full_bytes=full * itemsize, factored_bytes=factored * itemsize,
+            )
         self._save_wire(config.powersgd_P_file, [np.asarray(P, wire) for P in Ps])
         out["powerSGD_P_file"] = config.powersgd_P_file
         out["powerSGD_phase"] = PHASE_P_SYNC
@@ -292,6 +311,11 @@ class PowerSGDReducer(COINNReducer):
             return out
         if phases == {PHASE_P_SYNC}:
             avg_P = self._average(self._load("powerSGD_P_file"))
+            _telemetry().event(
+                "reduce:powerSGD", cat="reduce", phase=PHASE_P_SYNC,
+                sites=len(self.input), matrices=len(avg_P),
+                rank=int(self.cache.get("matrix_approximation_rank", 1)),
+            )
             fname = self._save_out(config.powersgd_P_file, avg_P)
             return {"powerSGD_P_file": fname, "powerSGD_phase": PHASE_Q_SYNC}
         if phases == {PHASE_Q_SYNC}:
@@ -299,6 +323,11 @@ class PowerSGDReducer(COINNReducer):
             qname = self._save_out(config.powersgd_Q_file, avg_Q)
             avg_r1 = self._average(self._load("rank1_file"))
             rname = self._save_out(rank1_file, avg_r1)
+            _telemetry().event(
+                "reduce:powerSGD", cat="reduce", phase=PHASE_Q_SYNC,
+                sites=len(self.input), matrices=len(avg_Q),
+                rank=int(self.cache.get("matrix_approximation_rank", 1)),
+            )
             return {
                 "powerSGD_Q_file": qname,
                 "rank1_file": rname,

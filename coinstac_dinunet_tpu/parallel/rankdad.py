@@ -34,6 +34,7 @@ import numpy as np
 
 from .. import config
 from ..ops import power_iteration_BC
+from ..telemetry import get_active as _telemetry
 from ..utils import logger, tensorutils
 from .learner import COINNLearner
 from .reducer import COINNReducer
@@ -255,6 +256,22 @@ class DADLearner(COINNLearner):
         for lk in st.layer_keys:
             payload.append(np.asarray(Brs[lk], wire))
             payload.append(np.asarray(Crs[lk], wire))
+        rec = _telemetry()
+        if rec.enabled:
+            # (delta, activation) factor bytes vs what the full per-layer
+            # kernel grads would have weighed at the same wire dtype
+            itemsize = np.dtype(wire).itemsize
+            factored = sum(int(a.size) for a in payload)
+            full = sum(
+                int(payload[2 * i].shape[1]) * int(payload[2 * i + 1].shape[1])
+                for i in range(len(st.layer_keys))
+            )
+            rec.event(
+                "rankdad:compress", cat="compress",
+                rank=int(self.cache.get("dad_reduction_rank", 10)),
+                layers=len(st.layer_keys),
+                full_bytes=full * itemsize, factored_bytes=factored * itemsize,
+            )
         self._save_wire(config.dad_data_file, payload)
         self._save_wire(dad_rest_file, [np.asarray(g, wire) for g in rest])
         out["dad_data_file"] = config.dad_data_file
@@ -318,6 +335,10 @@ class DADReducer(COINNReducer):
     def reduce(self):
         site_payloads = self._load("dad_data_file")
         n_layers = len(site_payloads[0]) // 2
+        _telemetry().event(
+            "reduce:rankDAD", cat="reduce", sites=len(self.input),
+            layers=n_layers, rank=self.rank, pow_iters=self.iters,
+        )
         wire = config.wire_dtype(self.precision_bits)
         out_payload = []
         key = jax.random.PRNGKey(int(self.cache.get("seed", 0)) + 29)

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import config
+from ..telemetry import get_active as _telemetry
 from ..utils import logger, tensorutils
 
 
@@ -142,6 +143,10 @@ class COINNReducer:
                     "epoch": int(self.cache.get("epoch", 0)),
                     "sites": bad,
                 })
+                _telemetry().event(
+                    "reduce:nonfinite_skip", cat="reduce", sites=bad,
+                    reduce_round=self.cache["_reduce_round"],
+                )
                 # a failure event is never verbosity-gated
                 logger.warn(
                     f"non-finite gradients from sites {bad}; excluded this round",
@@ -154,5 +159,9 @@ class COINNReducer:
         """Average all sites' gradients → ship ``avg_grads`` + signal update
         (≙ ref ``reducer.py:43-54``)."""
         avg = self._average(self._load("grads_file"))
+        _telemetry().event(
+            "reduce:dSGD", cat="reduce", sites=len(self.input),
+            leaves=len(avg),
+        )
         fname = self._save_out(config.avg_grads_file, avg)
         return {"avg_grads_file": fname, "update": True}

@@ -1,0 +1,28 @@
+"""Federation-wide structured telemetry (spans, wire accounting, timelines).
+
+Public surface:
+
+- :class:`Recorder` / :data:`NULL_RECORDER` — per-node event sink with a
+  zero-overhead disabled mode (``Recorder.for_node(cache, state)``).
+- :func:`get_active` / :func:`activate` — the ambient-recorder stack that
+  lets deep layers (wire serialization, reducers, the trainer) record
+  without plumbing a recorder through every signature.
+- :mod:`.collect` — merge per-node JSONL into one federation timeline,
+  summary tables and Perfetto/Chrome-trace export; CLI at
+  ``python -m coinstac_dinunet_tpu.telemetry``.
+
+Stdlib-only by design: importing this package never pulls in jax (the
+recorder bridges to ``jax.monitoring`` only if jax is already loaded).
+See ``docs/TELEMETRY.md`` for the schema and workflow.
+"""
+from .recorder import (  # noqa: F401
+    NULL_RECORDER,
+    Recorder,
+    SCHEMA_VERSION,
+    activate,
+    get_active,
+)
+
+__all__ = [
+    "Recorder", "NULL_RECORDER", "SCHEMA_VERSION", "activate", "get_active",
+]

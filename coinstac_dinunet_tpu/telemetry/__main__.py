@@ -1,0 +1,63 @@
+"""``python -m coinstac_dinunet_tpu.telemetry`` — the federation timeline CLI.
+
+Merge every node's ``telemetry.*.jsonl`` under a run directory, print the
+per-phase/per-site summary table, and export a Perfetto/Chrome-trace JSON::
+
+    python -m coinstac_dinunet_tpu.telemetry <workdir> --trace trace.json
+
+Open the trace at https://ui.perfetto.dev (or ``chrome://tracing``).
+"""
+import argparse
+import json
+import os
+import sys
+
+from .collect import load_events, render_summary, summarize, write_chrome_trace
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m coinstac_dinunet_tpu.telemetry",
+        description="merge per-node telemetry JSONL into one federation "
+                    "timeline (summary table + Perfetto trace)",
+    )
+    p.add_argument("root", nargs="?", default=".",
+                   help="run directory scanned recursively for "
+                        "telemetry.*.jsonl (default: .)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="write the merged Chrome-trace/Perfetto JSON here")
+    p.add_argument("--summary-json", default=None, metavar="PATH",
+                   help="write the machine-readable summary here")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress the summary table on stdout")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    events = load_events(args.root)
+    if not events:
+        print(f"no telemetry records under {args.root!r} — enable with "
+              "cache['profile']=True (docs/TELEMETRY.md)", file=sys.stderr)
+        return 1
+    summary = summarize(events)
+    if not args.quiet:
+        print(render_summary(summary))
+    if args.summary_json:
+        with open(args.summary_json, "w", encoding="utf-8") as f:
+            json.dump(summary, f, indent=2, sort_keys=True)
+    if args.trace:
+        trace = write_chrome_trace(args.trace, events)
+        if not args.quiet:
+            print(f"\nwrote {len(trace['traceEvents'])} trace events for "
+                  f"{len(summary['nodes'])} nodes -> {args.trace} "
+                  "(load at https://ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `... | head` is a legitimate way to use this
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -1,0 +1,297 @@
+"""Federation telemetry collector: merge per-node JSONL into one timeline.
+
+Every node of a run (site nodes, the aggregator, the engine driver) appends
+``telemetry.<node>.jsonl`` records into its own output directory
+(:mod:`.recorder` documents the schema).  This module walks a run's working
+directory, merges every node's records into one wall-clock-ordered
+federation timeline, renders a per-node/per-phase summary table, and exports
+Chrome-trace JSON (the ``traceEvents`` format) loadable by Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing`` — so a whole k-fold
+federated run is visually inspectable: site compute lanes, wire transfers
+with byte counts, and the aggregator's reduces, all on one timebase.
+"""
+import json
+import os
+import re
+
+from .recorder import FILE_PREFIX, FILE_SUFFIX
+
+_FILE_RE = re.compile(
+    re.escape(FILE_PREFIX) + r".+" + re.escape(FILE_SUFFIX) + r"$"
+)
+
+# stable Perfetto lane order: the engine driver first, the aggregator next,
+# sites after that (alphabetical), anything else last
+_NODE_ORDER = {"engine": 0, "remote": 1}
+
+
+def find_event_files(root):
+    """All telemetry JSONL files under ``root`` (recursive, stable order)."""
+    root = str(root)
+    if os.path.isfile(root):
+        return [root]
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            if _FILE_RE.match(name):
+                out.append(os.path.join(dirpath, name))
+    return out
+
+
+def load_events(root_or_files):
+    """Parse one run's telemetry records, wall-clock ordered.
+
+    ``root_or_files`` is a run directory (recursively scanned) or an
+    explicit list of JSONL paths.  Undecodable lines (a crash mid-append)
+    are skipped, never fatal.
+    """
+    if isinstance(root_or_files, (str, os.PathLike)):
+        files = find_event_files(root_or_files)
+    else:
+        files = [str(p) for p in root_or_files]
+    events = []
+    for path in files:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        rec.setdefault("node", _node_from_filename(path))
+                        events.append(rec)
+        except OSError:
+            continue
+    events.sort(key=lambda r: (float(r.get("t0", 0.0)), r.get("node", "")))
+    return events
+
+
+def _node_from_filename(path):
+    name = os.path.basename(path)
+    if name.startswith(FILE_PREFIX) and name.endswith(FILE_SUFFIX):
+        return name[len(FILE_PREFIX):-len(FILE_SUFFIX)]
+    return "unknown"
+
+
+def _node_sort_key(node):
+    return (_NODE_ORDER.get(node, 2), str(node))
+
+
+# ------------------------------------------------------------------ summary
+def summarize(events):
+    """Aggregate a merged timeline into per-node tables.
+
+    Returns ``{"nodes": [...], "spans": {node: {name: {calls,total_s,
+    max_s}}}, "wire": {node: {saves,save_bytes,save_raw_bytes,loads,
+    load_bytes,ratio}}, "counters": {node: {name: n}},
+    "events": {node: {name: n}}, "wall_s": span of the whole run}``.
+    """
+    spans, wire, counters, evcounts = {}, {}, {}, {}
+    t_lo, t_hi = None, None
+    for rec in events:
+        node = rec.get("node", "unknown")
+        t0 = float(rec.get("t0", 0.0))
+        t1 = t0 + float(rec.get("dur", 0.0) or 0.0)
+        t_lo = t0 if t_lo is None else min(t_lo, t0)
+        t_hi = t1 if t_hi is None else max(t_hi, t1)
+        kind = rec.get("kind")
+        if kind == "span":
+            s = spans.setdefault(node, {}).setdefault(
+                rec.get("name", "?"), {"calls": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            dur = float(rec.get("dur", 0.0) or 0.0)
+            s["calls"] += 1
+            s["total_s"] += dur
+            s["max_s"] = max(s["max_s"], dur)
+        elif kind == "wire":
+            w = wire.setdefault(node, {
+                "saves": 0, "save_bytes": 0, "save_raw_bytes": 0,
+                "loads": 0, "load_bytes": 0,
+            })
+            if rec.get("op") == "save":
+                w["saves"] += 1
+                w["save_bytes"] += int(rec.get("bytes", 0) or 0)
+                w["save_raw_bytes"] += int(
+                    rec.get("raw_bytes", rec.get("bytes", 0)) or 0
+                )
+            else:
+                w["loads"] += 1
+                w["load_bytes"] += int(rec.get("bytes", 0) or 0)
+        elif kind == "counter":
+            c = counters.setdefault(node, {})
+            name = rec.get("name", "?")
+            c[name] = c.get(name, 0) + int(rec.get("n", 0) or 0)
+        elif kind == "event":
+            e = evcounts.setdefault(node, {})
+            name = rec.get("name", "?")
+            e[name] = e.get(name, 0) + 1
+    for node, w in wire.items():
+        w["ratio"] = (
+            round(w["save_raw_bytes"] / w["save_bytes"], 4)
+            if w["save_bytes"] else None
+        )
+    nodes = sorted(
+        set(spans) | set(wire) | set(counters) | set(evcounts),
+        key=_node_sort_key,
+    )
+    return {
+        "nodes": nodes, "spans": spans, "wire": wire, "counters": counters,
+        "events": evcounts,
+        "wall_s": (round(t_hi - t_lo, 6) if t_lo is not None else 0.0),
+    }
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def render_summary(summary):
+    """Human-readable per-phase/per-site table for a merged timeline."""
+    lines = [f"federation wall-clock: {summary['wall_s']:.3f}s"]
+    for node in summary["nodes"]:
+        lines.append(f"\n[{node}]")
+        spans = summary["spans"].get(node, {})
+        if spans:
+            width = max(len(n) for n in spans)
+            lines.append(
+                f"  {'span'.ljust(width)}  {'calls':>6} {'total_s':>10} "
+                f"{'mean_ms':>9} {'max_ms':>9}"
+            )
+            for name in sorted(spans, key=lambda n: -spans[n]["total_s"]):
+                s = spans[name]
+                mean_ms = 1e3 * s["total_s"] / max(s["calls"], 1)
+                lines.append(
+                    f"  {name.ljust(width)}  {s['calls']:>6} "
+                    f"{s['total_s']:>10.4f} {mean_ms:>9.2f} "
+                    f"{1e3 * s['max_s']:>9.2f}"
+                )
+        w = summary["wire"].get(node)
+        if w:
+            ratio = f" (codec ratio {w['ratio']:.2f}x)" if w.get("ratio") else ""
+            lines.append(
+                f"  wire: out {w['saves']} files / "
+                f"{_fmt_bytes(w['save_bytes'])}{ratio}; "
+                f"in {w['loads']} files / {_fmt_bytes(w['load_bytes'])}"
+            )
+        c = summary["counters"].get(node)
+        if c:
+            lines.append(
+                "  counters: " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(c.items())
+                )
+            )
+        e = summary["events"].get(node)
+        if e:
+            lines.append(
+                "  events: " + ", ".join(
+                    f"{k}×{v}" for k, v in sorted(e.items())
+                )
+            )
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------- chrome trace
+_CTX_KEYS = ("round", "fold", "epoch", "phase")
+_RECORD_KEYS = ("v", "kind", "name", "cat", "t0", "dur", "node", "op",
+                "file", "bytes", "arrays", "codec", "raw_bytes", "ratio",
+                "n") + _CTX_KEYS
+
+
+def _args_for(rec):
+    """Chrome-trace ``args`` payload: the federation context plus any
+    record-specific attributes."""
+    args = {k: rec[k] for k in _CTX_KEYS if k in rec}
+    for k, v in rec.items():
+        if k not in _RECORD_KEYS:
+            args[k] = v
+    for k in ("file", "bytes", "arrays", "codec", "raw_bytes", "ratio"):
+        if k in rec:
+            args[k] = rec[k]
+    return args
+
+
+def chrome_trace(events):
+    """Merged timeline → Chrome trace-event JSON (Perfetto-loadable).
+
+    One trace "process" per node (pid = stable lane order), spans as
+    complete (``ph: "X"``) events, wire transfers as complete events on a
+    dedicated wire thread lane, instantaneous events as ``ph: "i"``, and
+    cumulative wire-byte counters (``ph: "C"``) so Perfetto plots the
+    transfer volume over time.
+    """
+    nodes = sorted({r.get("node", "unknown") for r in events}, key=_node_sort_key)
+    pid = {n: i + 1 for i, n in enumerate(nodes)}
+    out = []
+    for n in nodes:
+        out.append({"name": "process_name", "ph": "M", "pid": pid[n], "tid": 0,
+                    "args": {"name": str(n)}})
+        out.append({"name": "process_sort_index", "ph": "M", "pid": pid[n],
+                    "tid": 0, "args": {"sort_index": pid[n]}})
+        for tid, label in ((1, "phases"), (2, "wire"), (3, "events")):
+            out.append({"name": "thread_name", "ph": "M", "pid": pid[n],
+                        "tid": tid, "args": {"name": label}})
+    cum_bytes = {}
+    cum_counters = {}
+    for rec in events:
+        node = rec.get("node", "unknown")
+        p = pid[node]
+        ts = float(rec.get("t0", 0.0)) * 1e6
+        kind = rec.get("kind")
+        if kind == "span":
+            out.append({
+                "name": rec.get("name", "?"), "cat": rec.get("cat", "phase"),
+                "ph": "X", "ts": ts,
+                "dur": max(float(rec.get("dur", 0.0) or 0.0) * 1e6, 1.0),
+                "pid": p, "tid": 1, "args": _args_for(rec),
+            })
+        elif kind == "wire":
+            op = rec.get("op", "save")
+            out.append({
+                "name": f"wire:{op}:{rec.get('file', '?')}", "cat": "wire",
+                "ph": "X", "ts": ts,
+                "dur": max(float(rec.get("dur", 0.0) or 0.0) * 1e6, 1.0),
+                "pid": p, "tid": 2, "args": _args_for(rec),
+            })
+            key = (node, op)
+            cum_bytes[key] = cum_bytes.get(key, 0) + int(rec.get("bytes", 0) or 0)
+            out.append({
+                "name": f"wire_bytes_{op}", "cat": "wire", "ph": "C", "ts": ts,
+                "pid": p, "tid": 0,
+                "args": {"bytes": cum_bytes[key]},
+            })
+        elif kind == "counter":
+            # counter records are per-flush DELTAS (Recorder.flush drains
+            # the counters); accumulate so the Perfetto track is the
+            # monotone total, like the wire-bytes track
+            key = (node, rec.get("name", "?"))
+            cum_counters[key] = (
+                cum_counters.get(key, 0) + int(rec.get("n", 0) or 0)
+            )
+            out.append({
+                "name": rec.get("name", "?"), "cat": "counter", "ph": "C",
+                "ts": ts, "pid": p, "tid": 0,
+                "args": {"n": cum_counters[key]},
+            })
+        else:  # event
+            out.append({
+                "name": rec.get("name", "?"), "cat": rec.get("cat", "event"),
+                "ph": "i", "ts": ts, "pid": p, "tid": 3, "s": "t",
+                "args": _args_for(rec),
+            })
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path, events):
+    trace = chrome_trace(events)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(trace, f, separators=(",", ":"))
+    return trace

@@ -1,0 +1,382 @@
+"""Structured federation telemetry: typed spans/events to per-node JSONL.
+
+The COINSTAC process model makes conventional profilers useless for a
+federated round: every node invocation may be a FRESH process, the round is
+N site invocations + file relays + one aggregator invocation, and the only
+durable surface is each node's output directory.  The :class:`Recorder`
+leans into that — it appends typed records (spans with wall-clock + duration,
+instantaneous events, wire-transfer accounting, aggregated counters) to a
+``telemetry.<node>.jsonl`` file in the node's output directory, stamped with
+the federation context (node id, engine round, fold, epoch, phase).  The
+collector (:mod:`.collect`) merges every node's file into one federation
+timeline and exports Chrome-trace/Perfetto JSON.
+
+Design constraints, in priority order:
+
+1. **Zero overhead when disabled.**  ``Recorder.for_node`` returns the
+   :data:`NULL_RECORDER` singleton unless ``cache['profile']`` (or
+   ``cache['telemetry']``) is truthy; every method on it is a constant-return
+   no-op and ``span()`` hands back one shared, allocation-free context
+   manager.  The hot-path cost of a disabled call site is one attribute
+   lookup + one no-op call (asserted in ``tests/test_telemetry.py``).
+2. **Host-side only.**  Telemetry is I/O + wall-clock — it must NEVER appear
+   inside a jitted/shard_mapped function (it would be traced away at best,
+   force host syncs at worst).  The ``trace-telemetry`` dinulint rule
+   (:mod:`..analysis.trace_hazards`) enforces this statically.
+3. **Crash-friendly.**  Records buffer in memory and flush as one appended
+   write per node invocation (plus a size-bounded auto-flush), so a dying
+   site still leaves its timeline up to the last flush on disk.
+
+Record schema (one JSON object per line; absent context fields are omitted)::
+
+    {"v": 1, "kind": "span",    "name": ..., "cat": ..., "t0": epoch-secs,
+     "dur": secs, "node": ..., "round": n, "fold": ..., "epoch": n,
+     "phase": ..., ...attrs}
+    {"v": 1, "kind": "event",   "name": ..., "cat": ..., "t0": ..., ...}
+    {"v": 1, "kind": "wire",    "op": "save"|"load", "file": basename,
+     "bytes": payload-bytes, "arrays": k, "codec": ..., "raw_bytes": n,
+     "ratio": raw/payload, "dur": secs, ...context}
+    {"v": 1, "kind": "counter", "name": ..., "n": total, "t0": flush-time,
+     ...context}
+
+``t0`` is ``time.time()`` (wall clock — comparable across node processes on
+one host, and across hosts to NTP accuracy); durations are measured with
+``time.perf_counter()``.
+"""
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+
+SCHEMA_VERSION = 1
+FILE_PREFIX = "telemetry."
+FILE_SUFFIX = ".jsonl"
+
+# records buffered before an automatic mid-invocation flush
+_AUTOFLUSH_AT = 512
+
+
+class _NullSpan:
+    """Shared, allocation-free no-op context manager."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _NullRecorder:
+    """The disabled-mode fast path: every method is a no-op, ``span()``
+    returns one shared context manager, and the singleton is falsy so call
+    sites can guard bigger attribute computations with ``if rec.enabled:``."""
+
+    __slots__ = ()
+    enabled = False
+
+    def __bool__(self):
+        return False
+
+    def span(self, name, cat="phase", **attrs):
+        return _NULL_SPAN
+
+    def begin_invocation(self, **context):
+        pass
+
+    def event(self, name, cat="event", **attrs):
+        pass
+
+    def wire(self, op, path, nbytes=0, arrays=0, codec=None, raw_bytes=None,
+             dur=0.0):
+        pass
+
+    def count(self, name, n=1):
+        pass
+
+    def set_context(self, **kw):
+        pass
+
+    def flush(self):
+        pass
+
+
+NULL_RECORDER = _NullRecorder()
+
+# Active-recorder stack: index -1 is the ambient recorder instrumentation
+# points reach via get_active().  Seeded with the null recorder so lookup
+# never branches.  Per-process (the COINSTAC model runs one node per
+# process; the in-process engine activates one node at a time).
+_STACK = [NULL_RECORDER]
+
+
+def get_active():
+    """The ambient recorder (the null recorder when telemetry is off)."""
+    return _STACK[-1]
+
+
+@contextlib.contextmanager
+def activate(recorder):
+    """Make ``recorder`` the ambient recorder for the enclosed block —
+    nodes wrap their whole invocation so deep layers (wire serialization,
+    reducers, the trainer) reach the right sink without plumbing."""
+    _STACK.append(recorder)
+    try:
+        yield recorder
+    finally:
+        _STACK.pop()
+
+
+class _Span:
+    """Measures one section; emits a span record (and, when a cache is
+    attached, accumulates ``cache['profile_stats']`` at FULL precision —
+    rounding per accumulation, as the old PhaseTimer did, drifts by up to
+    5e-7 s per call)."""
+
+    __slots__ = ("rec", "name", "cat", "attrs", "t0", "p0")
+
+    def __init__(self, rec, name, cat, attrs):
+        self.rec = rec
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs
+
+    def __enter__(self):
+        self.t0 = time.time()
+        self.p0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self.p0
+        self.rec._end_span(self.name, self.cat, self.t0, dt, self.attrs,
+                           failed=exc_type is not None)
+        if exc_type is not None:
+            # a span dying with an exception may be the process's last act —
+            # get the timeline (incl. this failed span) to disk now
+            self.rec.flush()
+        return False
+
+
+class Recorder:
+    """Per-node telemetry sink.
+
+    ``node`` names the timeline lane (site id, ``"remote"``, ``"engine"``);
+    ``cache`` (optional) supplies live federation context (round, fold,
+    epoch) and receives ``profile_stats``; ``out_dir`` (optional) is where
+    the JSONL file lands — without it the recorder is stats-only (the
+    :class:`~..utils.profiling.PhaseTimer` compatibility mode).
+    """
+
+    enabled = True
+
+    def __init__(self, node, cache=None, out_dir=None):
+        self.node = str(node)
+        self.cache = cache
+        self.out_dir = str(out_dir) if out_dir else None
+        self._buffer = []
+        self._counters = {}
+        self._context = {}
+        # wire loads fan out over a thread pool (tensorutils.load_arrays_many
+        # without the native runtime), so buffer/counter mutation and the
+        # flush drain must be serialized
+        self._lock = threading.Lock()
+        self._io_lock = threading.Lock()  # keeps concurrent flushes' JSONL lines whole
+        _maybe_install_jax_compile_listener()
+
+    # ------------------------------------------------------------- factories
+    @classmethod
+    def for_node(cls, cache, state=None, node=None):
+        """The node-side factory: a real recorder when the node config asks
+        for telemetry (``cache['profile']`` — the long-standing profiling
+        flag — or ``cache['telemetry']``), the null singleton otherwise."""
+        cache = cache if cache is not None else {}
+        if not (cache.get("profile") or cache.get("telemetry")):
+            return NULL_RECORDER
+        state = state or {}
+        node = node or state.get("clientId") or "node"
+        return cls(node, cache=cache, out_dir=state.get("outputDirectory"))
+
+    # -------------------------------------------------------------- recording
+    def begin_invocation(self, **context):
+        """Start of one node invocation: bump the persisted round counter
+        (a plain cache key so it survives fresh-process round-trips; listed
+        in ``basetrainer._VOLATILE_CACHE_KEYS`` so it never churns the
+        shared compiled-step bucket) and stamp invocation context (phase).
+        Shared by both node classes so their timelines cannot drift apart."""
+        if self.cache is not None:
+            self.cache["telemetry_round"] = (
+                int(self.cache.get("telemetry_round", 0) or 0) + 1
+            )
+        self.set_context(**context)
+
+    def set_context(self, **kw):
+        """Static context stamped on every record (e.g. the engine's round
+        counter, the node's current phase).  ``None`` clears a key."""
+        for k, v in kw.items():
+            if v is None:
+                self._context.pop(k, None)
+            else:
+                self._context[k] = v
+
+    def _ctx(self):
+        ctx = {"node": self.node}
+        cache = self.cache
+        if cache is not None:
+            rnd = cache.get("telemetry_round")
+            if rnd is not None:
+                ctx["round"] = int(rnd)
+            fold = cache.get("split_ix")
+            if fold is not None:
+                ctx["fold"] = str(fold)
+            epoch = cache.get("epoch")
+            if epoch is not None:
+                ctx["epoch"] = int(epoch)
+        ctx.update(self._context)
+        return ctx
+
+    def _append(self, record):
+        if self.out_dir is None:
+            return  # stats-only mode (PhaseTimer shim): no sink, no buffering
+        with self._lock:
+            self._buffer.append(record)
+            full = len(self._buffer) >= _AUTOFLUSH_AT
+        if full:
+            self.flush()
+
+    def span(self, name, cat="phase", **attrs):
+        """Context manager measuring one section as a span record."""
+        return _Span(self, name, cat, attrs)
+
+    def _end_span(self, name, cat, t0, dt, attrs, failed=False):
+        rec = {"v": SCHEMA_VERSION, "kind": "span", "name": name, "cat": cat,
+               "t0": t0, "dur": dt}
+        rec.update(self._ctx())
+        if attrs:
+            rec.update(attrs)
+        if failed:
+            rec["failed"] = True
+        self._append(rec)
+        if self.cache is not None:
+            # PhaseTimer-compatible per-phase stats; full-precision
+            # accumulation (JSON round-trips repr exactly, so nothing is
+            # lost across fresh-process invocations either)
+            stats = self.cache.setdefault("profile_stats", {})
+            s = stats.setdefault(name, {"calls": 0, "total_s": 0.0, "max_s": 0.0})
+            s["calls"] += 1
+            s["total_s"] += dt
+            s["max_s"] = max(s["max_s"], dt)
+
+    def event(self, name, cat="event", **attrs):
+        """Instantaneous record (quorum decisions, jit builds, failures)."""
+        rec = {"v": SCHEMA_VERSION, "kind": "event", "name": name, "cat": cat,
+               "t0": time.time()}
+        rec.update(self._ctx())
+        if attrs:
+            rec.update(attrs)
+        self._append(rec)
+
+    def wire(self, op, path, nbytes=0, arrays=0, codec=None, raw_bytes=None,
+             dur=0.0):
+        """One wire-payload transfer: ``op`` is ``save`` (outbound) or
+        ``load`` (inbound), ``nbytes`` the on-disk payload size,
+        ``raw_bytes`` the uncompressed array bytes (compression ratio =
+        raw/payload)."""
+        rec = {"v": SCHEMA_VERSION, "kind": "wire", "op": op,
+               "file": os.path.basename(str(path)), "t0": time.time(),
+               "dur": float(dur), "bytes": int(nbytes), "arrays": int(arrays)}
+        if codec:
+            rec["codec"] = str(codec)
+        if raw_bytes is not None:
+            rec["raw_bytes"] = int(raw_bytes)
+            if nbytes:
+                rec["ratio"] = round(float(raw_bytes) / float(nbytes), 4)
+        rec.update(self._ctx())
+        self._append(rec)
+
+    def count(self, name, n=1):
+        """Cheap aggregated counter (e.g. compiled steps run); totals are
+        emitted as one ``counter`` record per name at flush time."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    # ----------------------------------------------------------------- output
+    def path(self):
+        if not self.out_dir:
+            return None
+        return os.path.join(
+            self.out_dir, f"{FILE_PREFIX}{_sanitize(self.node)}{FILE_SUFFIX}"
+        )
+
+    def flush(self):
+        """Append buffered records (and drained counters) to the node's
+        JSONL file in one write.  Without an ``out_dir`` this only drops the
+        buffer (stats-only mode)."""
+        with self._lock:
+            if self._counters:
+                now = time.time()
+                ctx = self._ctx()
+                for name, n in sorted(self._counters.items()):
+                    rec = {"v": SCHEMA_VERSION, "kind": "counter",
+                           "name": name, "n": int(n), "t0": now}
+                    rec.update(ctx)
+                    self._buffer.append(rec)
+                self._counters = {}
+            buffered, self._buffer = self._buffer, []
+        if not buffered:
+            return
+        path = self.path()
+        if path is None:
+            return
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            lines = "".join(
+                json.dumps(r, separators=(",", ":"), default=str) + "\n"
+                for r in buffered
+            )
+            with self._io_lock, open(path, "a", encoding="utf-8") as f:
+                f.write(lines)
+        except OSError:
+            pass  # telemetry must never fail the run
+
+
+def _sanitize(name):
+    return "".join(c if (c.isalnum() or c in "-_") else "_" for c in str(name))
+
+
+# --------------------------------------------------------------- jax bridge
+_JAX_LISTENER_INSTALLED = False
+
+
+def _maybe_install_jax_compile_listener():
+    """Forward jax's own compile-duration monitoring events (backend
+    compiles, tracing) to the ambient recorder — the recompile counter the
+    per-invocation process model otherwise hides.  Installed once per
+    process, only when jax is ALREADY imported (telemetry itself must never
+    pull in jax), and tolerant of the monitoring API not existing."""
+    global _JAX_LISTENER_INSTALLED
+    if _JAX_LISTENER_INSTALLED or "jax" not in sys.modules:
+        return
+    _JAX_LISTENER_INSTALLED = True  # one attempt per process, even on failure
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_jax_duration)
+    except Exception:  # noqa: BLE001 — monitoring is best-effort
+        pass
+
+
+def _on_jax_duration(event, duration, **kw):
+    if "compile" not in event and "trace" not in event:
+        return
+    rec = get_active()
+    if rec.enabled:
+        rec.event(
+            f"jax:{event.rsplit('/', 1)[-1]}", cat="compile",
+            secs=round(float(duration), 6),
+        )

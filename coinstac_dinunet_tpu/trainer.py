@@ -12,6 +12,7 @@ from . import config
 from .config.keys import Key, Mode
 from .metrics import new_metrics as _metric_factory
 from .nn.basetrainer import NNTrainer
+from .telemetry import get_active as _telemetry
 from .utils.utils import performance_improved_
 
 
@@ -46,9 +47,10 @@ class COINNTrainer(NNTrainer):
     def validation_distributed(self):
         """Run local validation and emit the serialized payload the
         aggregator reduces across sites (exact count merge)."""
-        averages, metrics = self.evaluation(
-            Mode.VALIDATION, [self.data_handle.get_validation_dataset()]
-        )
+        with _telemetry().span("local:validation", cat="eval"):
+            averages, metrics = self.evaluation(
+                Mode.VALIDATION, [self.data_handle.get_validation_dataset()]
+            )
         return {
             Key.VALIDATION_SERIALIZABLE.value: [
                 {"averages": averages.serialize(), "metrics": metrics.serialize()}
@@ -62,11 +64,12 @@ class COINNTrainer(NNTrainer):
         if os.path.exists(best_path):
             self.load_checkpoint(name=best)
         ds = self.data_handle.get_test_dataset(load_sparse=bool(self.cache.get("load_sparse")))
-        averages, metrics = self.evaluation(
-            Mode.TEST,
-            ds if isinstance(ds, list) else [ds],
-            save_pred=bool(self.cache.get("save_predictions")),
-        )
+        with _telemetry().span("local:test", cat="eval"):
+            averages, metrics = self.evaluation(
+                Mode.TEST,
+                ds if isinstance(ds, list) else [ds],
+                save_pred=bool(self.cache.get("save_predictions")),
+            )
         return {
             Key.TEST_SERIALIZABLE.value: [
                 {"averages": averages.serialize(), "metrics": metrics.serialize()}

@@ -16,7 +16,6 @@ subsystem:
   framework phases show up as named spans inside device traces.
 """
 import contextlib
-import time
 
 __all__ = ["PhaseTimer", "device_trace", "annotate"]
 
@@ -29,30 +28,33 @@ class PhaseTimer:
     standard cache dump publishes them.  Construct once per node; every
     ``with timer("phase"):`` is a measured section.  No-ops unless
     ``cache['profile']`` is truthy.
+
+    Since the :mod:`~coinstac_dinunet_tpu.telemetry` subsystem landed this
+    is a thin shim over :class:`~coinstac_dinunet_tpu.telemetry.Recorder`
+    in stats-only mode (no ``out_dir`` → no JSONL file, just the cache
+    stats).  The recorder accumulates ``total_s`` at FULL precision — the
+    old implementation re-rounded on every accumulation
+    (``round(total + dt, 6)``), drifting by up to 5e-7 s per call over a
+    long run; rounding now happens only at display time (the telemetry
+    collector's summary).
     """
 
     def __init__(self, cache):
         self.cache = cache
+        self._rec = None  # one stats-only Recorder per timer, built lazily
 
     @property
     def enabled(self):
         return bool(self.cache.get("profile"))
 
-    @contextlib.contextmanager
     def __call__(self, name):
+        from ..telemetry import NULL_RECORDER, Recorder
+
         if not self.enabled:
-            yield
-            return
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            stats = self.cache.setdefault("profile_stats", {})
-            s = stats.setdefault(name, {"calls": 0, "total_s": 0.0, "max_s": 0.0})
-            s["calls"] += 1
-            s["total_s"] = round(s["total_s"] + dt, 6)
-            s["max_s"] = round(max(s["max_s"], dt), 6)
+            return NULL_RECORDER.span(name)
+        if self._rec is None:
+            self._rec = Recorder.for_node(self.cache)
+        return self._rec.span(name)
 
 
 @contextlib.contextmanager

@@ -14,11 +14,14 @@ re-designed for a JAX runtime:
   and fixes the reference's 5-D indexing defect (``utils/tensorutils.py:22-23``).
 """
 import json
+import os
 import struct
+import time
 
 import numpy as np
 
 from .. import config
+from ..telemetry import get_active as _telemetry
 
 _MAGIC = b"COINNTW1"  # COINN Tensor Wire v1
 
@@ -117,11 +120,20 @@ def load_arrays(path):
     when available)."""
     from .. import native
 
+    rec = _telemetry()
+    t0 = time.perf_counter() if rec.enabled else 0.0
     payload = native.load_file(path) if native.available() else None
     if payload is None:
         with open(path, "rb") as f:
             payload = f.read()
-    return unpack_arrays(payload)
+    out = unpack_arrays(payload)
+    if rec.enabled:
+        rec.wire(
+            "load", path, nbytes=len(payload), arrays=len(out),
+            raw_bytes=sum(int(a.nbytes) for a in out),
+            dur=time.perf_counter() - t0,
+        )
+    return out
 
 
 def load_arrays_many(paths):
@@ -133,18 +145,33 @@ def load_arrays_many(paths):
     from .. import native
 
     paths = list(paths)
+    rec = _telemetry()
+    t0 = time.perf_counter() if rec.enabled else 0.0
     payloads = native.load_many(paths) if native.available() else None
     if payloads is None:
         from concurrent.futures import ThreadPoolExecutor
 
+        # each load_arrays call records its own wire event
         with ThreadPoolExecutor(max_workers=max(len(paths), 1)) as ex:
             return list(ex.map(load_arrays, paths))
     out = []
     for p, payload in zip(paths, payloads):
         if payload is None:  # transient native failure: retry via Python IO
             out.append(load_arrays(p))
+        elif rec.enabled:
+            arrays = unpack_arrays(payload)
+            out.append(arrays)
+            rec.wire(
+                "load", p, nbytes=len(payload), arrays=len(arrays),
+                raw_bytes=sum(int(a.nbytes) for a in arrays),
+            )
         else:
             out.append(unpack_arrays(payload))
+    if rec.enabled:
+        rec.event(
+            "wire:fan_in", cat="wire", files=len(paths),
+            secs=round(time.perf_counter() - t0, 6),
+        )
     return out
 
 
@@ -162,9 +189,22 @@ def save_wire(path, arrays, salt="", cache=None, precision_bits=None):
     cache = cache if cache is not None else {}
     counter = int(cache.get("_wire_seed", 0))
     seed = (stable_file_id(salt) + counter) % (2 ** 31)
-    save_arrays(
-        path, arrays, codec=config.wire_codec(precision_bits), seed=seed
-    )
+    codec = config.wire_codec(precision_bits)
+    rec = _telemetry()
+    t0 = time.perf_counter() if rec.enabled else 0.0
+    save_arrays(path, arrays, codec=codec, seed=seed)
+    if rec.enabled:
+        arr_list = arrays if isinstance(arrays, (list, tuple)) else [arrays]
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            nbytes = 0
+        rec.wire(
+            "save", path, nbytes=nbytes, arrays=len(arr_list), codec=codec,
+            # .nbytes exists on numpy AND jax arrays without a host copy
+            raw_bytes=sum(int(getattr(a, "nbytes", 0)) for a in arr_list),
+            dur=time.perf_counter() - t0,
+        )
     cache["_wire_seed"] = counter + (
         len(arrays) if isinstance(arrays, (list, tuple)) else 1
     )

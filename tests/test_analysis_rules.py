@@ -33,6 +33,7 @@ from coinstac_dinunet_tpu.analysis.trace_hazards import (
     ImpureCallRule,
     PyControlFlowRule,
     SetIterationRule,
+    TelemetryInTraceRule,
 )
 
 
@@ -323,6 +324,74 @@ def test_set_iteration_under_tracing():
     findings = SetIterationRule().visit_module(mod)
     assert len(findings) == 1
     assert "ordering varies across processes" in findings[0].message
+
+
+def test_telemetry_recorder_call_inside_jit_is_flagged():
+    """trace-telemetry: a recorder span/event inside a jitted body is
+    host-side I/O traced away at compile time — always a bug."""
+    mod = _module(
+        """
+        import jax
+        from coinstac_dinunet_tpu import telemetry
+
+        @jax.jit
+        def step(ts, batch):
+            with rec.span("inner"):
+                g = grad(ts, batch)
+            telemetry.get_active().event("oops")
+            return g
+        """
+    )
+    findings = TelemetryInTraceRule().visit_module(mod)
+    # rec.span, telemetry.get_active, and the chained .event() on it
+    assert len(findings) == 3
+    assert all("telemetry" in m for m in _messages(findings))
+    assert any("rec.span" in m for m in _messages(findings))
+
+
+def test_telemetry_phasetimer_and_chained_factory_flagged():
+    mod = _module(
+        """
+        def _build_train_step(model):
+            def train_step(state, batch):
+                with PhaseTimer(cache)("fwd"):
+                    out = model(state, batch)
+                get_active().count("steps")
+                return out
+            return train_step
+        """
+    )
+    findings = TelemetryInTraceRule().visit_module(mod)
+    # PhaseTimer(cache) and get_active / get_active().count — the chained
+    # call is one site reported per call node
+    msgs = _messages(findings)
+    assert any("PhaseTimer" in m for m in msgs)
+    assert any("get_active" in m for m in msgs)
+
+
+def test_telemetry_host_side_instrumentation_is_clean():
+    """The supported pattern — record AROUND the compiled call — never
+    fires, and unrelated names (``record.append``, ``rest.count``) are not
+    telemetry."""
+    mod = _module(
+        """
+        import jax
+
+        def host_round(trainer, rec, batch):
+            with rec.span("local:step"):
+                out = trainer.step_fn(batch)
+            rec.wire("save", "f", 10, 1)
+            return out
+
+        @jax.jit
+        def step(x, record):
+            n = record.count(2)  # list method on an unlucky name: clean
+            records = [x] * n
+            return records, x.sum()
+        """
+    )
+    findings = TelemetryInTraceRule().visit_module(mod)
+    assert findings == []
 
 
 def test_function_passed_to_shard_map_is_traced():

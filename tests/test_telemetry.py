@@ -1,0 +1,352 @@
+"""telemetry/: federation-wide structured tracing, wire accounting, merged
+Perfetto timeline (docs/TELEMETRY.md).
+
+Covers the subsystem's three contracts:
+
+- **Acceptance**: a two-site ``InProcessEngine`` run with
+  ``cache['profile']=True`` produces per-node JSONL that the collector
+  merges into a Chrome-trace JSON with spans for every local phase, every
+  wire transfer (byte counts + compression ratio) and the remote reduce.
+- **Zero overhead when disabled**: the factory returns the null singleton,
+  ``span()`` allocates nothing, and a no-op call site costs ~nothing.
+- **Quorum observability**: a site dying mid-run under ``site_quorum``
+  leaves ``quorum:drop``/``quorum:continue`` events on the aggregator's
+  timeline and ``site_died`` on the engine's, while the run completes on
+  the survivors (survivor-weighted averaging, ``COINNRemote._check_quorum``).
+"""
+import json
+import os
+import time
+
+import pytest
+
+from coinstac_dinunet_tpu import telemetry
+from coinstac_dinunet_tpu.engine import InProcessEngine
+from coinstac_dinunet_tpu.telemetry import NULL_RECORDER, Recorder
+from coinstac_dinunet_tpu.telemetry.collect import (
+    chrome_trace,
+    find_event_files,
+    load_events,
+    render_summary,
+    summarize,
+    write_chrome_trace,
+)
+
+from test_nodes import _make_engine
+from test_trainer import XorDataset, XorTrainer
+
+
+# ---------------------------------------------------------------- acceptance
+def test_two_site_run_produces_merged_perfetto_trace(tmp_path):
+    eng = _make_engine(tmp_path, n_sites=2, epochs=2, profile=True).run(
+        max_rounds=400
+    )
+    assert eng.success
+
+    # every node (and the engine driver) left its own JSONL
+    files = find_event_files(str(tmp_path))
+    names = {os.path.basename(f) for f in files}
+    assert "telemetry.engine.jsonl" in names
+    assert "telemetry.remote.jsonl" in names
+    assert "telemetry.site_0.jsonl" in names and "telemetry.site_1.jsonl" in names
+
+    events = load_events(str(tmp_path))
+    spans = [e for e in events if e.get("kind") == "span"]
+    span_names = {(e["node"], e["name"]) for e in spans}
+
+    # spans for every local phase the run went through, on both sites
+    for site in ("site_0", "site_1"):
+        for phase in ("init_runs", "next_run", "computation", "success"):
+            assert (site, f"local:{phase}") in span_names, (site, phase)
+        assert (site, "local:to_reduce") in span_names
+        assert (site, "local:validation") in span_names
+        assert (site, "local:test") in span_names
+    # the remote reduce and the engine's round/relay lanes
+    assert ("remote", "remote:reduce") in span_names
+    assert ("remote", "remote:round") in span_names
+    assert ("engine", "engine:round") in span_names
+    assert ("engine", "engine:relay") in span_names
+
+    # every wire transfer carries byte counts, array counts and the ratio
+    wires = [e for e in events if e.get("kind") == "wire"]
+    saves = [e for e in wires if e["op"] == "save"]
+    loads = [e for e in wires if e["op"] == "load"]
+    assert saves and loads
+    for e in wires:
+        assert e["bytes"] > 0 and e["arrays"] > 0
+        assert e["raw_bytes"] > 0 and "ratio" in e
+    # sites ship grads; the aggregator loads one payload per site per reduce
+    assert any(e["node"].startswith("site_") for e in saves)
+    assert any(e["node"] == "remote" for e in loads)
+
+    # context stamps: rounds count up, wire events carry the phase
+    assert max(e.get("round", 0) for e in events) == eng.rounds
+    assert all("node" in e for e in events)
+
+    # merged Chrome trace: loadable JSON, one process lane per node,
+    # spans/wire/instants all represented
+    trace = write_chrome_trace(str(tmp_path / "trace.json"), events)
+    with open(tmp_path / "trace.json") as f:
+        reloaded = json.load(f)
+    assert reloaded["traceEvents"] == trace["traceEvents"]
+    lanes = {
+        ev["args"]["name"] for ev in trace["traceEvents"]
+        if ev.get("ph") == "M" and ev["name"] == "process_name"
+    }
+    assert {"engine", "remote", "site_0", "site_1"} <= lanes
+    phs = {ev.get("ph") for ev in trace["traceEvents"]}
+    assert {"X", "M"} <= phs
+    x_names = {
+        ev["name"] for ev in trace["traceEvents"] if ev.get("ph") == "X"
+    }
+    assert any(n.startswith("wire:save:") for n in x_names)
+    assert "remote:reduce" in x_names
+
+    # the summary table renders every lane
+    text = render_summary(summarize(events))
+    for node in ("engine", "remote", "site_0", "site_1"):
+        assert f"[{node}]" in text
+
+
+def test_int8_wire_codec_ratio_shows_compression(tmp_path):
+    """With the int8 wire codec the save-side compression ratio beats the
+    raw float payload once arrays dominate the manifest overhead."""
+    import numpy as np
+
+    from coinstac_dinunet_tpu.utils import tensorutils
+
+    rec = Recorder("probe", out_dir=str(tmp_path))
+    with telemetry.activate(rec):
+        tensorutils.save_wire(
+            str(tmp_path / "w.npy"), [np.random.randn(64, 64).astype(np.float32)],
+            salt="probe", cache={}, precision_bits=8,
+        )
+        got = tensorutils.load_arrays(str(tmp_path / "w.npy"))
+    rec.flush()
+    assert len(got) == 1
+    events = load_events(str(tmp_path))
+    save = next(e for e in events if e.get("kind") == "wire" and e["op"] == "save")
+    load = next(e for e in events if e.get("kind") == "wire" and e["op"] == "load")
+    assert save["codec"] == "int8"
+    assert save["bytes"] == os.path.getsize(tmp_path / "w.npy")
+    # 64*64 f32 = 16 KiB raw vs ~4 KiB int8 (+scales/manifest): ratio > 2
+    assert save["ratio"] > 2.0
+    assert load["arrays"] == 1 and load["bytes"] == save["bytes"]
+
+
+# ------------------------------------------------------------ quorum dropout
+class DyingXorDataset(XorDataset):
+    """Raises during loading once the owning site reaches
+    ``cache['die_at_epoch']`` (mirrors tests/test_dropout.py)."""
+
+    def __getitem__(self, ix):
+        die_at = self.cache.get("die_at_epoch")
+        if die_at is not None and int(self.cache.get("epoch", 0)) >= int(die_at):
+            raise RuntimeError("simulated site crash (dataset IO died)")
+        return super().__getitem__(ix)
+
+
+def test_quorum_drop_emits_events_and_survivor_averaging(tmp_path):
+    eng = InProcessEngine(
+        tmp_path, n_sites=3, trainer_cls=XorTrainer,
+        dataset_cls=DyingXorDataset, task_id="xor", data_dir="data",
+        split_ratio=[0.7, 0.15, 0.15], batch_size=8, epochs=4,
+        validation_epochs=1, learning_rate=5e-2, input_shape=(2,), seed=11,
+        patience=50, profile=True, site_quorum=2,
+        site_args={"site_2": {"die_at_epoch": 2}},
+    )
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(24):
+            with open(os.path.join(d, f"s_{i * 24 + j}"), "w") as f:
+                f.write("x")
+    eng.run(max_rounds=600)
+
+    # survivor-averaging behavior (COINNRemote._check_quorum): the run
+    # completes, the drop is recorded once, survivors produced global scores
+    assert eng.success, f"no SUCCESS after {eng.rounds} rounds"
+    assert eng.dead_sites == {"site_2"}
+    assert eng.remote_cache.get("dropped_sites") == ["site_2"]
+    task_dir = os.path.join(eng.remote_state["outputDirectory"], "xor")
+    assert any("global_test_metrics" in f for f in os.listdir(task_dir)
+               if f.endswith(".csv"))
+
+    events = load_events(str(tmp_path))
+    by_name = {}
+    for e in events:
+        if e.get("kind") == "event":
+            by_name.setdefault(e["name"], []).append(e)
+
+    # the engine recorded the site's death with the failure reason
+    died = by_name.get("site_died", [])
+    assert [e["site"] for e in died] == ["site_2"]
+    assert "simulated site crash" in died[0]["error"]
+    # the aggregator recorded the quorum decision: who dropped, who
+    # survives, and that the run continued under the policy
+    drops = by_name.get("quorum:drop", [])
+    assert len(drops) == 1 and drops[0]["node"] == "remote"
+    assert drops[0]["sites"] == ["site_2"]
+    assert drops[0]["alive"] == ["site_0", "site_1"]
+    cont = by_name.get("quorum:continue", [])
+    assert len(cont) == 1 and cont[0]["alive"] == ["site_0", "site_1"]
+    assert not by_name.get("quorum:fail")
+    # the dead site's own timeline ends with its error
+    site2_errors = [
+        e for e in by_name.get("node_error", []) if e["node"] == "site_2"
+    ]
+    assert site2_errors and "simulated site crash" in site2_errors[0]["error"]
+
+
+def test_quorum_unmet_emits_fail_event(tmp_path):
+    eng = InProcessEngine(
+        tmp_path, n_sites=3, trainer_cls=XorTrainer,
+        dataset_cls=DyingXorDataset, task_id="xor", data_dir="data",
+        split_ratio=[0.7, 0.15, 0.15], batch_size=8, epochs=4,
+        validation_epochs=1, learning_rate=5e-2, input_shape=(2,), seed=11,
+        patience=50, profile=True, site_quorum=2,
+        site_args={"site_1": {"die_at_epoch": 2},
+                   "site_2": {"die_at_epoch": 2}},
+    )
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(24):
+            with open(os.path.join(d, f"s_{i * 24 + j}"), "w") as f:
+                f.write("x")
+    with pytest.raises(RuntimeError, match="quorum unmet"):
+        eng.run(max_rounds=600)
+    events = load_events(str(tmp_path))
+    fails = [e for e in events
+             if e.get("kind") == "event" and e["name"] == "quorum:fail"]
+    assert fails and fails[0]["reason"] == "quorum unmet"
+    assert sorted(fails[0]["dropped"]) == ["site_1", "site_2"]
+
+
+# --------------------------------------------------------- disabled-mode cost
+def test_disabled_recorder_is_identity_noop():
+    # the factory hands back the singleton — no allocation, no state
+    assert Recorder.for_node({}, {}) is NULL_RECORDER
+    assert Recorder.for_node(None) is NULL_RECORDER
+    assert Recorder.for_node({"profile": False}) is NULL_RECORDER
+    # span() returns one shared context manager, not a fresh object
+    assert NULL_RECORDER.span("x") is NULL_RECORDER.span("y")
+    with NULL_RECORDER.span("x"):
+        pass
+    NULL_RECORDER.event("e")
+    NULL_RECORDER.wire("save", "p", 1, 1)
+    NULL_RECORDER.count("c")
+    NULL_RECORDER.flush()
+    assert not NULL_RECORDER.enabled and not NULL_RECORDER
+
+
+def test_disabled_mode_overhead_is_bounded():
+    """The no-op fast path: one attribute lookup + one no-op call.  200k
+    disabled call sites must stay well under a second (they measure in the
+    tens of milliseconds) — a regression here means the disabled path grew
+    real work."""
+    get_active = telemetry.get_active
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        rec = get_active()
+        rec.count("steps")
+        with rec.span("phase"):
+            pass
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"disabled-mode telemetry cost {dt:.3f}s for 200k sites"
+
+
+def test_disabled_run_writes_no_telemetry_files(tmp_path):
+    eng = _make_engine(tmp_path, n_sites=2, epochs=1)
+    for _ in range(3):
+        eng.step_round()
+    assert find_event_files(str(tmp_path)) == []
+    assert "profile_stats" not in eng.site_caches["site_0"]
+
+
+# ------------------------------------------------- recorder/collector units
+def test_profile_stats_accumulate_full_precision():
+    """The PhaseTimer rounding-drift fix: accumulation never re-rounds
+    (round(total + dt, 6) drifted up to 5e-7s per call)."""
+    cache = {"profile": True}
+    rec = Recorder("t", cache=cache)
+    dt = 0.1234567891234
+    for _ in range(1000):
+        rec._end_span("phase", "phase", 0.0, dt, {})
+    total = cache["profile_stats"]["phase"]["total_s"]
+    # plain f64 summation error is ~4e-12 here; the old re-rounding
+    # accumulation drifted ~1e-4 over the same 1000 calls
+    assert total == pytest.approx(1000 * dt, abs=1e-9)
+    assert cache["profile_stats"]["phase"]["calls"] == 1000
+
+
+def test_phase_timer_shim_keeps_contract():
+    from coinstac_dinunet_tpu.utils.profiling import PhaseTimer
+
+    cache = {"profile": True}
+    timer = PhaseTimer(cache)
+    with timer("section"):
+        time.sleep(0.001)
+    s = cache["profile_stats"]["section"]
+    assert s["calls"] == 1 and s["total_s"] > 0 and s["max_s"] > 0
+    # disabled: nothing written, and the shared null span is returned
+    cache2 = {}
+    assert PhaseTimer(cache2)("x") is PhaseTimer(cache2)("y")
+    assert "profile_stats" not in cache2
+
+
+def test_span_flushes_on_exception(tmp_path):
+    rec = Recorder("t", out_dir=str(tmp_path))
+    with pytest.raises(ValueError):
+        with rec.span("doomed"):
+            raise ValueError("boom")
+    events = load_events(str(tmp_path))
+    assert len(events) == 1
+    assert events[0]["name"] == "doomed" and events[0]["failed"] is True
+
+
+def test_collector_skips_corrupt_lines(tmp_path):
+    p = tmp_path / "telemetry.x.jsonl"
+    p.write_text(
+        '{"v":1,"kind":"span","name":"ok","t0":1.0,"dur":0.5,"node":"x"}\n'
+        "{truncated-by-crash\n"
+        '{"v":1,"kind":"event","name":"e","t0":2.0,"node":"x"}\n'
+    )
+    events = load_events([str(p)])
+    assert [e["name"] for e in events] == ["ok", "e"]
+    trace = chrome_trace(events)
+    assert len([e for e in trace["traceEvents"] if e.get("ph") == "X"]) == 1
+
+
+def test_chrome_trace_counters_accumulate_across_flushes():
+    """Counter records are per-flush deltas; the Perfetto track must be the
+    monotone cumulative total (like the wire-bytes track)."""
+    events = [
+        {"kind": "counter", "name": "grad_steps", "n": 512, "t0": 1.0, "node": "s"},
+        {"kind": "counter", "name": "grad_steps", "n": 40, "t0": 2.0, "node": "s"},
+    ]
+    trace = chrome_trace(events)
+    vals = [e["args"]["n"] for e in trace["traceEvents"]
+            if e.get("ph") == "C" and e["name"] == "grad_steps"]
+    assert vals == [512, 552]
+
+
+def test_cli_merges_and_exports(tmp_path, capsys):
+    from coinstac_dinunet_tpu.telemetry.__main__ import main
+
+    rec = Recorder("site_0", out_dir=str(tmp_path / "site_0"))
+    with rec.span("local:computation"):
+        pass
+    rec.flush()
+    out = tmp_path / "trace.json"
+    assert main([str(tmp_path), "--trace", str(out),
+                 "--summary-json", str(tmp_path / "s.json")]) == 0
+    printed = capsys.readouterr().out
+    assert "local:computation" in printed and "[site_0]" in printed
+    with open(out) as f:
+        trace = json.load(f)
+    assert any(e.get("name") == "local:computation" for e in trace["traceEvents"])
+    with open(tmp_path / "s.json") as f:
+        assert "site_0" in json.load(f)["spans"]
+    # an empty directory is a usage error, not a silent success
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main([str(empty)]) == 1
