@@ -138,7 +138,13 @@ def register_rule(cls):
 def default_rules():
     """Fresh instances of every registered rule, importing the built-in rule
     modules on first use (registration happens at import)."""
-    from . import jax_api, protocol, sharding, trace_hazards  # noqa: F401 (register)
+    from . import (  # noqa: F401 (register)
+        jax_api,
+        protocol,
+        sharding,
+        telemetry_names,
+        trace_hazards,
+    )
 
     return [cls() for _, cls in sorted(_REGISTRY.items())]
 

@@ -1,0 +1,230 @@
+"""telemetry-metric-name: health-series / anomaly names must come from the
+``config/keys.py`` vocabulary.
+
+Metric and anomaly names are the health layer's wire protocol: the watchdog
+routes a sample to its detectors by STRING EQUALITY on the series name, and
+the doctor's per-site attribution keys on the same strings.  A typo'd name
+(``record_metric("gradnorm", ...)``) doesn't crash anything — it silently
+produces a series no detector watches and no report aggregates, which is the
+exact failure mode the :class:`~..config.keys.Metric`/:class:`~..config.keys.
+Anomaly` vocabulary exists to prevent.  Same machinery as the ``sharding-*``
+family: the vocabulary is *parsed* out of ``config/keys.py`` (never
+imported), ``Metric.X``/``Anomaly.X`` attribute spellings resolve through
+it, and fixture tests can substitute a ``keys_source``.
+
+Checked call shapes (names resolvable statically only — dynamic names are
+the caller's problem):
+
+- ``record_metric(NAME, ...)`` / ``health.record_metric(NAME, ...)`` — NAME
+  must be a declared **metric**.
+- ``<recorder>.metric(NAME, ...)`` where ``<recorder>`` is a conventional
+  recorder binding (``rec``/``recorder``/``tracer``/``telemetry``) or a
+  chained factory call (``get_active().metric(...)``) — declared metric.
+- ``register_detector(ANOMALY[, metric=METRIC])`` — ANOMALY must be a
+  declared **anomaly**, METRIC (when present and not None) a declared
+  metric.
+- ``<watchdog>.observe(NAME, ...)`` on a ``Watchdog(...)`` chain or a
+  ``wd``/``watchdog`` binding — declared metric.
+"""
+import ast
+import os
+
+from .core import Finding, Rule, dotted_name, register_rule
+
+METRIC_CLASS = "Metric"
+ANOMALY_CLASS = "Anomaly"
+
+_RECORDER_ROOTS = {"rec", "recorder", "telemetry", "tracer"}
+_WATCHDOG_ROOTS = {"wd", "watchdog"}
+_FACTORY_SEGMENTS = {"get_active", "for_node"}
+
+
+def _keys_module_path():
+    return os.path.normpath(
+        os.path.join(os.path.dirname(__file__), "..", "config", "keys.py")
+    )
+
+
+def load_name_vocab(keys_source=None):
+    """Parse ``config/keys.py`` into ``{class_name: {member: value}}`` for
+    the :class:`Metric` and :class:`Anomaly` vocabularies."""
+    if keys_source is None:
+        with open(_keys_module_path(), "r", encoding="utf-8") as f:
+            keys_source = f.read()
+    tree = ast.parse(keys_source)
+    vocab = {METRIC_CLASS: {}, ANOMALY_CLASS: {}}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name in vocab:
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, str)
+                ):
+                    vocab[node.name][stmt.targets[0].id] = stmt.value.value
+    return vocab
+
+
+def _last(name):
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _resolve_name(node, vocab):
+    """expr → ``(value_or_None, kind)``.
+
+    kind: ``'literal'`` (string constant), ``'member'`` (``Metric.X`` /
+    ``Anomaly.X`` — value None when the member does not exist), ``'none'``
+    (the ``None`` constant), or ``'dynamic'`` (unresolvable)."""
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return None, "none"
+        if isinstance(node.value, str):
+            return node.value, "literal"
+        return None, "dynamic"
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    parts.reverse()
+    if len(parts) >= 2 and parts[-2] in (METRIC_CLASS, ANOMALY_CLASS):
+        return vocab[parts[-2]].get(parts[-1]), "member"
+    return None, "dynamic"
+
+
+def _chain_root(node):
+    """Innermost Name/Call of an attribute chain (``a.b.c`` → ``a``)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node
+
+
+def _is_recorder_expr(expr):
+    """True when ``expr`` conventionally holds a recorder: a known binding
+    name, anything mentioning telemetry, or a factory-call chain."""
+    if isinstance(expr, ast.Name):
+        return expr.id.lower() in _RECORDER_ROOTS or "telemetry" in expr.id.lower()
+    if isinstance(expr, ast.Attribute):
+        name = (dotted_name(expr, require_name_root=False) or "").lower()
+        segs = name.split(".")
+        return (
+            "telemetry" in name
+            or (segs and segs[0] in _RECORDER_ROOTS)
+            or (segs and segs[-1] in _RECORDER_ROOTS)
+        )
+    if isinstance(expr, ast.Call):
+        name = (dotted_name(expr.func, require_name_root=False) or "").lower()
+        return _last(name) in _FACTORY_SEGMENTS or "telemetry" in name
+    return False
+
+
+def _is_watchdog_expr(expr):
+    if isinstance(expr, ast.Name):
+        return expr.id.lower() in _WATCHDOG_ROOTS
+    if isinstance(expr, ast.Call):
+        name = dotted_name(expr.func, require_name_root=False) or ""
+        return _last(name) == "Watchdog"
+    if isinstance(expr, ast.Attribute):
+        name = (dotted_name(expr, require_name_root=False) or "").lower()
+        return _last(name) in _WATCHDOG_ROOTS
+    return False
+
+
+@register_rule
+class TelemetryMetricNameRule(Rule):
+    id = "telemetry-metric-name"
+    doc = ("Metric/anomaly names in record_metric()/Recorder.metric()/"
+           "Watchdog.observe() calls and register_detector() registrations "
+           "must come from the config/keys.py Metric/Anomaly vocabulary "
+           "(typos make silently-unwatched series).")
+
+    def __init__(self, keys_source=None):
+        self._keys_source = keys_source
+        self._vocab = None
+
+    def vocab(self):
+        if self._vocab is None:
+            self._vocab = load_name_vocab(self._keys_source)
+        return self._vocab
+
+    # ----------------------------------------------------------------- checks
+    def _check_name(self, module, node, which):
+        """One name argument against vocabulary ``which``; returns the
+        finding or None."""
+        vocab = self.vocab()
+        values = set(vocab[which].values())
+        resolved, kind = _resolve_name(node, vocab)
+        if kind in ("none", "dynamic"):
+            return None
+        if kind == "member" and resolved is None:
+            cls = METRIC_CLASS if which == METRIC_CLASS else ANOMALY_CLASS
+            return Finding(
+                rule=self.id, path=module.path, line=node.lineno,
+                col=node.col_offset,
+                message=f"unknown {cls} member "
+                        f"'{dotted_name(node, require_name_root=False)}' — "
+                        f"declare it in config/keys.py {cls}",
+            )
+        if resolved not in values:
+            return Finding(
+                rule=self.id, path=module.path, line=node.lineno,
+                col=node.col_offset,
+                message=f"{which.lower()} name '{resolved}' is not declared "
+                        f"in the config/keys.py {which} vocabulary "
+                        f"(known: {', '.join(sorted(values))})",
+            )
+        return None
+
+    def _first_arg(self, call, kwarg=None):
+        if call.args and not isinstance(call.args[0], ast.Starred):
+            return call.args[0]
+        if kwarg:
+            for kw in call.keywords:
+                if kw.arg == kwarg:
+                    return kw.value
+        return None
+
+    def visit_module(self, module):
+        findings = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func, require_name_root=False) or ""
+            last = _last(name)
+            hit = None
+            if last == "record_metric":
+                arg = self._first_arg(node, kwarg="name")
+                if arg is not None:
+                    hit = self._check_name(module, arg, METRIC_CLASS)
+            elif last == "register_detector":
+                arg = self._first_arg(node, kwarg="anomaly")
+                if arg is not None:
+                    hit = self._check_name(module, arg, ANOMALY_CLASS)
+                    if hit:
+                        findings.append(hit)
+                    hit = None
+                metric = None
+                if len(node.args) > 1 and not isinstance(node.args[1], ast.Starred):
+                    metric = node.args[1]
+                else:
+                    for kw in node.keywords:
+                        if kw.arg == "metric":
+                            metric = kw.value
+                if metric is not None:
+                    hit = self._check_name(module, metric, METRIC_CLASS)
+            elif last == "metric" and isinstance(node.func, ast.Attribute):
+                if _is_recorder_expr(node.func.value):
+                    arg = self._first_arg(node, kwarg="name")
+                    if arg is not None:
+                        hit = self._check_name(module, arg, METRIC_CLASS)
+            elif last == "observe" and isinstance(node.func, ast.Attribute):
+                if _is_watchdog_expr(node.func.value):
+                    arg = self._first_arg(node, kwarg="name")
+                    if arg is not None:
+                        hit = self._check_name(module, arg, METRIC_CLASS)
+            if hit:
+                findings.append(hit)
+        return findings

@@ -84,6 +84,8 @@ class LocalWire(_StrEnum):
     # rankDAD compressed activation/delta payloads — see parallel/rankdad.py
     DAD_DATA_FILE = "dad_data_file"
     DAD_REST_FILE = "dad_rest_file"
+    # per-site health summary (watchdog anomalies) — see telemetry/watchdog.py
+    HEALTH = "health"
 
 
 class RemoteWire(_StrEnum):
@@ -104,6 +106,8 @@ class RemoteWire(_StrEnum):
     RANK1_FILE = "rank1_file"
     DAD_DATA_FILE = "dad_data_file"
     DAD_REST_FILE = "dad_rest_file"
+    # federation-wide health rollup (aggregator → sites)
+    HEALTH = "health"
 
 
 class MeshAxis:
@@ -138,6 +142,78 @@ class MeshAxis:
     SP = "sp"
     EP = "ep"
     PP = "pp"
+
+
+class Metric:
+    """Health-metric name vocabulary — the single source of truth for every
+    scalar series the telemetry layer records per federated round.
+
+    Plain ``str`` constants (not an Enum), mirroring :class:`MeshAxis`: the
+    names flow into JSONL ``metric`` records and watchdog detector wiring,
+    where a bare string is the canonical spelling — the constant only pins
+    WHICH string.  The ``telemetry-metric-name`` rule of
+    :mod:`coinstac_dinunet_tpu.analysis` statically cross-checks every
+    ``record_metric(...)`` call site and detector registration against this
+    vocabulary, so a typo'd metric name is a lint error, never a silently
+    empty series.
+
+    Series:
+    - ``GRAD_NORM`` / ``GRAD_NORM_EMA`` — site-side global L2 gradient norm
+      per backward round, and its watchdog EMA (``nn/basetrainer.py``).
+    - ``UPDATE_NORM`` — global L2 norm of the applied (averaged) update.
+    - ``TRAIN_LOSS`` — per-round mean training loss.
+    - ``VAL_SCORE`` — the monitored validation metric per epoch barrier.
+    - ``SITE_COSINE`` — per-site cosine similarity of the site's payload to
+      the participation-weighted mean (``parallel/reducer.py``; NaN marks a
+      non-finite site, attributing the failure).
+    - ``SITE_DISPERSION`` — cross-site std-dev of the finite cosines.
+    - ``SURVIVORS`` — sites actually contributing to the reduce (finite AND
+      participating).
+    - ``COMPRESSION_ERROR`` — relative reconstruction error of the
+      compressed gradient (PowerSGD ``‖M−P̂Qᵀ‖/‖M‖``; rankDAD
+      ``‖G−CᵀB‖/‖G‖``).
+    - ``EFFECTIVE_RANK`` — entropy effective rank of the factorization's
+      spectrum (rank-collapse signal).
+    """
+
+    GRAD_NORM = "grad_norm"
+    GRAD_NORM_EMA = "grad_norm_ema"
+    UPDATE_NORM = "update_norm"
+    TRAIN_LOSS = "train_loss"
+    VAL_SCORE = "val_score"
+    SITE_COSINE = "site_cosine"
+    SITE_DISPERSION = "site_dispersion"
+    SURVIVORS = "survivors"
+    COMPRESSION_ERROR = "compression_error"
+    EFFECTIVE_RANK = "effective_rank"
+
+
+class Anomaly:
+    """Anomaly name vocabulary for the watchdog's detectors
+    (:mod:`coinstac_dinunet_tpu.telemetry.watchdog`).
+
+    Same contract as :class:`Metric`: plain ``str`` constants checked
+    statically by the ``telemetry-metric-name`` rule.  Each name is one
+    detector's finding, emitted as an ``anomaly:<name>`` event and rolled
+    into the node's ``health`` summary:
+
+    - ``NONFINITE`` — a watched series went NaN/Inf (site-attributed when
+      the series is per-site).
+    - ``GRAD_EXPLOSION`` — gradient norm spiked vs its EMA.
+    - ``DIVERGENCE_OUTLIER`` — a site's gradient direction detached from
+      the consensus (cosine below floor).
+    - ``VAL_STALL`` — the monitored validation metric stopped improving.
+    - ``COMPRESSION_SPIKE`` — compression reconstruction error spiked vs
+      its EMA.
+    - ``RANK_COLLAPSE`` — the factorization's effective rank collapsed.
+    """
+
+    NONFINITE = "nonfinite"
+    GRAD_EXPLOSION = "grad_explosion"
+    DIVERGENCE_OUTLIER = "divergence_outlier"
+    VAL_STALL = "val_stall"
+    COMPRESSION_SPIKE = "compression_spike"
+    RANK_COLLAPSE = "rank_collapse"
 
 
 # Keys a node reads from ``input`` that the ENGINE/compspec injects on the

@@ -34,6 +34,7 @@ from .. import config
 from ..config.keys import Key, MeshAxis, Mode
 from ..metrics import COINNAverages, Prf1a
 from ..telemetry import get_active as _telemetry
+from ..telemetry import health as _health
 from ..utils import atomic_write, logger
 from ..utils.jax_compat import shard_map
 from ..utils.utils import performance_improved_, stop_training_
@@ -67,6 +68,10 @@ _VOLATILE_CACHE_KEYS = frozenset((
     "skipped_sites", "global_test_metrics", "log_dir", "log_header",
     "resume", "profile_stats", "telemetry_round", "weights_file", "train_log",
     "validation_log", "test_log", "seed", "verbose",
+    # watchdog/health bookkeeping: detector state + anomaly rollup mutate
+    # every round and the quarantine roster grows — all host-side, never
+    # traced (telemetry/watchdog.py)
+    "health", "quarantined_sites",
     # Key.* bookkeeping the nodes append per round/fold (metrics rollups,
     # serialized score blobs, one-shot flags) — all host-side, never traced
     Key.TEST_METRICS.value, Key.TRAIN_SERIALIZABLE.value,
@@ -646,22 +651,29 @@ class NNTrainer:
         This is the site-side half of a federated round (≙ learner.backward).
         With >1 local device the batch fans out over a ``device`` mesh axis
         (≙ ref DataParallel) and the returned grads are the exact masked-mean."""
-        _telemetry().count("grad_steps")
+        rec = _telemetry()
+        rec.count("grad_steps")
         n = self._dp_device_count(
             jax.tree_util.tree_leaves(stacked_batches)[0].shape[1]
         )
         if n > 1:
-            return self._compute_grads_dp(ts, stacked_batches, n)
-        fn = self._compiled.get("grads")
-        if fn is None:
-            self._note_jit_build("grads")
-            metrics_shell, averages_shell = self._metrics_shell()
+            grads, aux = self._compute_grads_dp(ts, stacked_batches, n)
+        else:
+            fn = self._compiled.get("grads")
+            if fn is None:
+                self._note_jit_build("grads")
+                metrics_shell, averages_shell = self._metrics_shell()
 
-            def _grads(ts, stacked):
-                return self._grads_uncompiled(ts, stacked, metrics_shell, averages_shell)
+                def _grads(ts, stacked):
+                    return self._grads_uncompiled(ts, stacked, metrics_shell, averages_shell)
 
-            fn = self._compiled["grads"] = jax.jit(_grads)
-        return fn(ts, stacked_batches)
+                fn = self._compiled["grads"] = jax.jit(_grads)
+            grads, aux = fn(ts, stacked_batches)
+        if rec.enabled:
+            # host-side, AROUND the compiled call: global grad norm + its
+            # watchdog EMA + the round's mean loss (docs/TELEMETRY.md)
+            _health.record_grad_health(self.cache, grads, aux, recorder=rec)
+        return grads, aux
 
     def _build_dp_step(self, n, apply_updates, donate):
         """Compiled batch-sharded step over ``n`` local devices: per-shard
@@ -712,6 +724,9 @@ class NNTrainer:
     def apply_grads(self, ts, grads, new_rng=None):
         """One optimizer step from externally supplied (e.g. averaged)
         gradients — the site-side apply half of a federated round."""
+        rec = _telemetry()
+        if rec.enabled:
+            _health.record_update_health(self.cache, grads, recorder=rec)
         fn = self._compiled.get("apply")
         if fn is None:
             self._note_jit_build("apply")

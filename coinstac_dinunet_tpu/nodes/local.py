@@ -63,6 +63,10 @@ class COINNLocal:
         # aggregator's quorum policy sees it on EVERY transport, including
         # fresh-process nodes configured via first_input
         site_quorum=None,
+        # opt-in watchdog quarantine (telemetry/watchdog.py): a site-
+        # attributed anomaly zeroes that site's reduce weight from the round
+        # it fires; frozen into shared_args so the aggregator sees it
+        quarantine_on_anomaly=None,
         # engine-specific knobs (present so they freeze into shared_args)
         matrix_approximation_rank=1,
         start_powerSGD_iter=10,
@@ -463,6 +467,22 @@ class COINNLocal:
                         os.makedirs(os.path.dirname(dst), exist_ok=True)
                         shutil.copy(src, dst)
                         break
+
+        # health reporting: ship this site's watchdog summary to the
+        # aggregator and surface any federation-wide warning it broadcast
+        # (both wire keys declared in config/keys.py; observe-and-report —
+        # see telemetry/watchdog.py)
+        if rec.enabled:
+            fed_health = self.input.get(RemoteWire.HEALTH.value)
+            if fed_health and client_id in (fed_health.get("quarantined") or []):
+                logger.warn(
+                    f"aggregator quarantined this site ({client_id}): its "
+                    "payloads carry weight 0 in every reduce "
+                    "(cache['quarantine_on_anomaly'])"
+                )
+            summary = telemetry.Watchdog(self.cache, rec).summary()
+            if summary:
+                self.out[LocalWire.HEALTH.value] = summary
 
         # persist the live train state across engine invocations (in cache
         # for a persistent process; on disk for a fresh-process engine)

@@ -225,6 +225,13 @@ class COINNRemote:
 
     def _save_if_better(self, **info):
         score = info["val_metrics"].extract(self.cache.get("monitor_metric", "f1"))
+        rec = telemetry.get_active()
+        if rec.enabled:
+            # the GLOBAL monitored-metric trajectory — the federation-level
+            # stall series (sites record their local ones)
+            from ..telemetry import health as _health
+
+            _health.record_val_score(self.cache, score, recorder=rec)
         self.out[RemoteWire.SAVE_CURRENT_AS_BEST.value] = performance_improved_(
             self.cache["epoch"], score, self.cache
         )
@@ -376,6 +383,22 @@ class COINNRemote:
             else:
                 self.out.update(**self._send_global_scores(trainer))
                 self.out[RemoteWire.PHASE.value] = Phase.SUCCESS.value
+
+        # federation-wide health rollup: the aggregator's own watchdog
+        # findings (reduce-side divergence/nonfinite/stall) merged with
+        # every site's shipped summary, broadcast back so each site can
+        # surface warnings (and learn it was quarantined)
+        if rec.enabled:
+            fed = dict(telemetry.Watchdog(self.cache, rec).summary())
+            per_site = {}
+            for site, site_vars in self.input.items():
+                h = site_vars.get(LocalWire.HEALTH.value)
+                if h:
+                    per_site[site] = {"counts": h.get("counts", {})}
+            if per_site:
+                fed["sites"] = per_site
+            if fed:
+                self.out[RemoteWire.HEALTH.value] = fed
         return self.out
 
     def __call__(self, *a, **kw):

@@ -23,6 +23,7 @@ import numpy as np
 from .. import config
 from ..ops import orthogonalize
 from ..telemetry import get_active as _telemetry
+from ..telemetry import health as _health
 from ..utils import tensorutils
 from .learner import COINNLearner
 from .reducer import COINNReducer
@@ -280,6 +281,21 @@ class PowerSGDLearner(COINNLearner):
         ]
         avg_rank1 = tensorutils.load_arrays(self._base_path(self.input["rank1_file"]))
         recon, errors = _reconstruct(st.Ms, st.Phats, avg_Q)
+        rec = _telemetry()
+        if rec.enabled:
+            # reconstruction health: how much gradient the rank-r estimate
+            # lost this round (‖M − P̂Qᵀ‖/‖M‖ over all leaves), and the
+            # entropy effective rank of the factor spectrum (σ(P̂Qᵀ) = σ(Q)
+            # since P̂ has orthonormal columns) — the rank-collapse signal
+            eff = (
+                float(np.mean([_health.effective_rank(np.asarray(q))
+                               for q in avg_Q]))
+                if avg_Q else None
+            )
+            _health.record_compression_health(
+                self.cache, _health.relative_error(errors, st.Ms), eff,
+                recorder=rec, engine="powerSGD",
+            )
         st.errors = errors
         st.Qs = avg_Q  # warm start next round (≙ ref warm_start)
         # reassemble the full flat gradient list at original shapes
@@ -310,7 +326,8 @@ class PowerSGDReducer(COINNReducer):
             out["powerSGD_phase"] = PHASE_P_SYNC
             return out
         if phases == {PHASE_P_SYNC}:
-            avg_P = self._average(self._load("powerSGD_P_file"))
+            avg_P = self._average(self._load("powerSGD_P_file"),
+                                  payload="powerSGD_P")
             _telemetry().event(
                 "reduce:powerSGD", cat="reduce", phase=PHASE_P_SYNC,
                 sites=len(self.input), matrices=len(avg_P),
@@ -319,9 +336,10 @@ class PowerSGDReducer(COINNReducer):
             fname = self._save_out(config.powersgd_P_file, avg_P)
             return {"powerSGD_P_file": fname, "powerSGD_phase": PHASE_Q_SYNC}
         if phases == {PHASE_Q_SYNC}:
-            avg_Q = self._average(self._load("powerSGD_Q_file"))
+            avg_Q = self._average(self._load("powerSGD_Q_file"),
+                                  payload="powerSGD_Q")
             qname = self._save_out(config.powersgd_Q_file, avg_Q)
-            avg_r1 = self._average(self._load("rank1_file"))
+            avg_r1 = self._average(self._load("rank1_file"), payload="rank1")
             rname = self._save_out(rank1_file, avg_r1)
             _telemetry().event(
                 "reduce:powerSGD", cat="reduce", phase=PHASE_Q_SYNC,

@@ -35,6 +35,7 @@ import numpy as np
 from .. import config
 from ..ops import power_iteration_BC
 from ..telemetry import get_active as _telemetry
+from ..telemetry import health as _health
 from ..utils import logger, tensorutils
 from .learner import COINNLearner
 from .reducer import COINNReducer
@@ -82,7 +83,7 @@ class _DADState:
         self.perturbs = None  # zero pytree, one leaf per captured output
         self.leaf_map = None  # layer key -> (kernel_leaf_ix, bias_leaf_ix|None)
         self.rest_ix = None  # flat-leaf indices exchanged dSGD-style
-        self.compiled = None
+        self.compiled = {}  # with_health flag -> jitted step
 
 
 def _leaf_paths(params):
@@ -206,10 +207,17 @@ class DADLearner(COINNLearner):
         st.perturbs = {k: jnp.zeros(s, d) for k, (s, d) in shapes.items()}
 
     # ------------------------------------------------------------- site steps
-    def _dad_compiled(self):
+    def _dad_compiled(self, with_health=False):
+        """The compiled site step.  ``with_health`` (telemetry enabled only —
+        the disabled path never pays for it) additionally computes the
+        factorization's aggregate relative reconstruction error
+        ``‖G − CᵀB‖/‖G‖`` against the exact per-layer kernel gradients
+        ``G = actᵀ·delta`` inside the same compiled call; the flag is
+        constant for a run, so the two variants never churn retraces."""
         st = self.dad
-        if st.compiled is not None:
-            return st.compiled
+        fn = st.compiled.get(bool(with_health))
+        if fn is not None:
+            return fn
         rank, iters = self.rank, self.iters
         layer_keys = tuple(st.layer_keys)
         leaf_map = dict(st.leaf_map)
@@ -227,10 +235,27 @@ class DADLearner(COINNLearner):
             )
             vleaves = jax.tree_util.tree_leaves(vgrads)
             rest = [vleaves[i] for i in rest_ix]
-            return Brs, Crs, rest, loss, it
+            rel_err = jnp.zeros(())
+            if with_health:
+                num = jnp.zeros(())
+                den = jnp.zeros(())
+                for lk in layer_keys:
+                    delta = _flatten2d(pgrads[lk]).astype(jnp.float32)
+                    act = _flatten2d(acts[lk]).astype(jnp.float32)
+                    if leaf_map[lk][1] is not None:
+                        act = jnp.concatenate(
+                            [act, jnp.ones((act.shape[0], 1), act.dtype)],
+                            axis=1,
+                        )
+                    G = act.T @ delta  # exact (din[+1], dout) kernel grad
+                    R = Crs[lk].T @ Brs[lk]
+                    num = num + jnp.sum(jnp.square(G - R))
+                    den = den + jnp.sum(jnp.square(G))
+                rel_err = jnp.sqrt(num / jnp.maximum(den, 1e-30))
+            return Brs, Crs, rest, loss, it, rel_err
 
-        st.compiled = jax.jit(_fn)
-        return st.compiled
+        fn = st.compiled[bool(with_health)] = jax.jit(_fn)
+        return fn
 
     def to_reduce(self):
         """One batch → per-layer compressed (delta, act) factors on the wire
@@ -247,17 +272,26 @@ class DADLearner(COINNLearner):
             self._discover(ts.params, batch, ts.rng)
         rng, sub = jax.random.split(ts.rng)
         key = jax.random.fold_in(sub, 17)
-        Brs, Crs, rest, loss, it = self._dad_compiled()(
-            ts.params, st.perturbs, batch, sub, key
-        )
+        rec = _telemetry()
+        Brs, Crs, rest, loss, it, rel_err = self._dad_compiled(
+            with_health=rec.enabled
+        )(ts.params, st.perturbs, batch, sub, key)
         self.trainer.train_state = ts.replace(rng=rng)
         wire = config.wire_dtype(self.precision_bits)
         payload = []
         for lk in st.layer_keys:
             payload.append(np.asarray(Brs[lk], wire))
             payload.append(np.asarray(Crs[lk], wire))
-        rec = _telemetry()
         if rec.enabled:
+            eff = (
+                float(np.mean([_health.effective_rank(np.asarray(Brs[lk]))
+                               for lk in st.layer_keys]))
+                if st.layer_keys else None
+            )
+            _health.record_compression_health(
+                self.cache, float(np.asarray(rel_err)), eff,
+                recorder=rec, engine="rankDAD",
+            )
             # (delta, activation) factor bytes vs what the full per-layer
             # kernel grads would have weighed at the same wire dtype
             itemsize = np.dtype(wire).itemsize
@@ -366,6 +400,6 @@ class DADReducer(COINNReducer):
             out_payload.append(np.asarray(B, wire))
             out_payload.append(np.asarray(C, wire))
         fname = self._save_out(config.dad_data_file, out_payload)
-        rest_avg = self._average(self._load("dad_rest_file"))
+        rest_avg = self._average(self._load("dad_rest_file"), payload="dad_rest")
         rname = self._save_out(dad_rest_file, rest_avg)
         return {"dad_data_file": fname, "dad_rest_file": rname, "update": True}

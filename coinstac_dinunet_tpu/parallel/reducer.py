@@ -17,6 +17,7 @@ import numpy as np
 
 from .. import config
 from ..telemetry import get_active as _telemetry
+from ..telemetry import health as _health
 from ..utils import logger, tensorutils
 
 
@@ -54,6 +55,41 @@ def _guarded_mean(leaves, w0):
         for x in leaves
     ]
     return means, ok
+
+
+@jax.jit
+def site_cosines(leaves, w0):
+    """Per-site agreement with the consensus: cosine of each site's flat
+    payload vector against the participation-weighted mean over the FINITE
+    sites.  A non-finite site gets cosine NaN — the per-site series the
+    health layer records, attributing exactly who corrupted the round.
+
+    Accumulates dots/norms leaf by leaf over the already-stacked payload
+    (mathematically identical to flattening everything into one vector, but
+    never materializes a second full copy of the site payloads), in one
+    compiled call (the divergence/one-bad-site regime of compressed
+    federated SGD — arxiv 1906.12043).
+    """
+    n = leaves[0].shape[0]
+    ok = jnp.ones((n,), jnp.bool_)
+    for x in leaves:
+        ok = ok & jnp.isfinite(x).all(axis=tuple(range(1, x.ndim)))
+    w = ok.astype(jnp.float32) * jnp.asarray(w0, jnp.float32)
+    denom = jnp.maximum(jnp.sum(w), 1.0)
+    dots = jnp.zeros((n,), jnp.float32)
+    norms2 = jnp.zeros((n,), jnp.float32)
+    mnorm2 = jnp.zeros((), jnp.float32)
+    for x in leaves:
+        v = jnp.nan_to_num(
+            jnp.asarray(x, jnp.float32).reshape(n, -1),
+            nan=0.0, posinf=0.0, neginf=0.0,
+        )
+        mean = jnp.tensordot(w, v, axes=(0, 0)) / denom
+        dots = dots + v @ mean
+        norms2 = norms2 + jnp.sum(jnp.square(v), axis=1)
+        mnorm2 = mnorm2 + jnp.sum(jnp.square(mean))
+    cos = dots / jnp.maximum(jnp.sqrt(norms2) * jnp.sqrt(mnorm2), 1e-30)
+    return jnp.where(ok, cos, jnp.nan)
 
 
 class COINNReducer:
@@ -97,6 +133,19 @@ class COINNReducer:
         )
         return fname
 
+    def _apply_quarantine(self, weights):
+        """Zero the participation weight of watchdog-quarantined sites —
+        the opt-in ``cache['quarantine_on_anomaly']`` escalation folded
+        into the same weighting as the nonfinite guard."""
+        quarantined = self.cache.get("quarantined_sites")
+        if quarantined:
+            sites = sorted(self.input.keys())
+            weights = weights * jnp.asarray(
+                [0.0 if s in quarantined else 1.0 for s in sites],
+                jnp.float32,
+            )
+        return weights
+
     def _site_weights(self):
         """(n_sites,) participation weights from the sites' ``grad_weight``
         outputs (1.0 when absent — older payloads): a site whose lockstep
@@ -111,7 +160,7 @@ class COINNReducer:
         )
 
     # ---------------------------------------------------------------- reduce
-    def _average(self, site_leaves, weights=None):
+    def _average(self, site_leaves, weights=None, payload=None):
         """Stack each leaf across sites and participation-weighted-mean
         on-device in one compiled call (≙ ref ``reducer.py:25-32``
         stack→GPU→mean, plus the weighting the reference's no-mask padding
@@ -120,7 +169,14 @@ class COINNReducer:
         With ``cache['guard_nonfinite']`` (default on) sites shipping NaN/Inf
         gradients — a diverged or corrupted node — are detected on-device and
         excluded from the round; the skipped site ids land in
-        ``cache['skipped_sites']`` for the control plane/logs."""
+        ``cache['skipped_sites']`` for the control plane/logs.
+
+        With telemetry enabled, every reduce also records the per-site
+        cosine-to-mean / dispersion / survivor health series (tagged with
+        ``payload``) and runs the watchdog over them; a site the watchdog
+        quarantined (opt-in ``cache['quarantine_on_anomaly']``) is folded
+        into this weighting at weight 0 — the same exclusion path as the
+        nonfinite guard, applied from the round it fires."""
         n_leaves = len(site_leaves[0])
         if n_leaves == 0:  # e.g. rankDAD's "rest" payload with no 1-D params
             return []
@@ -130,6 +186,21 @@ class COINNReducer:
             jnp.stack([jnp.asarray(site[i], dtype=jnp.float32) for site in site_leaves])
             for i in range(n_leaves)
         ]
+        # already-quarantined sites drop out BEFORE the health series, so
+        # the recorded consensus/survivor numbers describe the average that
+        # is actually applied (not a mean a weight-0 site still shaped)
+        weights = self._apply_quarantine(weights)
+        rec = _telemetry()
+        if rec.enabled:
+            sites = sorted(self.input.keys())
+            cos = np.asarray(site_cosines(stacked, weights))
+            _health.record_site_agreement(
+                self.cache, sites, cos, weights=np.asarray(weights),
+                recorder=rec, payload=payload,
+            )
+            # a quarantine the watchdog issued on THIS round's series takes
+            # effect immediately (idempotent re-mask)
+            weights = self._apply_quarantine(weights)
         wire = config.wire_dtype(self.precision_bits)
         if self.cache.get("guard_nonfinite", True):
             means, ok = _guarded_mean(stacked, weights)
@@ -158,7 +229,7 @@ class COINNReducer:
     def reduce(self):
         """Average all sites' gradients → ship ``avg_grads`` + signal update
         (≙ ref ``reducer.py:43-54``)."""
-        avg = self._average(self._load("grads_file"))
+        avg = self._average(self._load("grads_file"), payload="grads")
         _telemetry().event(
             "reduce:dSGD", cat="reduce", sites=len(self.input),
             leaves=len(avg),

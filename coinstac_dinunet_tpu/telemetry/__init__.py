@@ -10,10 +10,20 @@ Public surface:
 - :mod:`.collect` — merge per-node JSONL into one federation timeline,
   summary tables and Perfetto/Chrome-trace export; CLI at
   ``python -m coinstac_dinunet_tpu.telemetry``.
+- :mod:`.health` — per-round numeric health series (grad norms, per-site
+  cosine agreement, compression reconstruction error) recorded as typed
+  ``metric`` records at the host-side choke points.
+- :mod:`.watchdog` — pluggable anomaly detectors over those series
+  (non-finite, explosion, divergence outlier, stall, compression spike,
+  rank collapse); observe-and-report, with opt-in site quarantine.
+- :mod:`.doctor` — postmortem report over a merged run (anomaly timeline,
+  per-site divergence, ranked verdicts); CLI at
+  ``python -m coinstac_dinunet_tpu.telemetry doctor``.
 
-Stdlib-only by design: importing this package never pulls in jax (the
-recorder bridges to ``jax.monitoring`` only if jax is already loaded).
-See ``docs/TELEMETRY.md`` for the schema and workflow.
+jax-free by design: importing this package never pulls in jax (the recorder
+bridges to ``jax.monitoring`` only if jax is already loaded, and
+:mod:`.health` imports its jax numerics lazily inside the enabled-only
+helpers).  See ``docs/TELEMETRY.md`` for the schema and workflow.
 """
 from .recorder import (  # noqa: F401
     NULL_RECORDER,
@@ -22,7 +32,9 @@ from .recorder import (  # noqa: F401
     activate,
     get_active,
 )
+from .watchdog import Watchdog, register_detector  # noqa: F401
 
 __all__ = [
     "Recorder", "NULL_RECORDER", "SCHEMA_VERSION", "activate", "get_active",
+    "Watchdog", "register_detector",
 ]

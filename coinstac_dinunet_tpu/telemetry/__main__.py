@@ -6,6 +6,14 @@ per-phase/per-site summary table, and export a Perfetto/Chrome-trace JSON::
     python -m coinstac_dinunet_tpu.telemetry <workdir> --trace trace.json
 
 Open the trace at https://ui.perfetto.dev (or ``chrome://tracing``).
+
+The ``doctor`` subcommand turns the same records into a postmortem report
+(anomaly timeline, per-site divergence table, round-throughput trend, ranked
+likely-cause verdicts)::
+
+    python -m coinstac_dinunet_tpu.telemetry doctor <workdir> \\
+        --markdown postmortem.md --json postmortem.json [--format github] \\
+        [--bench-history BENCH_HISTORY.jsonl]
 """
 import argparse
 import json
@@ -13,6 +21,12 @@ import os
 import sys
 
 from .collect import load_events, render_summary, summarize, write_chrome_trace
+from .doctor import (
+    build_report,
+    load_bench_history,
+    render_github,
+    render_markdown,
+)
 
 
 def build_parser():
@@ -33,7 +47,62 @@ def build_parser():
     return p
 
 
+def build_doctor_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m coinstac_dinunet_tpu.telemetry doctor",
+        description="merge per-node telemetry into a postmortem report: "
+                    "anomaly timeline, per-site divergence, round trend, "
+                    "ranked likely-cause verdicts",
+    )
+    p.add_argument("root", nargs="?", default=".",
+                   help="run directory scanned recursively for "
+                        "telemetry.*.jsonl (default: .)")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="write the machine-readable report here")
+    p.add_argument("--markdown", default=None, metavar="PATH",
+                   help="write the markdown postmortem here")
+    p.add_argument("--format", choices=("text", "github"), default="text",
+                   help="'github' prints ::error/::warning workflow "
+                        "annotations for the verdicts instead of markdown")
+    p.add_argument("--bench-history", default=None, metavar="PATH",
+                   help="BENCH_HISTORY.jsonl (scripts/bench_history.py); "
+                        "a >threshold samples/sec/chip drop vs the previous "
+                        "entry becomes a verdict")
+    p.add_argument("--regression-threshold", type=float, default=0.10,
+                   help="bench regression fraction (default 0.10 = 10%%)")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress the report on stdout")
+    return p
+
+
+def doctor_main(argv=None):
+    args = build_doctor_parser().parse_args(argv)
+    events = load_events(args.root)
+    if not events:
+        print(f"no telemetry records under {args.root!r} — enable with "
+              "cache['profile']=True (docs/TELEMETRY.md)", file=sys.stderr)
+        return 1
+    report = build_report(
+        events,
+        bench_history=load_bench_history(args.bench_history),
+        regression_threshold=args.regression_threshold,
+    )
+    md = render_markdown(report)
+    if args.markdown:
+        with open(args.markdown, "w", encoding="utf-8") as f:
+            f.write(md + "\n")
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    if not args.quiet:
+        print(render_github(report) if args.format == "github" else md)
+    return 0
+
+
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "doctor":
+        return doctor_main(argv[1:])
     args = build_parser().parse_args(argv)
     events = load_events(args.root)
     if not events:

@@ -11,6 +11,7 @@ federated run is visually inspectable: site compute lanes, wire transfers
 with byte counts, and the aggregator's reduces, all on one timebase.
 """
 import json
+import math
 import os
 import re
 
@@ -83,15 +84,46 @@ def _node_sort_key(node):
 
 
 # ------------------------------------------------------------------ summary
+def new_metric_stats():
+    """Empty fold state for one metric series (shared with the doctor so
+    the summary table and the postmortem can never disagree on semantics)."""
+    return {"count": 0, "nonfinite": 0, "last": None, "min": None, "max": None}
+
+
+def fold_metric_sample(stats, value):
+    """Fold one ``metric`` record's value into ``stats``.  ``last``/``min``/
+    ``max`` track FINITE samples only (non-finite ones are counted, not
+    aggregated).  Returns the finite float, or None for a non-finite/
+    non-numeric sample."""
+    stats["count"] += 1
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        v = float("nan")
+    if math.isfinite(v):
+        stats["last"] = v
+        stats["min"] = v if stats["min"] is None else min(stats["min"], v)
+        stats["max"] = v if stats["max"] is None else max(stats["max"], v)
+        return v
+    stats["nonfinite"] += 1
+    return None
+
+
 def summarize(events):
     """Aggregate a merged timeline into per-node tables.
 
     Returns ``{"nodes": [...], "spans": {node: {name: {calls,total_s,
     max_s}}}, "wire": {node: {saves,save_bytes,save_raw_bytes,loads,
     load_bytes,ratio}}, "counters": {node: {name: n}},
-    "events": {node: {name: n}}, "wall_s": span of the whole run}``.
+    "events": {node: {name: n}}, "metrics": {node: {name: {count,
+    nonfinite,last,min,max}}}, "wall_s": span of the whole run}``.
+
+    ``reduce:nonfinite_skip`` events additionally surface as a per-SITE
+    ``nonfinite_skipped`` counter (attributed to the skipped site's lane,
+    not the aggregator that noticed) — the skip was previously an
+    aggregator event only, invisible in the summary table.
     """
-    spans, wire, counters, evcounts = {}, {}, {}, {}
+    spans, wire, counters, evcounts, metrics = {}, {}, {}, {}, {}
     t_lo, t_hi = None, None
     for rec in events:
         node = rec.get("node", "unknown")
@@ -126,22 +158,34 @@ def summarize(events):
             c = counters.setdefault(node, {})
             name = rec.get("name", "?")
             c[name] = c.get(name, 0) + int(rec.get("n", 0) or 0)
+        elif kind == "metric":
+            name = rec.get("name", "?")
+            m = metrics.setdefault(node, {}).setdefault(
+                name, new_metric_stats()
+            )
+            fold_metric_sample(m, rec.get("value"))
         elif kind == "event":
             e = evcounts.setdefault(node, {})
             name = rec.get("name", "?")
             e[name] = e.get(name, 0) + 1
+            if name == "reduce:nonfinite_skip":
+                # per-site visibility: credit each skipped SITE's lane with
+                # a counter, so the summary table shows who got excluded
+                for site in rec.get("sites", []) or []:
+                    c = counters.setdefault(str(site), {})
+                    c["nonfinite_skipped"] = c.get("nonfinite_skipped", 0) + 1
     for node, w in wire.items():
         w["ratio"] = (
             round(w["save_raw_bytes"] / w["save_bytes"], 4)
             if w["save_bytes"] else None
         )
     nodes = sorted(
-        set(spans) | set(wire) | set(counters) | set(evcounts),
+        set(spans) | set(wire) | set(counters) | set(evcounts) | set(metrics),
         key=_node_sort_key,
     )
     return {
         "nodes": nodes, "spans": spans, "wire": wire, "counters": counters,
-        "events": evcounts,
+        "events": evcounts, "metrics": metrics,
         "wall_s": (round(t_hi - t_lo, 6) if t_lo is not None else 0.0),
     }
 
@@ -196,6 +240,14 @@ def render_summary(summary):
                     f"{k}×{v}" for k, v in sorted(e.items())
                 )
             )
+        m = summary.get("metrics", {}).get(node)
+        if m:
+            parts = []
+            for name, st in sorted(m.items()):
+                last = "-" if st["last"] is None else f"{st['last']:.4g}"
+                nf = f" !{st['nonfinite']}nf" if st["nonfinite"] else ""
+                parts.append(f"{name}={last} (n={st['count']}{nf})")
+            lines.append("  metrics: " + ", ".join(parts))
     return "\n".join(lines)
 
 
@@ -203,7 +255,7 @@ def render_summary(summary):
 _CTX_KEYS = ("round", "fold", "epoch", "phase")
 _RECORD_KEYS = ("v", "kind", "name", "cat", "t0", "dur", "node", "op",
                 "file", "bytes", "arrays", "codec", "raw_bytes", "ratio",
-                "n") + _CTX_KEYS
+                "n", "value", "site") + _CTX_KEYS
 
 
 def _args_for(rec):
@@ -268,6 +320,32 @@ def chrome_trace(events):
                 "pid": p, "tid": 0,
                 "args": {"bytes": cum_bytes[key]},
             })
+        elif kind == "metric":
+            try:
+                v = float(rec.get("value"))
+            except (TypeError, ValueError):
+                v = float("nan")
+            name = rec.get("name", "?")
+            if math.isfinite(v):
+                suffix = f":{rec['site']}" if rec.get("site") else ""
+                out.append({
+                    "name": f"metric:{name}{suffix}", "cat": "metric",
+                    "ph": "C", "ts": ts, "pid": p, "tid": 0,
+                    "args": {"value": v},
+                })
+            else:
+                # a NaN sample breaks Perfetto counter tracks (and strict
+                # JSON) — surface it as an instant marker with the value
+                # stringified
+                args = _args_for(rec)
+                args["value"] = str(rec.get("value"))
+                if rec.get("site"):
+                    args["site"] = rec["site"]
+                out.append({
+                    "name": f"metric:{name}:nonfinite", "cat": "metric",
+                    "ph": "i", "ts": ts, "pid": p, "tid": 3, "s": "t",
+                    "args": args,
+                })
         elif kind == "counter":
             # counter records are per-flush DELTAS (Recorder.flush drains
             # the counters); accumulate so the Perfetto track is the

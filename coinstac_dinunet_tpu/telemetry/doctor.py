@@ -1,0 +1,385 @@
+"""``telemetry doctor`` — merge a run's telemetry into a postmortem report.
+
+The collector (:mod:`.collect`) answers *where did the time and bytes go*;
+the doctor answers *what went wrong and who did it*.  It merges every node's
+spans + metric series + anomaly events into:
+
+- an **anomaly timeline** (wall-clock ordered watchdog findings with round +
+  site attribution),
+- a **per-site divergence table** (cosine-to-mean stats, non-finite rounds,
+  nonfinite-skip counts, anomaly counts per site),
+- a **round-throughput trend** (engine:round span durations, first-half vs
+  second-half drift),
+- **ranked likely-cause verdicts** — ordered heuristics over the evidence
+  (a site shipping NaNs outranks a validation stall outranks a slow round),
+- optionally a **benchmark regression check** against
+  ``BENCH_HISTORY.jsonl`` (``scripts/bench_history.py``; >10% samples/sec/
+  chip drop vs the previous entry becomes a verdict).
+
+Renderers: markdown (the human postmortem, uploaded as a CI artifact), JSON
+(machines), and ``--format github`` workflow annotations for CI.
+"""
+import json
+import math
+import os
+
+from .collect import fold_metric_sample, new_metric_stats
+
+#: verdict severity order (rank 0 = most likely the root cause)
+_SEVERITY = {"critical": 0, "warning": 1, "info": 2}
+
+
+def _finite(v):
+    try:
+        return math.isfinite(float(v))
+    except (TypeError, ValueError):
+        return False
+
+
+# ------------------------------------------------------------------ report
+def build_report(events, bench_history=None, regression_threshold=0.10):
+    """Merged timeline records → postmortem report dict.
+
+    ``bench_history`` is a list of bench JSON dicts (oldest first) as read
+    by :func:`load_bench_history`; the last two entries feed the regression
+    verdict.
+    """
+    anomalies = []
+    sites = {}
+    metrics = {}
+    rounds = []
+    quarantined = set()
+
+    def site_entry(site):
+        return sites.setdefault(str(site), {
+            "cosine_n": 0, "cosine_sum": 0.0, "cosine_min": None,
+            "nonfinite_rounds": 0, "skipped_rounds": 0, "anomalies": 0,
+        })
+
+    for rec in events:
+        kind = rec.get("kind")
+        name = rec.get("name", "")
+        if kind == "metric":
+            m = metrics.setdefault(name, new_metric_stats())
+            v = fold_metric_sample(m, rec.get("value"))
+            if name == "site_cosine" and rec.get("site") is not None:
+                s = site_entry(rec["site"])
+                if v is not None:
+                    s["cosine_n"] += 1
+                    s["cosine_sum"] += v
+                    s["cosine_min"] = v if s["cosine_min"] is None else min(
+                        s["cosine_min"], v
+                    )
+                else:
+                    s["nonfinite_rounds"] += 1
+        elif kind == "event":
+            if name.startswith("anomaly:"):
+                entry = {
+                    "anomaly": name.split(":", 1)[1],
+                    "t0": float(rec.get("t0", 0.0)),
+                    "node": rec.get("node"),
+                    "round": rec.get("round"),
+                    "metric": rec.get("metric"),
+                    "value": rec.get("value"),
+                    "site": rec.get("site"),
+                    "detail": rec.get("detail"),
+                }
+                anomalies.append(entry)
+                if entry["site"] is not None:
+                    site_entry(entry["site"])["anomalies"] += 1
+            elif name == "reduce:nonfinite_skip":
+                for s in rec.get("sites", []) or []:
+                    site_entry(s)["skipped_rounds"] += 1
+            elif name == "quarantine" and rec.get("site") is not None:
+                quarantined.add(str(rec["site"]))
+        elif kind == "span" and name == "engine:round":
+            rounds.append(float(rec.get("dur", 0.0) or 0.0))
+
+    anomalies.sort(key=lambda a: a["t0"])
+    for s in sites.values():
+        n = s.pop("cosine_n")
+        total = s.pop("cosine_sum")
+        s["cosine_mean"] = round(total / n, 4) if n else None
+        if s["cosine_min"] is not None:
+            s["cosine_min"] = round(s["cosine_min"], 4)
+
+    round_stats = None
+    if rounds:
+        half = len(rounds) // 2
+        first = rounds[:half] or rounds
+        second = rounds[half:] or rounds
+        mean = sum(rounds) / len(rounds)
+        trend = (
+            (sum(second) / len(second)) / max(sum(first) / len(first), 1e-12)
+            - 1.0
+        )
+        round_stats = {
+            "count": len(rounds),
+            "mean_s": round(mean, 4),
+            "max_s": round(max(rounds), 4),
+            "trend_pct": round(100.0 * trend, 1),
+        }
+
+    bench = _bench_verdict_data(bench_history, regression_threshold)
+    report = {
+        "anomalies": anomalies,
+        "sites": sites,
+        "rounds": round_stats,
+        "metrics": metrics,
+        "quarantined": sorted(quarantined),
+        "bench": bench,
+    }
+    report["verdicts"] = _rank_verdicts(report)
+    return report
+
+
+def _bench_verdict_data(bench_history, threshold):
+    if not bench_history or len(bench_history) < 2:
+        return None
+    prev, last = bench_history[-2], bench_history[-1]
+    pv, lv = prev.get("value"), last.get("value")
+    if not (_finite(pv) and _finite(lv)) or float(pv) <= 0:
+        return None
+    drop = 1.0 - float(lv) / float(pv)
+    return {
+        "previous": float(pv), "latest": float(lv),
+        "drop_pct": round(100.0 * drop, 1),
+        "regressed": drop > threshold,
+        "threshold_pct": round(100.0 * threshold, 1),
+    }
+
+
+def _rank_verdicts(report):
+    """Evidence → ordered likely-cause list.  Severity first, then how much
+    evidence backs the verdict."""
+    verdicts = []
+
+    def add(severity, cause, evidence, weight=1):
+        verdicts.append({
+            "severity": severity, "cause": cause, "evidence": evidence,
+            "_w": weight,
+        })
+
+    # one-bad-site corruption: the strongest, most attributable signal.
+    # nonfinite_rounds (NaN site_cosine samples) and skipped_rounds
+    # (reduce:nonfinite_skip events) describe the SAME corrupted reduces
+    # from two record kinds — max, not sum, or the blast radius doubles
+    for site, s in sorted(report["sites"].items()):
+        bad = max(s["nonfinite_rounds"], s["skipped_rounds"])
+        if bad:
+            first = next(
+                (a["round"] for a in report["anomalies"]
+                 if a["site"] == site and a["anomaly"] == "nonfinite"),
+                None,
+            )
+            add(
+                "critical",
+                f"site {site} shipped non-finite gradients",
+                f"{bad} affected reduce round(s)"
+                + (f", first anomaly at round {first}" if first is not None
+                   else "")
+                + (", quarantined" if site in report["quarantined"] else
+                   ", excluded per-round by the nonfinite guard"),
+                weight=bad,
+            )
+    by_kind = {}
+    for a in report["anomalies"]:
+        by_kind.setdefault(a["anomaly"], []).append(a)
+    for site, s in sorted(report["sites"].items()):
+        outliers = [a for a in by_kind.get("divergence_outlier", [])
+                    if a["site"] == site]
+        if outliers:
+            add(
+                "critical",
+                f"site {site} diverged from the consensus gradient",
+                f"{len(outliers)} divergence anomaly(ies); "
+                f"mean cosine {s['cosine_mean']}, min {s['cosine_min']}",
+                weight=len(outliers),
+            )
+    for kind, severity, cause in (
+        ("grad_explosion", "critical", "gradient explosion"),
+        ("compression_spike", "warning",
+         "compression reconstruction error spiked"),
+        ("rank_collapse", "warning",
+         "compression factorization rank collapsed"),
+        ("val_stall", "warning", "validation metric stalled"),
+    ):
+        hits = by_kind.get(kind, [])
+        if hits:
+            where = sorted({a["node"] for a in hits if a["node"]})
+            first = hits[0]
+            add(
+                severity, cause,
+                f"{len(hits)} anomaly(ies) on {', '.join(where) or '?'}; "
+                f"first at round {first['round']}: {first['detail'] or ''}",
+                weight=len(hits),
+            )
+    rounds = report.get("rounds")
+    if rounds and rounds["count"] >= 4 and rounds["trend_pct"] > 20.0:
+        add(
+            "warning", "round throughput degraded over the run",
+            f"second-half rounds {rounds['trend_pct']:+.1f}% vs first half "
+            f"(mean {rounds['mean_s']}s over {rounds['count']} rounds)",
+        )
+    bench = report.get("bench")
+    if bench and bench["regressed"]:
+        add(
+            "warning",
+            "benchmark throughput regressed vs the previous run",
+            f"samples/sec/chip {bench['latest']:g} vs {bench['previous']:g} "
+            f"({bench['drop_pct']:+.1f}% drop, threshold "
+            f"{bench['threshold_pct']:g}%)",
+        )
+    if not verdicts:
+        add("info", "no anomalies detected",
+            "all watched series stayed within bounds")
+    verdicts.sort(key=lambda v: (_SEVERITY[v["severity"]], -v["_w"]))
+    for rank, v in enumerate(verdicts, 1):
+        v.pop("_w")
+        v["rank"] = rank
+    return verdicts
+
+
+# --------------------------------------------------------------- renderers
+def _md_table(headers, rows):
+    out = ["| " + " | ".join(headers) + " |",
+           "|" + "|".join("---" for _ in headers) + "|"]
+    for row in rows:
+        out.append("| " + " | ".join(str(c) for c in row) + " |")
+    return out
+
+
+def render_markdown(report):
+    """The human postmortem (CI artifact / PR comment body)."""
+    lines = ["# Federation health postmortem", ""]
+
+    lines.append("## Verdicts (ranked)")
+    lines.append("")
+    for v in report["verdicts"]:
+        lines.append(
+            f"{v['rank']}. **[{v['severity']}] {v['cause']}** — {v['evidence']}"
+        )
+    lines.append("")
+
+    if report["anomalies"]:
+        lines.append("## Anomaly timeline")
+        lines.append("")
+        rows = [
+            (a["round"] if a["round"] is not None else "-",
+             a["node"] or "-", a["anomaly"], a["site"] or "-",
+             a["metric"] or "-", a["value"], a["detail"] or "")
+            for a in report["anomalies"]
+        ]
+        lines.extend(_md_table(
+            ("round", "node", "anomaly", "site", "metric", "value", "detail"),
+            rows,
+        ))
+        lines.append("")
+
+    if report["sites"]:
+        lines.append("## Per-site divergence")
+        lines.append("")
+        rows = []
+        for site, s in sorted(report["sites"].items()):
+            flags = []
+            if site in report["quarantined"]:
+                flags.append("quarantined")
+            rows.append((
+                site,
+                s["cosine_mean"] if s["cosine_mean"] is not None else "-",
+                s["cosine_min"] if s["cosine_min"] is not None else "-",
+                s["nonfinite_rounds"], s["skipped_rounds"], s["anomalies"],
+                ",".join(flags) or "-",
+            ))
+        lines.extend(_md_table(
+            ("site", "cosine mean", "cosine min", "nonfinite", "skipped",
+             "anomalies", "flags"),
+            rows,
+        ))
+        lines.append("")
+
+    rounds = report.get("rounds")
+    if rounds:
+        lines.append("## Round throughput")
+        lines.append("")
+        lines.append(
+            f"{rounds['count']} engine rounds, mean {rounds['mean_s']}s, "
+            f"max {rounds['max_s']}s, second-half trend "
+            f"{rounds['trend_pct']:+.1f}%."
+        )
+        lines.append("")
+
+    bench = report.get("bench")
+    if bench:
+        lines.append("## Benchmark history")
+        lines.append("")
+        state = ("**REGRESSED**" if bench["regressed"] else "within bounds")
+        lines.append(
+            f"samples/sec/chip {bench['latest']:g} vs previous "
+            f"{bench['previous']:g} ({bench['drop_pct']:+.1f}%; threshold "
+            f"{bench['threshold_pct']:g}%) — {state}."
+        )
+        lines.append("")
+
+    if report["metrics"]:
+        lines.append("## Metric series")
+        lines.append("")
+        rows = [
+            (name, m["count"], m["nonfinite"],
+             "-" if m["last"] is None else f"{m['last']:.4g}",
+             "-" if m["min"] is None else f"{m['min']:.4g}",
+             "-" if m["max"] is None else f"{m['max']:.4g}")
+            for name, m in sorted(report["metrics"].items())
+        ]
+        lines.extend(_md_table(
+            ("metric", "samples", "nonfinite", "last", "min", "max"), rows,
+        ))
+        lines.append("")
+    return "\n".join(lines)
+
+
+def _github_escape(text):
+    return str(text).replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+
+def render_github(report):
+    """GitHub workflow annotations: one ::error per critical verdict, one
+    ::warning per warning verdict (inline on the PR's checks page)."""
+    lines = []
+    for v in report["verdicts"]:
+        if v["severity"] == "info":
+            continue
+        cmd = "error" if v["severity"] == "critical" else "warning"
+        lines.append(
+            f"::{cmd} title=telemetry doctor::"
+            f"{_github_escape(v['cause'] + ' — ' + v['evidence'])}"
+        )
+    lines.append(
+        f"{len(report['anomalies'])} anomaly(ies), "
+        f"{len(report['verdicts'])} verdict(s)"
+    )
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------ bench history
+def load_bench_history(path):
+    """``BENCH_HISTORY.jsonl`` (one bench JSON line per run, oldest first)
+    → list of dicts.  Missing file → empty list; corrupt lines skipped."""
+    out = []
+    if not path or not os.path.exists(path):
+        return out
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out

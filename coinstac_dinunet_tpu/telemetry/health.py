@@ -1,0 +1,186 @@
+"""Numeric health series for a federated round — the *is the training
+healthy* layer on top of the PR-3 Recorder's *where does the time go*.
+
+Every function here is a HOST-SIDE choke point helper: it computes a small
+scalar (a norm, a cosine, an effective rank) from values the instrumented
+code already holds on the host boundary, records it as a typed ``metric``
+JSONL record, and feeds the node's :class:`~.watchdog.Watchdog`.  The
+contract with the hot path:
+
+- **Zero overhead when disabled.**  Call sites guard with
+  ``if get_active().enabled:`` before computing anything — with
+  ``cache['profile']`` off, the cost of a health point is the same one
+  attribute lookup as every other telemetry site.
+- **Never inside traced functions.**  Norm/cosine math runs op-by-op on
+  device and pulls ONE scalar to host per series — always around the
+  compiled call, never in it (the ``trace-telemetry`` dinulint rule keeps
+  this true statically).
+
+Metric names come from the :class:`~..config.keys.Metric` vocabulary; the
+``telemetry-metric-name`` dinulint rule statically rejects any
+``record_metric(...)`` call whose name is not in it.
+"""
+import math
+
+import numpy as np
+
+from ..config.keys import Anomaly, Metric
+from .recorder import get_active
+from .watchdog import Watchdog
+
+__all__ = [
+    "record_metric", "global_norm", "effective_rank", "relative_error",
+    "record_grad_health", "record_update_health", "record_val_score",
+    "record_site_agreement", "record_compression_health",
+]
+
+
+def record_metric(name, value, site=None, cache=None, recorder=None, **attrs):
+    """Record one sample of a health series and run the watchdog over it.
+
+    No-op when telemetry is disabled.  ``cache`` binds the watchdog (skip it
+    for record-only series); extra ``attrs`` ride the JSONL record.
+    """
+    rec = recorder if recorder is not None else get_active()
+    if not rec.enabled:
+        return
+    value = float(value)
+    rec.metric(name, value, site=site, **attrs)
+    if cache is not None:
+        Watchdog(cache, rec).observe(name, value, site=site)
+
+
+# ---------------------------------------------------------------- numerics
+def global_norm(tree_or_leaves):
+    """Global L2 norm over a pytree (or list) of arrays, as a host float.
+    One device reduction per leaf + one host sync total."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree_or_leaves)
+    if not leaves:
+        return 0.0
+    total = sum(
+        jnp.sum(jnp.square(jnp.asarray(g, jnp.float32))) for g in leaves
+    )
+    return float(jnp.sqrt(total))
+
+
+def relative_error(errors, references):
+    """``sqrt(Σ‖err‖² / Σ‖ref‖²)`` over paired leaf lists — the aggregate
+    relative reconstruction error of a compressed gradient."""
+    import jax.numpy as jnp
+
+    if not errors:
+        return 0.0
+    num = sum(jnp.sum(jnp.square(jnp.asarray(e, jnp.float32))) for e in errors)
+    den = sum(jnp.sum(jnp.square(jnp.asarray(r, jnp.float32))) for r in references)
+    return float(jnp.sqrt(num / jnp.maximum(den, 1e-30)))
+
+
+def effective_rank(factor):
+    """Entropy effective rank ``exp(H(σ²/Σσ²))`` of a rank-r factor's
+    spectrum, via the tiny r×r Gram matrix (the factor is tall-skinny:
+    PowerSGD's Q or rankDAD's B).  1.0 = fully collapsed, r = flat."""
+    f = np.asarray(factor, np.float64)
+    if f.ndim != 2 or 0 in f.shape:
+        return 0.0
+    if f.shape[0] < f.shape[1]:
+        f = f.T
+    gram = f.T @ f  # (r, r)
+    if not np.all(np.isfinite(gram)):
+        return float("nan")
+    s2 = np.linalg.eigvalsh(gram)
+    s2 = np.clip(s2, 0.0, None)
+    total = float(s2.sum())
+    if total <= 0.0:
+        return 0.0
+    p = s2 / total
+    p = p[p > 0]
+    return float(math.exp(-np.sum(p * np.log(p))))
+
+
+# ------------------------------------------------------- choke-point helpers
+def record_grad_health(cache, grads, aux=None, recorder=None):
+    """Site-side backward round: global gradient norm + its watchdog EMA +
+    the round's mean training loss.  Call ONLY under ``rec.enabled``."""
+    rec = recorder if recorder is not None else get_active()
+    if not rec.enabled:
+        return
+    norm = global_norm(grads)
+    record_metric(Metric.GRAD_NORM, norm, cache=cache, recorder=rec)
+    ema = Watchdog(cache, rec).ema(Anomaly.GRAD_EXPLOSION)
+    if ema is not None:
+        # record-only: the EMA is the explosion detector's own baseline
+        record_metric(Metric.GRAD_NORM_EMA, ema, recorder=rec)
+    if aux is not None and aux.get("loss") is not None:
+        record_metric(
+            Metric.TRAIN_LOSS, float(np.asarray(aux["loss"])),
+            cache=cache, recorder=rec,
+        )
+
+
+def record_update_health(cache, grads, recorder=None):
+    """Applied-update round: global norm of the (averaged) gradient the
+    optimizer is about to apply."""
+    rec = recorder if recorder is not None else get_active()
+    if not rec.enabled:
+        return
+    record_metric(
+        Metric.UPDATE_NORM, global_norm(grads), cache=cache, recorder=rec,
+    )
+
+
+def record_val_score(cache, score, recorder=None):
+    """Epoch barrier: the monitored validation metric (stall detection)."""
+    rec = recorder if recorder is not None else get_active()
+    if not rec.enabled or score is None:
+        return
+    record_metric(Metric.VAL_SCORE, float(score), cache=cache, recorder=rec)
+
+
+def record_site_agreement(cache, sites, cosines, weights=None, recorder=None,
+                          payload=None):
+    """Aggregator-side reduce: per-site cosine-to-mean series (NaN for a
+    non-finite site — the attribution the doctor ranks on), the cross-site
+    dispersion, and the survivor count.
+
+    ``cosines`` is the (n_sites,) vector from
+    :func:`~..parallel.reducer.site_cosines`; ``weights`` the participation
+    weights (0 = excluded).  ``payload`` tags which wire payload the series
+    came from (grads / powerSGD_P / dad_data ...).
+    """
+    rec = recorder if recorder is not None else get_active()
+    if not rec.enabled:
+        return
+    cos = np.asarray(cosines, np.float64)
+    w = (np.asarray(weights, np.float64) if weights is not None
+         else np.ones(len(sites)))
+    attrs = {"payload": payload} if payload else {}
+    wd = Watchdog(cache, rec)
+    finite = []
+    for site, c, wi in zip(sites, cos, w):
+        rec.metric(Metric.SITE_COSINE, float(c), site=str(site), **attrs)
+        wd.observe(Metric.SITE_COSINE, float(c), site=str(site))
+        if math.isfinite(float(c)) and wi > 0:
+            finite.append(float(c))
+    dispersion = float(np.std(finite)) if finite else 0.0
+    record_metric(Metric.SITE_DISPERSION, dispersion, cache=cache,
+                  recorder=rec, **attrs)
+    record_metric(Metric.SURVIVORS, float(len(finite)), cache=cache,
+                  recorder=rec, **attrs)
+
+
+def record_compression_health(cache, rel_error, eff_rank, recorder=None,
+                              engine=None):
+    """Compression round: relative reconstruction error + effective rank of
+    the factorization (spike / rank-collapse detection)."""
+    rec = recorder if recorder is not None else get_active()
+    if not rec.enabled:
+        return
+    attrs = {"engine": engine} if engine else {}
+    record_metric(Metric.COMPRESSION_ERROR, float(rel_error), cache=cache,
+                  recorder=rec, **attrs)
+    if eff_rank is not None:
+        record_metric(Metric.EFFECTIVE_RANK, float(eff_rank), cache=cache,
+                      recorder=rec, **attrs)

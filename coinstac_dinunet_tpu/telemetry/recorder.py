@@ -33,6 +33,8 @@ Record schema (one JSON object per line; absent context fields are omitted)::
      "dur": secs, "node": ..., "round": n, "fold": ..., "epoch": n,
      "phase": ..., ...attrs}
     {"v": 1, "kind": "event",   "name": ..., "cat": ..., "t0": ..., ...}
+    {"v": 1, "kind": "metric",  "name": ..., "value": float, "t0": ...,
+     "site": attributed-site-id?, ...context}
     {"v": 1, "kind": "wire",    "op": "save"|"load", "file": basename,
      "bytes": payload-bytes, "arrays": k, "codec": ..., "raw_bytes": n,
      "ratio": raw/payload, "dur": secs, ...context}
@@ -91,6 +93,9 @@ class _NullRecorder:
         pass
 
     def event(self, name, cat="event", **attrs):
+        pass
+
+    def metric(self, name, value, site=None, **attrs):
         pass
 
     def wire(self, op, path, nbytes=0, arrays=0, codec=None, raw_bytes=None,
@@ -276,6 +281,23 @@ class Recorder:
         """Instantaneous record (quorum decisions, jit builds, failures)."""
         rec = {"v": SCHEMA_VERSION, "kind": "event", "name": name, "cat": cat,
                "t0": time.time()}
+        rec.update(self._ctx())
+        if attrs:
+            rec.update(attrs)
+        self._append(rec)
+
+    def metric(self, name, value, site=None, **attrs):
+        """One sample of a numeric health series (``kind: "metric"``).
+
+        ``value`` is kept as a float even when non-finite — a NaN sample IS
+        the signal for the nonfinite watchdog and the doctor's attribution
+        (Python's json module round-trips ``NaN``/``Infinity`` tokens).
+        ``site`` attributes an aggregator-side series to the originating
+        site (e.g. per-site gradient cosine)."""
+        rec = {"v": SCHEMA_VERSION, "kind": "metric", "name": str(name),
+               "value": float(value), "t0": time.time()}
+        if site is not None:
+            rec["site"] = str(site)
         rec.update(self._ctx())
         if attrs:
             rec.update(attrs)

@@ -1,0 +1,346 @@
+"""Host-side training-health watchdog: pluggable anomaly detectors over the
+metric series the health layer records.
+
+The failure modes this watches for are exactly the ones the paper's regime
+invites — many sites pushing gradients through lossy compression
+(PowerSGD/rankDAD) with quorum dropout: silent divergence of one site, rank
+collapse of the factorization, NaN corruption from a single bad node, a
+validation metric that quietly stopped moving (decoupled/compressed gradient
+paths amplify staleness and reconstruction error; arxiv 1906.12043,
+2004.13336).  None of these crash the run — they degrade it, which is why a
+watchdog has to watch the *numbers*, not the exceptions.
+
+Design:
+
+- **Observe-and-report by default.**  Every detector finding is (1) an
+  ``anomaly:<name>`` event on the node's telemetry timeline, (2) an entry in
+  the JSON-able ``cache['health']['anomalies']`` rollup the nodes ship over
+  the wire (``LocalWire.HEALTH``/``RemoteWire.HEALTH``), and (3) a log
+  warning.  Nothing changes the training math.
+- **Opt-in quarantine.**  With ``cache['quarantine_on_anomaly']`` truthy, a
+  site-attributed anomaly adds the site to ``cache['quarantined_sites']``,
+  which the reducer folds into the existing nonfinite-skip weighting
+  (weight 0 — the site stops influencing the average but stays in the
+  protocol).
+- **Edge-triggered.**  A detector fires when its condition *becomes* true
+  and re-arms when the series recovers, so a persistently-NaN site is one
+  anomaly, not one per round (the per-round evidence lives in the metric
+  series itself).
+- **Fresh-process safe.**  All detector state is a small JSON-able dict
+  under ``cache['health']`` (listed in ``basetrainer._VOLATILE_CACHE_KEYS``),
+  so it survives the invocation-per-round engine exactly like
+  ``telemetry_round`` does.
+
+Detector registration names MUST come from the
+:class:`~..config.keys.Anomaly`/:class:`~..config.keys.Metric` vocabulary —
+the ``telemetry-metric-name`` dinulint rule checks every
+``register_detector(...)`` call statically.
+"""
+import math
+
+from ..config.keys import Anomaly, Metric
+from ..utils import logger
+from .recorder import get_active
+
+# bounded rollup: the health summary rides the wire every round
+_MAX_ANOMALIES = 50
+
+#: default detector classes in registration order
+DEFAULT_DETECTORS = []
+
+
+def register_detector(anomaly, metric=None):
+    """Class decorator binding a detector to its anomaly name and (optional)
+    watched metric.  ``metric=None`` means the detector sees EVERY series
+    (the nonfinite check).  Both names are statically checked against the
+    config/keys.py vocabulary by the ``telemetry-metric-name`` lint rule."""
+
+    def deco(cls):
+        cls.anomaly = str(anomaly)
+        cls.metric = str(metric) if metric is not None else None
+        DEFAULT_DETECTORS.append(cls)
+        return cls
+
+    return deco
+
+
+class Detector:
+    """One anomaly condition over one (or every) metric series.
+
+    ``check(state, value, site, cache)`` sees the detector's own JSON-able
+    state dict and returns ``None`` (healthy) or a dict of anomaly
+    attributes (fires).  Implementations must be edge-triggered: use the
+    state dict to remember the armed/fired condition.
+    """
+
+    anomaly = None
+    metric = None
+
+    def check(self, state, value, site, cache):  # pragma: no cover - interface
+        return None
+
+
+def _finite(v):
+    return isinstance(v, (int, float)) and math.isfinite(v)
+
+
+@register_detector(Anomaly.NONFINITE)
+class NonfiniteDetector(Detector):
+    """Any watched series going NaN/Inf — the one-bad-site corruption
+    signal.  Per-(metric, site) edge trigger: fires on the transition into
+    the non-finite state, re-arms on recovery."""
+
+    def check(self, state, value, site, cache):
+        key = f"{state['_metric']}@{site or ''}"
+        bad = not _finite(value)
+        was_bad = key in state.setdefault("bad", [])
+        if bad and not was_bad:
+            state["bad"].append(key)
+            return {"detail": f"{state['_metric']} went non-finite"}
+        if not bad and was_bad:
+            state["bad"].remove(key)
+        return None
+
+
+class _EmaSpikeDetector(Detector):
+    """Shared machinery: value > ratio × EMA after a warm-up, edge-triggered.
+    The EMA is published back into the state so the health layer can record
+    it as its own series (``grad_norm_ema``)."""
+
+    ratio_key = None
+    default_ratio = 10.0
+    warmup = 5
+    decay = 0.9
+
+    def check(self, state, value, site, cache):
+        if not _finite(value):
+            return None  # the nonfinite detector owns that failure mode
+        ema = state.get("ema")
+        n = int(state.get("n", 0))
+        ratio = float(cache.get(self.ratio_key, self.default_ratio))
+        fired = None
+        if ema is not None and n >= self.warmup and value > ratio * max(ema, 1e-30):
+            if not state.get("tripped"):
+                state["tripped"] = True
+                fired = {
+                    "detail": (
+                        f"{self.metric} {value:.4g} exceeded "
+                        f"{ratio:g}x EMA {ema:.4g}"
+                    ),
+                    "ema": round(ema, 6),
+                }
+            # a tripped spike must not drag the EMA up to the spike level —
+            # freeze it so a sustained explosion stays visible
+        else:
+            state["tripped"] = False
+            ema = value if ema is None else self.decay * ema + (1 - self.decay) * value
+            state["ema"] = ema
+        state["n"] = n + 1
+        return fired
+
+
+@register_detector(Anomaly.GRAD_EXPLOSION, metric=Metric.GRAD_NORM)
+class GradExplosionDetector(_EmaSpikeDetector):
+    """Gradient norm spiking vs its EMA (``cache['watchdog_explosion_ratio']``,
+    default 10x, after 5 warm-up rounds)."""
+
+    ratio_key = "watchdog_explosion_ratio"
+    default_ratio = 10.0
+
+
+@register_detector(Anomaly.COMPRESSION_SPIKE, metric=Metric.COMPRESSION_ERROR)
+class CompressionSpikeDetector(_EmaSpikeDetector):
+    """Compression reconstruction error spiking vs its EMA
+    (``cache['watchdog_compression_ratio']``, default 5x)."""
+
+    ratio_key = "watchdog_compression_ratio"
+    default_ratio = 5.0
+
+
+@register_detector(Anomaly.DIVERGENCE_OUTLIER, metric=Metric.SITE_COSINE)
+class DivergenceOutlierDetector(Detector):
+    """A site's gradient direction detaching from the consensus: cosine to
+    the weighted mean below ``cache['watchdog_cosine_floor']`` (default 0.0
+    — anti-aligned).  Per-site edge trigger."""
+
+    def check(self, state, value, site, cache):
+        if not _finite(value):
+            return None
+        floor = float(cache.get("watchdog_cosine_floor", 0.0))
+        low = state.setdefault("low", [])
+        key = str(site or "")
+        if value < floor:
+            if key not in low:
+                low.append(key)
+                return {
+                    "detail": (
+                        f"site cosine {value:.4f} below floor {floor:g}"
+                    ),
+                }
+        elif key in low:
+            low.remove(key)
+        return None
+
+
+@register_detector(Anomaly.VAL_STALL, metric=Metric.VAL_SCORE)
+class ValStallDetector(Detector):
+    """The monitored validation metric not improving for
+    ``cache['watchdog_stall_patience']`` consecutive observations (default
+    5); direction follows ``cache['metric_direction']``.  Fires once per
+    stall; re-arms on the next improvement."""
+
+    def check(self, state, value, site, cache):
+        if not _finite(value):
+            return None
+        patience = int(cache.get("watchdog_stall_patience", 5))
+        maximize = str(cache.get("metric_direction", "maximize")) == "maximize"
+        best = state.get("best")
+        improved = best is None or (value > best if maximize else value < best)
+        if improved:
+            state["best"] = value
+            state["since"] = 0
+            state["tripped"] = False
+            return None
+        state["since"] = int(state.get("since", 0)) + 1
+        if state["since"] >= patience and not state.get("tripped"):
+            state["tripped"] = True
+            return {
+                "detail": (
+                    f"no improvement over best {best:.4g} for "
+                    f"{state['since']} evaluations"
+                ),
+            }
+        return None
+
+
+@register_detector(Anomaly.RANK_COLLAPSE, metric=Metric.EFFECTIVE_RANK)
+class RankCollapseDetector(Detector):
+    """Effective rank dropping below
+    ``cache['watchdog_rank_floor_frac']`` (default 0.5) of the first
+    observed value — the factorization degenerating to (near) rank one,
+    which silently discards gradient directions."""
+
+    def check(self, state, value, site, cache):
+        if not _finite(value):
+            return None
+        first = state.get("first")
+        if first is None:
+            state["first"] = value
+            return None
+        frac = float(cache.get("watchdog_rank_floor_frac", 0.5))
+        if value < frac * first:
+            if not state.get("tripped"):
+                state["tripped"] = True
+                return {
+                    "detail": (
+                        f"effective rank {value:.3f} below {frac:g}x the "
+                        f"initial {first:.3f}"
+                    ),
+                }
+        else:
+            state["tripped"] = False
+        return None
+
+
+class Watchdog:
+    """The per-node anomaly watchdog bound to a node cache + recorder.
+
+    Cheap to construct (all state lives in the cache), so call sites build
+    one per observation batch: ``Watchdog(cache).observe(name, value)``.
+    """
+
+    def __init__(self, cache, recorder=None, detectors=None):
+        self.cache = cache
+        self.rec = recorder if recorder is not None else get_active()
+        self.detectors = [
+            cls() for cls in (detectors if detectors is not None
+                              else DEFAULT_DETECTORS)
+        ]
+        st = cache.get("health")
+        if not isinstance(st, dict):
+            st = cache["health"] = {}
+        st.setdefault("detectors", {})
+        st.setdefault("anomalies", [])
+        self.state = st
+
+    # ------------------------------------------------------------- observing
+    def observe(self, name, value, site=None, **ctx):
+        """Feed one sample of series ``name`` through every matching
+        detector; emits anomalies as events + rollup entries.  Returns the
+        list of anomaly names that fired."""
+        name = str(name)
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            return []
+        fired = []
+        for det in self.detectors:
+            if det.metric is not None and det.metric != name:
+                continue
+            st = self.state["detectors"].setdefault(det.anomaly, {})
+            st["_metric"] = name
+            hit = det.check(st, value, site, self.cache)
+            if hit:
+                self._emit(det.anomaly, name, value, site, hit, ctx)
+                fired.append(det.anomaly)
+        return fired
+
+    def ema(self, anomaly_name):
+        """Published EMA of an EMA-based detector (None before warm-up)."""
+        return self.state["detectors"].get(str(anomaly_name), {}).get("ema")
+
+    # -------------------------------------------------------------- emission
+    def _emit(self, anomaly, metric_name, value, site, hit, ctx):
+        entry = {
+            "anomaly": anomaly,
+            "metric": metric_name,
+            "value": (round(value, 6) if _finite(value) else str(value)),
+            "round": int(self.cache.get("telemetry_round", 0) or 0),
+            "epoch": int(self.cache.get("epoch", 0) or 0),
+        }
+        if site is not None:
+            entry["site"] = str(site)
+        entry.update({k: v for k, v in hit.items() if v is not None})
+        roll = self.state["anomalies"]
+        roll.append(entry)
+        del roll[:-_MAX_ANOMALIES]
+        self.rec.event(
+            f"anomaly:{anomaly}", cat="anomaly", metric=metric_name,
+            value=entry["value"], **({"site": entry["site"]} if site is not None else {}),
+            **{k: v for k, v in hit.items() if v is not None}, **ctx,
+        )
+        # an anomaly is never verbosity-gated
+        logger.warn(
+            f"watchdog: {anomaly} on {metric_name}"
+            + (f" (site {site})" if site is not None else "")
+            + f" — {hit.get('detail', '')}",
+            True,
+        )
+        if self.cache.get("quarantine_on_anomaly") and site is not None:
+            q = self.cache.setdefault("quarantined_sites", [])
+            if str(site) not in q:
+                q.append(str(site))
+                self.rec.event(
+                    "quarantine", cat="anomaly", site=str(site),
+                    anomaly=anomaly,
+                )
+                logger.warn(
+                    f"watchdog: quarantined site {site} "
+                    f"({anomaly}; weight 0 in every following reduce)",
+                    True,
+                )
+
+    # --------------------------------------------------------------- summary
+    def summary(self):
+        """Wire-sized health summary (the ``health`` wire key payload):
+        recent anomalies plus per-anomaly counts.  Empty dict = healthy."""
+        anomalies = self.state.get("anomalies", [])
+        if not anomalies and not self.cache.get("quarantined_sites"):
+            return {}
+        counts = {}
+        for a in anomalies:
+            counts[a["anomaly"]] = counts.get(a["anomaly"], 0) + 1
+        out = {"counts": counts, "recent": anomalies[-10:]}
+        if self.cache.get("quarantined_sites"):
+            out["quarantined"] = list(self.cache["quarantined_sites"])
+        return out

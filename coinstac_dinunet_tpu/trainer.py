@@ -13,6 +13,7 @@ from .config.keys import Key, Mode
 from .metrics import new_metrics as _metric_factory
 from .nn.basetrainer import NNTrainer
 from .telemetry import get_active as _telemetry
+from .telemetry import health as _health
 from .utils.utils import performance_improved_
 
 
@@ -47,10 +48,19 @@ class COINNTrainer(NNTrainer):
     def validation_distributed(self):
         """Run local validation and emit the serialized payload the
         aggregator reduces across sites (exact count merge)."""
-        with _telemetry().span("local:validation", cat="eval"):
+        rec = _telemetry()
+        with rec.span("local:validation", cat="eval"):
             averages, metrics = self.evaluation(
                 Mode.VALIDATION, [self.data_handle.get_validation_dataset()]
             )
+        if rec.enabled:
+            # the site's own monitored-metric trajectory (the stall
+            # detector's series; the aggregator records the GLOBAL one)
+            try:
+                score = metrics.extract(self.cache.get("monitor_metric", "f1"))
+            except AttributeError:
+                score = averages.average
+            _health.record_val_score(self.cache, score, recorder=rec)
         return {
             Key.VALIDATION_SERIALIZABLE.value: [
                 {"averages": averages.serialize(), "metrics": metrics.serialize()}

@@ -1,0 +1,101 @@
+"""Benchmark history ledger: append ``bench.py``'s one-line JSON to
+``BENCH_HISTORY.jsonl`` and flag throughput regressions.
+
+``bench.py`` prints ONE JSON line whose ``value`` is the headline
+samples/sec/chip; each CI/operator run appends that line here (oldest
+first), giving the ``telemetry doctor`` a baseline to diff against::
+
+    python bench.py | tail -1 > /tmp/bench.json
+    python scripts/bench_history.py append --input /tmp/bench.json
+    python -m coinstac_dinunet_tpu.telemetry doctor <workdir> \\
+        --bench-history BENCH_HISTORY.jsonl
+
+``check`` compares the last two entries and exits non-zero on a
+``--threshold`` (default 10%) drop — usable as a standalone CI gate;
+``append`` also prints the comparison (add ``--fail-on-regression`` to gate
+in the same step).
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_HISTORY = os.path.join(_REPO, "BENCH_HISTORY.jsonl")
+
+
+def _load_history(path):
+    # same tolerant reader the doctor uses (corrupt lines never wedge CI)
+    sys.path.insert(0, _REPO)
+    from coinstac_dinunet_tpu.telemetry.doctor import load_bench_history
+
+    return load_bench_history(path)
+
+
+def _compare(history, threshold):
+    """(message, regressed) for the last two entries of ``history``."""
+    if len(history) < 2:
+        return f"{len(history)} entr{'y' if len(history) == 1 else 'ies'} — nothing to compare yet", False
+    prev, last = history[-2], history[-1]
+    pv, lv = prev.get("value"), last.get("value")
+    try:
+        pv, lv = float(pv), float(lv)
+    except (TypeError, ValueError):
+        return "previous or latest entry has no numeric 'value'", False
+    if pv <= 0:
+        return f"previous value {pv} not positive; skipping comparison", False
+    drop = 1.0 - lv / pv
+    msg = (
+        f"samples/sec/chip {lv:g} vs previous {pv:g} "
+        f"({-100.0 * drop:+.1f}%)"
+    )
+    if drop > threshold:
+        return f"REGRESSION: {msg} exceeds the {100 * threshold:g}% threshold", True
+    return f"OK: {msg}", False
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    ap = sub.add_parser("append", help="append a bench JSON line and compare")
+    ap.add_argument("--input", default="-",
+                    help="file holding bench.py's JSON line (default: stdin)")
+    ap.add_argument("--history", default=DEFAULT_HISTORY)
+    ap.add_argument("--threshold", type=float, default=0.10)
+    ap.add_argument("--fail-on-regression", action="store_true")
+    cp = sub.add_parser("check", help="compare the last two history entries")
+    cp.add_argument("--history", default=DEFAULT_HISTORY)
+    cp.add_argument("--threshold", type=float, default=0.10)
+    args = p.parse_args(argv)
+
+    if args.cmd == "append":
+        raw = (sys.stdin.read() if args.input == "-"
+               else open(args.input, "r", encoding="utf-8").read())
+        # bench.py may print progress lines; the LAST JSON line is the result
+        entry = None
+        for line in reversed(raw.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    entry = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+        if not isinstance(entry, dict):
+            print("no JSON object found in the input", file=sys.stderr)
+            return 2
+        with open(args.history, "a", encoding="utf-8") as f:
+            f.write(json.dumps(entry, separators=(",", ":"), sort_keys=True) + "\n")
+        history = _load_history(args.history)
+        msg, regressed = _compare(history, args.threshold)
+        print(f"appended entry #{len(history)} to {args.history}; {msg}")
+        return 1 if (regressed and args.fail_on_regression) else 0
+
+    history = _load_history(args.history)
+    msg, regressed = _compare(history, args.threshold)
+    print(msg)
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

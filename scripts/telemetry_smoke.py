@@ -8,10 +8,17 @@ subsystem's contract: spans for the local phases, wire transfers with byte
 counts + compression ratio, and the remote reduce — all present in the
 merged timeline.
 
+With ``--inject-nan-site N`` the N-th site feeds NaN inputs from its second
+epoch on (the one-bad-site corruption scenario): the smoke then additionally
+asserts the watchdog attributed a ``nonfinite`` anomaly to that site, the
+reducer excluded it per round, and ``telemetry doctor``'s TOP verdict names
+it — the observability acceptance gate, run by the CI ``telemetry`` job
+which uploads the markdown postmortem as an artifact.
+
 Usage::
 
     python scripts/telemetry_smoke.py --workdir /tmp/telemetry_run \
-        --trace /tmp/telemetry_run/trace.json
+        --trace /tmp/telemetry_run/trace.json [--inject-nan-site 1]
 """
 import argparse
 import json
@@ -31,6 +38,9 @@ def main(argv=None):
                    help="merged Chrome-trace output path "
                         "(default: <workdir>/trace.json)")
     p.add_argument("--sites", type=int, default=2)
+    p.add_argument("--inject-nan-site", type=int, default=None, metavar="N",
+                   help="site index whose inputs go NaN from its second "
+                        "epoch on (watchdog/doctor acceptance scenario)")
     args = p.parse_args(argv)
     trace_path = args.trace or os.path.join(args.workdir, "trace.json")
 
@@ -38,19 +48,42 @@ def main(argv=None):
 
     jax.config.update("jax_platforms", "cpu")
 
+    import numpy as np
+
     from coinstac_dinunet_tpu.engine import InProcessEngine
     from coinstac_dinunet_tpu.models import FSVDataset, FSVTrainer
     from coinstac_dinunet_tpu.telemetry.collect import (
         load_events, render_summary, summarize, write_chrome_trace,
     )
 
+    class NaNFSVDataset(FSVDataset):
+        """NaN inputs once the owning site reaches cache['nan_from_epoch']
+        — gradients (and every payload derived from them) go non-finite."""
+
+        def __getitem__(self, ix):
+            item = super().__getitem__(ix)
+            start = self.cache.get("nan_from_epoch")
+            if start is not None and int(self.cache.get("epoch", 0)) >= int(start):
+                item = dict(item)
+                item["inputs"] = np.full_like(
+                    np.asarray(item["inputs"], np.float32), np.nan
+                )
+            return item
+
+    nan_site = (
+        f"site_{args.inject_nan_site}" if args.inject_nan_site is not None
+        else None
+    )
     eng = InProcessEngine(
         args.workdir, n_sites=args.sites, trainer_cls=FSVTrainer,
-        dataset_cls=FSVDataset, task_id="fsv_classification",
+        dataset_cls=(NaNFSVDataset if nan_site else FSVDataset),
+        task_id="fsv_classification",
         data_dir="data", split_ratio=[0.6, 0.2, 0.2], batch_size=4,
         epochs=2, validation_epochs=1, learning_rate=5e-2, input_size=12,
         hidden_sizes=[8], num_classes=2, seed=7, synthetic=True,
         patience=50, profile=True,
+        # site epoch counters are 0-based: 1 = the second epoch
+        site_args=({nan_site: {"nan_from_epoch": 1}} if nan_site else None),
     )
     for s in eng.site_ids:
         d = eng.site_data_dir(s)
@@ -81,6 +114,28 @@ def main(argv=None):
     assert wires and all(
         e["bytes"] > 0 and e["arrays"] > 0 and "ratio" in e for e in wires
     ), "wire records missing byte/ratio accounting"
+
+    # health layer: metric series on the live rounds
+    metric_names = {e["name"] for e in events if e.get("kind") == "metric"}
+    assert "grad_norm" in metric_names, metric_names
+    assert "site_cosine" in metric_names, metric_names
+
+    if nan_site:
+        from coinstac_dinunet_tpu.telemetry.doctor import build_report
+
+        anomalies = [e for e in events if e.get("kind") == "event"
+                     and e["name"] == "anomaly:nonfinite"]
+        assert any(e.get("site") == nan_site for e in anomalies), (
+            f"no nonfinite anomaly attributed to {nan_site}: {anomalies}"
+        )
+        skips = [e for e in events if e.get("kind") == "event"
+                 and e["name"] == "reduce:nonfinite_skip"]
+        assert skips and all(nan_site in e["sites"] for e in skips), skips
+        report = build_report(events)
+        top = report["verdicts"][0]
+        assert nan_site in top["cause"] and top["severity"] == "critical", top
+        print(f"\ninjected-NaN scenario verified: top verdict = {top['cause']}")
+
     print(
         f"\nOK: {len(events)} records from {len(summary['nodes'])} nodes, "
         f"{len(trace['traceEvents'])} trace events -> {trace_path}"

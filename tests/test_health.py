@@ -1,0 +1,530 @@
+"""Health metrics, anomaly watchdog, and the `telemetry doctor` postmortem
+(ISSUE 4 acceptance).
+
+- **Detectors**: every watchdog detector fires EXACTLY ONCE at the seeded
+  index of a synthetic series (edge-triggered), and re-arms on recovery.
+- **Quarantine**: opt-in ``quarantine_on_anomaly`` folds a site-attributed
+  anomaly into the reducer's weighting (weight 0, the nonfinite-skip path).
+- **Acceptance**: a two-site PowerSGD run with one site injecting NaN
+  gradients produces (a) grad-norm / site-divergence / compression-error
+  metric series across the live rounds, (b) a ``nonfinite`` anomaly
+  attributed to the correct site and round, (c) a ``doctor`` report whose
+  TOP verdict names that site.
+- **Doctor**: golden report over a two-site trace with one injected
+  anomaly; markdown/github renderers; bench-history regression verdict.
+- **Lint**: the ``telemetry-metric-name`` rule fires on typo'd names and
+  stays quiet on vocabulary constants (fixture tests, ≙ sharding-*).
+"""
+import ast
+import json
+import math
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu.config.keys import Anomaly, Metric
+from coinstac_dinunet_tpu.engine import InProcessEngine
+from coinstac_dinunet_tpu.telemetry import (
+    NULL_RECORDER,
+    Recorder,
+    Watchdog,
+    activate,
+    health,
+)
+from coinstac_dinunet_tpu.telemetry.collect import load_events, summarize
+from coinstac_dinunet_tpu.telemetry.doctor import (
+    build_report,
+    load_bench_history,
+    render_github,
+    render_markdown,
+)
+
+from test_trainer import XorDataset, XorTrainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ metric records
+def test_recorder_metric_record_schema(tmp_path):
+    cache = {"profile": True, "telemetry_round": 3, "epoch": 1}
+    rec = Recorder("remote", cache=cache, out_dir=str(tmp_path))
+    rec.metric(Metric.GRAD_NORM, 1.25)
+    rec.metric(Metric.SITE_COSINE, float("nan"), site="site_1", payload="grads")
+    rec.flush()
+    events = load_events(str(tmp_path))
+    assert [e["kind"] for e in events] == ["metric", "metric"]
+    g, c = events
+    assert g["name"] == "grad_norm" and g["value"] == 1.25 and g["round"] == 3
+    assert c["site"] == "site_1" and math.isnan(c["value"])  # NaN round-trips
+    assert c["payload"] == "grads"
+
+
+def test_null_recorder_metric_is_noop():
+    assert NULL_RECORDER.metric("x", 1.0) is None
+    cache = {}
+    health.record_metric(Metric.GRAD_NORM, 1.0, cache=cache)  # disabled
+    assert "health" not in cache  # no watchdog state materialized
+
+
+def test_record_metric_feeds_watchdog(tmp_path):
+    cache = {"profile": True}
+    rec = Recorder("t", cache=cache, out_dir=str(tmp_path))
+    with activate(rec):
+        health.record_metric(Metric.GRAD_NORM, float("inf"), cache=cache)
+    rec.flush()
+    events = load_events(str(tmp_path))
+    names = [e["name"] for e in events]
+    assert "grad_norm" in names and "anomaly:nonfinite" in names
+
+
+# ------------------------------------------------------- detector unit tests
+def _drive(values, metric=Metric.GRAD_NORM, site=None, cache=None):
+    """Feed a synthetic series; returns [(index, anomaly), ...]."""
+    cache = cache if cache is not None else {}
+    fired = []
+    for i, v in enumerate(values):
+        cache["telemetry_round"] = i + 1
+        for a in Watchdog(cache, NULL_RECORDER).observe(metric, v, site=site):
+            fired.append((i, a))
+    return fired, cache
+
+
+def test_nonfinite_detector_fires_once_at_seeded_index():
+    fired, _ = _drive([1.0, 1.1, float("nan"), float("nan"), float("nan")])
+    assert fired == [(2, Anomaly.NONFINITE)]
+
+
+def test_nonfinite_detector_rearms_on_recovery():
+    fired, _ = _drive([1.0, float("nan"), 1.0, float("nan")])
+    assert fired == [(1, Anomaly.NONFINITE), (3, Anomaly.NONFINITE)]
+
+
+def test_grad_explosion_fires_once_at_spike():
+    series = [1.0] * 6 + [50.0, 50.0, 1.0]
+    fired, cache = _drive(series)
+    assert fired == [(6, Anomaly.GRAD_EXPLOSION)]
+    # the EMA the detector publishes is the recordable baseline series
+    assert 0.5 < Watchdog(cache, NULL_RECORDER).ema(Anomaly.GRAD_EXPLOSION) < 2.0
+
+
+def test_divergence_outlier_fires_once_per_site_dip():
+    series = [0.9, 0.8, -0.2, -0.3, 0.5]
+    fired, _ = _drive(series, metric=Metric.SITE_COSINE, site="site_1")
+    assert fired == [(2, Anomaly.DIVERGENCE_OUTLIER)]
+
+
+def test_val_stall_fires_once_after_patience():
+    cache = {"watchdog_stall_patience": 3, "metric_direction": "maximize"}
+    series = [0.1, 0.2, 0.2, 0.2, 0.2, 0.2]
+    fired, _ = _drive(series, metric=Metric.VAL_SCORE, cache=cache)
+    assert fired == [(4, Anomaly.VAL_STALL)]
+
+
+def test_val_stall_respects_minimize_direction():
+    cache = {"watchdog_stall_patience": 2, "metric_direction": "minimize"}
+    series = [1.0, 0.9, 0.8, 0.7]  # monotone improvement: never stalls
+    fired, _ = _drive(series, metric=Metric.VAL_SCORE, cache=cache)
+    assert fired == []
+
+
+def test_compression_spike_fires_once():
+    series = [0.1] * 6 + [1.0]
+    fired, _ = _drive(series, metric=Metric.COMPRESSION_ERROR)
+    assert fired == [(6, Anomaly.COMPRESSION_SPIKE)]
+
+
+def test_rank_collapse_fires_once_below_floor():
+    series = [4.0, 3.9, 1.0, 1.0]
+    fired, _ = _drive(series, metric=Metric.EFFECTIVE_RANK)
+    assert fired == [(2, Anomaly.RANK_COLLAPSE)]
+
+
+def test_effective_rank_numerics():
+    # orthogonal columns with equal energy: effective rank = r
+    q, _ = np.linalg.qr(np.random.default_rng(0).normal(size=(64, 4)))
+    assert health.effective_rank(q) == pytest.approx(4.0, abs=1e-6)
+    # rank-1 factor: effective rank 1
+    r1 = np.outer(np.ones(64), [1.0, 0.0, 0.0, 0.0]) @ np.eye(4)
+    assert health.effective_rank(r1) == pytest.approx(1.0, abs=1e-6)
+    assert math.isnan(health.effective_rank(np.full((8, 2), np.nan)))
+
+
+# --------------------------------------------------------------- quarantine
+def test_quarantine_on_anomaly_marks_site():
+    cache = {"quarantine_on_anomaly": True}
+    Watchdog(cache, NULL_RECORDER).observe(
+        Metric.SITE_COSINE, float("nan"), site="site_2"
+    )
+    assert cache["quarantined_sites"] == ["site_2"]
+    summary = Watchdog(cache, NULL_RECORDER).summary()
+    assert summary["quarantined"] == ["site_2"]
+    assert summary["counts"] == {Anomaly.NONFINITE: 1}
+
+
+class _StubTrainer:
+    def __init__(self, cache, input, state):
+        self.cache, self.input, self.state = cache, input, state
+
+
+def test_reducer_average_excludes_quarantined_site():
+    from coinstac_dinunet_tpu.parallel.reducer import COINNReducer
+
+    cache = {"quarantined_sites": ["site_1"], "guard_nonfinite": True}
+    reducer = COINNReducer(trainer=_StubTrainer(
+        cache, {"site_0": {}, "site_1": {}}, {}
+    ))
+    leaves = [
+        [np.ones((2, 2), np.float32)],        # site_0
+        [np.full((2, 2), 9.0, np.float32)],   # site_1 (finite but quarantined)
+    ]
+    avg = reducer._average(leaves)
+    np.testing.assert_allclose(np.asarray(avg[0]), np.ones((2, 2)))
+
+
+def test_site_cosines_attributes_nonfinite_site():
+    import jax.numpy as jnp
+
+    from coinstac_dinunet_tpu.parallel.reducer import site_cosines
+
+    v = jnp.asarray([
+        [1.0, 0.0, 1.0], [1.0, 0.1, 0.9], [np.nan, 1.0, 1.0],
+    ], jnp.float32)
+    cos = np.asarray(site_cosines([v], jnp.ones(3, jnp.float32)))
+    assert np.isnan(cos[2]) and not np.isnan(cos[:2]).any()
+    assert (cos[:2] > 0.9).all()
+
+
+def test_site_cosines_leaf_accumulation_matches_flat_concat():
+    """The per-leaf dots/norms accumulation (no second full payload copy)
+    must equal the cosine over the flat concatenated vectors."""
+    import jax.numpy as jnp
+
+    from coinstac_dinunet_tpu.parallel.reducer import site_cosines
+
+    rng = np.random.default_rng(3)
+    leaves = [
+        jnp.asarray(rng.normal(size=(3, 4, 2)), jnp.float32),
+        jnp.asarray(rng.normal(size=(3, 5)), jnp.float32),
+    ]
+    w = jnp.asarray([1.0, 1.0, 0.5], jnp.float32)
+    got = np.asarray(site_cosines(leaves, w))
+    flat = np.concatenate(
+        [np.asarray(x).reshape(3, -1) for x in leaves], axis=1
+    )
+    mean = (np.asarray(w)[:, None] * flat).sum(0) / np.asarray(w).sum()
+    want = (flat @ mean) / (
+        np.linalg.norm(flat, axis=1) * np.linalg.norm(mean)
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+# -------------------------------------------------- collector summary table
+def test_summarize_surfaces_nonfinite_skip_per_site():
+    events = [
+        {"kind": "event", "name": "reduce:nonfinite_skip", "node": "remote",
+         "t0": 1.0, "sites": ["site_2"]},
+        {"kind": "event", "name": "reduce:nonfinite_skip", "node": "remote",
+         "t0": 2.0, "sites": ["site_1", "site_2"]},
+        {"kind": "metric", "name": "grad_norm", "node": "site_0", "t0": 1.0,
+         "value": 1.5},
+        {"kind": "metric", "name": "grad_norm", "node": "site_0", "t0": 2.0,
+         "value": float("nan")},
+    ]
+    s = summarize(events)
+    assert s["counters"]["site_2"]["nonfinite_skipped"] == 2
+    assert s["counters"]["site_1"]["nonfinite_skipped"] == 1
+    m = s["metrics"]["site_0"]["grad_norm"]
+    assert m["count"] == 2 and m["nonfinite"] == 1 and m["last"] == 1.5
+    from coinstac_dinunet_tpu.telemetry.collect import render_summary
+
+    text = render_summary(s)
+    assert "nonfinite_skipped=2" in text and "grad_norm=1.5" in text
+
+
+# ------------------------------------------------------ doctor golden report
+def _golden_events():
+    """Synthetic two-site trace: site_1 diverges at round 3 (one injected
+    anomaly), steady rounds otherwise."""
+    ev = []
+    for rnd in range(1, 5):
+        t = 100.0 + rnd
+        ev.append({"kind": "span", "name": "engine:round", "node": "engine",
+                   "t0": t, "dur": 0.5, "round": rnd})
+        for site, cos in (("site_0", 0.9), ("site_1", 0.8 if rnd < 3 else -0.4)):
+            ev.append({"kind": "metric", "name": "site_cosine",
+                       "node": "remote", "t0": t + 0.1, "value": cos,
+                       "site": site, "round": rnd})
+    ev.append({"kind": "event", "name": "anomaly:divergence_outlier",
+               "cat": "anomaly", "node": "remote", "t0": 103.2, "round": 3,
+               "metric": "site_cosine", "value": -0.4, "site": "site_1",
+               "detail": "site cosine -0.4000 below floor 0"})
+    return ev
+
+
+def test_doctor_golden_report_two_site_one_anomaly():
+    report = build_report(_golden_events())
+    top = report["verdicts"][0]
+    assert top["rank"] == 1 and top["severity"] == "critical"
+    assert "site_1" in top["cause"] and "diverged" in top["cause"]
+    assert report["sites"]["site_1"]["cosine_min"] == -0.4
+    assert report["sites"]["site_0"]["anomalies"] == 0
+    assert report["rounds"]["count"] == 4
+    assert len(report["anomalies"]) == 1
+    assert report["anomalies"][0]["round"] == 3
+
+    md = render_markdown(report)
+    for section in ("# Federation health postmortem", "## Verdicts (ranked)",
+                    "## Anomaly timeline", "## Per-site divergence",
+                    "## Round throughput", "## Metric series"):
+        assert section in md, section
+    assert "site_1" in md and "divergence_outlier" in md
+
+    gh = render_github(report)
+    assert gh.startswith("::error title=telemetry doctor::")
+    assert "site_1" in gh
+
+
+def test_doctor_healthy_run_reports_no_anomalies():
+    events = [{"kind": "metric", "name": "grad_norm", "node": "site_0",
+               "t0": 1.0, "value": 1.0}]
+    report = build_report(events)
+    assert report["verdicts"][0]["severity"] == "info"
+    assert "no anomalies" in report["verdicts"][0]["cause"]
+    assert "::" not in render_github(report).splitlines()[0] or True
+    # github format emits no error/warning annotations for a healthy run
+    assert "::error" not in render_github(report)
+
+
+def test_doctor_cli_writes_json_and_markdown(tmp_path, capsys):
+    from coinstac_dinunet_tpu.telemetry.__main__ import main
+
+    cache = {"profile": True}
+    rec = Recorder("remote", cache=cache, out_dir=str(tmp_path / "remote"))
+    with activate(rec):
+        health.record_metric(Metric.GRAD_NORM, float("nan"), cache=cache)
+    rec.flush()
+    md, js = tmp_path / "post.md", tmp_path / "post.json"
+    assert main(["doctor", str(tmp_path), "--markdown", str(md),
+                 "--json", str(js)]) == 0
+    out = capsys.readouterr().out
+    assert "# Federation health postmortem" in out
+    report = json.loads(js.read_text())
+    assert report["verdicts"] and md.read_text().startswith("# Federation")
+    # github annotation mode
+    assert main(["doctor", str(tmp_path), "--format", "github",
+                 "--quiet"]) == 0
+    # an empty directory is a usage error, like the collector
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["doctor", str(empty)]) == 1
+
+
+# ------------------------------------------------------------- bench history
+def test_bench_history_append_and_regression(tmp_path):
+    hist = tmp_path / "BENCH_HISTORY.jsonl"
+    script = os.path.join(REPO, "scripts", "bench_history.py")
+
+    def run(*args, inp=None):
+        return subprocess.run(
+            [sys.executable, script, *args], input=inp, text=True,
+            capture_output=True,
+        )
+
+    first = run("append", "--history", str(hist),
+                inp='# noise\n{"value": 100.0, "unit": "samples/sec/chip"}\n')
+    assert first.returncode == 0, first.stderr
+    assert "nothing to compare" in first.stdout
+    ok = run("append", "--history", str(hist), inp='{"value": 95.0}')
+    assert ok.returncode == 0 and "OK:" in ok.stdout
+    reg = run("append", "--history", str(hist), "--fail-on-regression",
+              inp='{"value": 60.0}')
+    assert reg.returncode == 1 and "REGRESSION" in reg.stdout
+    chk = run("check", "--history", str(hist))
+    assert chk.returncode == 1 and "REGRESSION" in chk.stdout
+
+    entries = load_bench_history(str(hist))
+    assert [e["value"] for e in entries] == [100.0, 95.0, 60.0]
+    # the doctor folds the regression into its verdicts
+    report = build_report([], bench_history=entries)
+    causes = [v["cause"] for v in report["verdicts"]]
+    assert any("benchmark throughput regressed" in c for c in causes)
+    assert report["bench"]["regressed"] is True
+    # within-threshold history produces no bench verdict
+    report = build_report([], bench_history=entries[:2])
+    assert report["bench"]["regressed"] is False
+
+
+# -------------------------------------------------------------- lint fixtures
+_KEYS_FIXTURE = """
+class Metric:
+    GRAD_NORM = "grad_norm"
+    VAL_SCORE = "val_score"
+
+class Anomaly:
+    NONFINITE = "nonfinite"
+"""
+
+
+def _tel_findings(source, path="pkg/fixture.py"):
+    from coinstac_dinunet_tpu.analysis.core import Module
+    from coinstac_dinunet_tpu.analysis.telemetry_names import (
+        TelemetryMetricNameRule,
+    )
+
+    rule = TelemetryMetricNameRule(
+        keys_source=textwrap.dedent(_KEYS_FIXTURE)
+    )
+    src = textwrap.dedent(source)
+    return rule.visit_module(Module(path, src, ast.parse(src)))
+
+
+def test_metric_name_rule_flags_typo_literal():
+    findings = _tel_findings("""
+        from pkg.telemetry import health
+
+        def f(cache):
+            health.record_metric("gradnorm", 1.0, cache=cache)
+    """)
+    assert len(findings) == 1
+    assert "'gradnorm'" in findings[0].message
+    assert "Metric vocabulary" in findings[0].message
+
+
+def test_metric_name_rule_accepts_vocabulary_spellings():
+    findings = _tel_findings("""
+        from pkg.keys import Anomaly, Metric
+        from pkg.telemetry import health, register_detector
+
+        def f(rec, cache, wd):
+            health.record_metric(Metric.GRAD_NORM, 1.0, cache=cache)
+            health.record_metric("val_score", 0.5)   # literal, but declared
+            rec.metric(Metric.GRAD_NORM, 2.0)
+            wd.observe(Metric.VAL_SCORE, 0.5)
+            name = compute()
+            rec.metric(name, 2.0)                    # dynamic: caller's duty
+
+        @register_detector(Anomaly.NONFINITE, metric=Metric.GRAD_NORM)
+        class D:
+            pass
+
+        @register_detector(Anomaly.NONFINITE, metric=None)
+        class E:
+            pass
+    """)
+    assert findings == []
+
+
+def test_metric_name_rule_flags_unknown_member_and_registrations():
+    findings = _tel_findings("""
+        from pkg.keys import Anomaly, Metric
+        from pkg.telemetry import register_detector
+
+        def f(rec):
+            rec.metric(Metric.BOGUS, 1.0)
+
+        @register_detector("weird_anomaly", metric=Metric.GRAD_NORM)
+        class D:
+            pass
+
+        @register_detector(Anomaly.NONFINITE, metric="not_a_metric")
+        class E:
+            pass
+    """)
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "Metric.BOGUS" in msgs
+    assert "'weird_anomaly'" in msgs
+    assert "'not_a_metric'" in msgs
+
+
+def test_metric_name_rule_ignores_unrelated_calls():
+    findings = _tel_findings("""
+        def f(metrics, df):
+            metrics.extract("f1")          # not the telemetry surface
+            df.metric("whatever")          # root not a recorder convention
+            observe("thing", 1.0)          # bare call, not a watchdog
+    """)
+    assert findings == []
+
+
+# ----------------------------------------------------------- acceptance run
+class NaNXorDataset(XorDataset):
+    """NaN inputs once the owning site reaches ``cache['nan_from_epoch']``
+    (0-based epochs) — every derived payload goes non-finite."""
+
+    def __getitem__(self, ix):
+        item = super().__getitem__(ix)
+        start = self.cache.get("nan_from_epoch")
+        if start is not None and int(self.cache.get("epoch", 0)) >= int(start):
+            item = dict(item)
+            item["inputs"] = np.full_like(item["inputs"], np.nan)
+        return item
+
+
+def test_acceptance_nan_site_metrics_anomaly_and_doctor_verdict(tmp_path):
+    """ISSUE 4 acceptance: two-site PowerSGD run, site_1 injects NaN
+    gradients from its second epoch → metric series on live rounds, a
+    site-attributed nonfinite anomaly, and the doctor naming the site."""
+    eng = InProcessEngine(
+        tmp_path, n_sites=2, trainer_cls=XorTrainer,
+        dataset_cls=NaNXorDataset, task_id="xor", data_dir="data",
+        split_ratio=[0.7, 0.15, 0.15], batch_size=8, epochs=2,
+        validation_epochs=1, learning_rate=5e-2, input_shape=(2,), seed=11,
+        patience=50, profile=True,
+        agg_engine="powerSGD", start_powerSGD_iter=0,
+        matrix_approximation_rank=2,
+        site_args={"site_1": {"nan_from_epoch": 1}},
+    )
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(24):
+            with open(os.path.join(d, f"s_{i * 24 + j}"), "w") as f:
+                f.write("x")
+    eng.run(max_rounds=600)
+    assert eng.success, f"no SUCCESS after {eng.rounds} rounds"
+
+    events = load_events(str(tmp_path))
+
+    # (a) the health series exist across the live rounds
+    by_metric = {}
+    for e in events:
+        if e.get("kind") == "metric":
+            by_metric.setdefault(e["name"], []).append(e)
+    for name in ("grad_norm", "site_cosine", "compression_error",
+                 "effective_rank", "site_dispersion", "survivors",
+                 "update_norm", "val_score"):
+        assert by_metric.get(name), f"no {name} series recorded"
+    assert len(by_metric["grad_norm"]) >= 4  # both sites, several rounds
+    assert len({e.get("round") for e in by_metric["site_cosine"]}) >= 2
+    # effective rank of a healthy rank-2 factorization stays near 2
+    finite_ranks = [e["value"] for e in by_metric["effective_rank"]
+                    if math.isfinite(e["value"])]
+    assert finite_ranks and max(finite_ranks) <= 2.0 + 1e-6
+
+    # (b) the nonfinite anomaly is attributed to site_1 with its round
+    anomalies = [e for e in events if e.get("kind") == "event"
+                 and e["name"] == "anomaly:nonfinite"]
+    attributed = [e for e in anomalies if e.get("site") == "site_1"]
+    assert attributed, f"no site-attributed nonfinite anomaly: {anomalies}"
+    assert all(e.get("round") for e in attributed)
+    # the reducer excluded the site on the corrupted rounds
+    skips = [e for e in events if e.get("kind") == "event"
+             and e["name"] == "reduce:nonfinite_skip"]
+    assert skips and all("site_1" in e["sites"] for e in skips)
+    # ... and the per-site counter surfaces in the summary
+    assert summarize(events)["counters"]["site_1"]["nonfinite_skipped"] >= 1
+
+    # (c) the doctor's TOP verdict names the site
+    report = build_report(events)
+    top = report["verdicts"][0]
+    assert top["severity"] == "critical" and "site_1" in top["cause"], top
+    assert "site_1" in render_markdown(report)
+
+    # the aggregator's watchdog kept the rollup and broadcast it federation-
+    # wide on the final round (RemoteWire.HEALTH)
+    assert eng.remote_cache.get("health", {}).get("anomalies")
+    assert eng.last_remote_out.get("health", {}).get("counts")
