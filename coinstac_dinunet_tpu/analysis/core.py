@@ -144,6 +144,7 @@ def default_rules():
         sharding,
         telemetry_names,
         trace_hazards,
+        wire_atomic,
     )
 
     return [cls() for _, cls in sorted(_REGISTRY.items())]

@@ -216,6 +216,41 @@ class Anomaly:
     RANK_COLLAPSE = "rank_collapse"
 
 
+class Retry:
+    """Retry/backoff cache-key vocabulary for the resilience layer
+    (:mod:`coinstac_dinunet_tpu.resilience.retry`).
+
+    Plain ``str`` constants, mirroring :class:`Metric`: each names the cache
+    key that configures one knob of a :class:`~..resilience.retry.RetryPolicy`.
+    Two policy families share the machinery:
+
+    - ``WIRE_*`` — retries around wire-payload loads
+      (``utils/tensorutils.py::load_arrays``): a corrupt/incomplete/absent
+      payload is retried with exponential backoff before the failure ever
+      reaches the quorum machinery.  Defaults ON (3 attempts) — a payload
+      mid-relay is the common transient.
+    - ``INVOKE_*`` — retries around whole node invocations
+      (``engine.py``): a crashed/hung invocation is re-run before the site
+      is declared dead.  Defaults OFF (1 attempt) — re-invoking a node has
+      side effects the operator must opt into.
+
+    ``ASYNC_WIRE_COMMIT`` opts a node into the background commit thread
+    (:mod:`~..resilience.transport`): outbound payload serialization +
+    fsync overlap the next compute step; the node flushes (and re-raises
+    any commit error) before its output JSON names the files.
+    """
+
+    WIRE_ATTEMPTS = "wire_retry_attempts"
+    WIRE_BASE_DELAY = "wire_retry_base_delay"
+    WIRE_MAX_DELAY = "wire_retry_max_delay"
+    WIRE_DEADLINE = "wire_retry_deadline"
+    INVOKE_ATTEMPTS = "invoke_retry_attempts"
+    INVOKE_BASE_DELAY = "invoke_retry_base_delay"
+    INVOKE_MAX_DELAY = "invoke_retry_max_delay"
+    INVOKE_DEADLINE = "invoke_retry_deadline"
+    ASYNC_WIRE_COMMIT = "async_wire_commit"
+
+
 # Keys a node reads from ``input`` that the ENGINE/compspec injects on the
 # first invocation (not part of the local↔remote handshake); the
 # protocol-conformance rule treats reads of these as engine-provided rather

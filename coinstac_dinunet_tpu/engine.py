@@ -26,6 +26,9 @@ from . import config, telemetry, utils
 from .config.keys import Key, Mode, Phase
 from .data import COINNDataHandle
 from .nodes import COINNLocal, COINNRemote
+from .resilience import transport as wire_transport
+from .resilience.chaos import ChaosSession
+from .resilience.retry import RetryExhausted, RetryPolicy
 from .trainer import COINNTrainer
 from .utils import logger
 from .utils.utils import performance_improved_, stop_training_
@@ -77,7 +80,11 @@ class InProcessEngine:
     def __init__(self, workdir, n_sites, trainer_cls=COINNTrainer,
                  dataset_cls=None, datahandle_cls=COINNDataHandle,
                  remote_trainer_cls=None, learner_cls=None, reducer_cls=None,
-                 site_args=None, inputspec=None, **args):
+                 site_args=None, inputspec=None, fault_plan=None, **args):
+        # deterministic fault injection (resilience/chaos.py): None → the
+        # no-op singleton, so the fault-free hot path costs one attribute
+        # lookup per hook point
+        self.chaos = ChaosSession.from_spec(fault_plan)
         # spec args sit BELOW explicit **args and site_args (lowest priority)
         self.site_spec = {}
         if inputspec is not None:
@@ -189,22 +196,88 @@ class InProcessEngine:
         fi = getattr(self, "first_input", None)
         return bool(fi) and any(has_quorum(v) for v in fi.values())
 
-    def _site_failure(self, s, exc):
-        """A site's invocation raised.  Without ``site_quorum`` the failure
+    def _site_failure(self, s, exc, attempts=1):
+        """A site's invocation raised (after ``attempts`` tries under the
+        invoke retry policy).  Without ``site_quorum`` the failure
         propagates (reference-faithful all-site lockstep); with it, the site
         is marked dead and excluded from all subsequent rounds — the REMOTE
         enforces the actual quorum policy and the documented survivor-
-        weighted semantics (``COINNRemote._check_quorum``)."""
+        weighted semantics (``COINNRemote._check_quorum``).  The
+        ``site_died`` event carries the attempt count so ``telemetry
+        doctor`` can attribute the death to *exhausted retries* vs a *hard
+        failure* with no retry configured."""
         if not self._quorum_configured():
             raise exc
         self.dead_sites.add(s)
         self.site_failures[s] = f"{type(exc).__name__}: {exc}"
+        # exact attribution: RetryExhausted means the retry budget (attempt
+        # count OR deadline) ran out — `attempts > 1` alone would misread a
+        # deadline exhausted during attempt 1 as "no retry configured"
         self._recorder().event(
             "site_died", cat="quorum", site=s, error=self.site_failures[s],
+            attempts=int(getattr(exc, "attempts", attempts)),
+            retries_exhausted=isinstance(exc, RetryExhausted),
         )
         logger.warn(
-            f"site {s} died mid-run ({self.site_failures[s]}); "
-            "excluded from the remaining rounds (site_quorum set)"
+            f"site {s} died mid-run ({self.site_failures[s]}) after "
+            f"{attempts} invocation attempt(s); excluded from the remaining "
+            "rounds (site_quorum set)"
+        )
+
+    # ---------------------------------------------------------- invoke retry
+    def _invoke_policy(self, target):
+        """The invocation retry policy for ONE target, resolved over that
+        target's own arg channels so a retry opt-in scoped to one site via
+        ``site_args``/``inputspec`` never silently applies to another
+        (re-invoking a node has side effects the operator opts into
+        per-site).  Site priority mirrors node construction: ``site_args``
+        > engine ``**args`` > ``site_spec``, then the round-tripped cache
+        and the fresh-process ``first_input``.  The remote scans every
+        channel (mirroring ``_quorum_configured``) because its config can
+        only arrive via a site's ``first_input`` before round 1 freezes
+        ``shared_args`` into its cache.  Nested ``*_args`` tiers count.
+        Default is 1 attempt (retry off)."""
+        if target == "remote":
+            chans = [self.args, self.remote_cache,
+                     *self.site_args.values(), *self.site_spec.values(),
+                     *self.site_caches.values()]
+            chans += list(getattr(self, "first_input", {}).values() or [])
+        else:
+            fi = getattr(self, "first_input", {}) or {}
+            chans = [self.site_args.get(target, {}), self.args,
+                     self.site_spec.get(target, {}),
+                     self.site_caches.get(target, {}), fi.get(target, {})]
+        cfg = {}
+        for chan in chans:
+            if not isinstance(chan, dict):
+                continue
+            for k, v in chan.items():
+                if isinstance(v, dict) and str(k).endswith("_args"):
+                    for k2, v2 in v.items():
+                        cfg.setdefault(k2, v2)
+                else:
+                    cfg.setdefault(k, v)
+        return RetryPolicy.for_invoke(cfg)
+
+    def _invoke_with_retry(self, policy, attempt_fn, target, rec):
+        """Run one node invocation under the retry policy: every retry first
+        heals chaos-damaged payloads (the deterministic 'relay completed'
+        moment for out-of-process readers) and lands an ``invoke:retry``
+        event on the engine lane."""
+
+        def on_retry(exc, attempt, delay):
+            # only heal damage blocking THIS node's reads — a retry of one
+            # node must not cancel faults aimed at another
+            healed = self.chaos.heal_for_retry(rec, target=target)
+            rec.event(
+                "invoke:retry", cat="invoke", target=str(target),
+                attempt=attempt, delay=round(delay, 4), healed=healed,
+                error=f"{type(exc).__name__}: {exc}"[:300],
+            )
+
+        return policy.run(
+            attempt_fn, retryable=(Exception,),
+            describe=f"invoke {target}", on_retry=on_retry,
         )
 
     def site_data_dir(self, site_id, data_dir="data"):
@@ -213,60 +286,95 @@ class InProcessEngine:
         return d
 
     # ------------------------------------------------------------- one round
+    def _relay_broadcast(self, rnd, rec):
+        """Relay aggregator transfer files into every surviving site's inbox
+        — atomically (a reader can never observe a partial copy), with the
+        chaos relay faults (drop/duplicate) applied per destination."""
+        xfer = self.remote_state["transferDirectory"]
+        for f in os.listdir(xfer):
+            src = os.path.join(xfer, f)
+            for s in self._alive_site_ids():
+                dst = os.path.join(self.site_states[s]["baseDirectory"], f)
+                fault = self.chaos.relay_fault(rnd, f, s, rec)
+                if fault is not None and fault.kind == "drop_relay":
+                    # the file never arrives this round; the repair (a retry
+                    # heal performs the copy) models the relay completing
+                    self.chaos.register_dropped_relay(src, dst, fault,
+                                                      reader=s)
+                    continue
+                if fault is not None and fault.kind == "duplicate_delivery":
+                    # a stale out-of-order duplicate clobbers the fresh copy
+                    self.chaos.deliver_duplicate(src, dst, fault, s, rec)
+                    continue
+                wire_transport.atomic_copy(src, dst)
+
     def step_round(self):
         """One full engine round: every site computes, files relay to the
         aggregator, the aggregator computes, its output + files relay back."""
         rec = self._recorder()
-        rec.set_context(round=self.rounds + 1)
+        rnd = self.rounds + 1
+        rec.set_context(round=rnd)
         site_outs = {}
-        with rec.span("engine:round", cat="engine"):
+        with self.chaos.activate(rec), rec.span("engine:round", cat="engine"):
             for s in self._alive_site_ids():
-                node = COINNLocal(
-                    cache=self.site_caches[s],
-                    input=self.site_inputs[s],
-                    state=self.site_states[s],
-                    **{**self.site_spec.get(s, {}), **self.args,
-                       **self.site_args.get(s, {})},
-                )
-                try:
+                policy = self._invoke_policy(s)
+
+                def attempt(s=s):
+                    self.chaos.invoke_fault(rnd, s, rec)
+                    node = COINNLocal(
+                        cache=self.site_caches[s],
+                        input=self.site_inputs[s],
+                        state=self.site_states[s],
+                        **{**self.site_spec.get(s, {}), **self.args,
+                           **self.site_args.get(s, {})},
+                    )
                     with rec.span(f"invoke:{s}", cat="invoke"):
-                        result = node(
+                        return node(
                             trainer_cls=self.trainer_cls,
                             dataset_cls=self.dataset_cls,
                             datahandle_cls=self.datahandle_cls,
                             learner_cls=self.learner_cls,
                         )
+
+                try:
+                    result = self._invoke_with_retry(policy, attempt, s, rec)
                 except Exception as exc:  # noqa: BLE001 — see _site_failure
-                    self._site_failure(s, exc)
+                    self._site_failure(s, exc, attempts=policy.last_attempts)
                     continue
                 site_outs[s] = result["output"]
+                # chaos payload damage happens AFTER the site committed its
+                # outbound files — exactly where a truncated relay would
+                self.chaos.payload_faults(
+                    rnd, s, self.site_states[s]["transferDirectory"], rec
+                )
 
             if not site_outs:
                 raise RuntimeError(
                     "every site died; nothing to aggregate — failures: "
                     f"{self.site_failures}"
                 )
-            remote = COINNRemote(
-                cache=self.remote_cache, input=site_outs, state=self.remote_state
-            )
-            with rec.span("invoke:remote", cat="invoke"):
-                result = remote(
-                    trainer_cls=self.remote_trainer_cls,
-                    reducer_cls=self.reducer_cls,
+
+            def remote_attempt():
+                self.chaos.invoke_fault(rnd, "remote", rec)
+                remote = COINNRemote(
+                    cache=self.remote_cache, input=site_outs,
+                    state=self.remote_state,
                 )
+                with rec.span("invoke:remote", cat="invoke"):
+                    return remote(
+                        trainer_cls=self.remote_trainer_cls,
+                        reducer_cls=self.reducer_cls,
+                    )
+
+            result = self._invoke_with_retry(
+                self._invoke_policy("remote"), remote_attempt, "remote", rec,
+            )
             remote_out = result["output"]
             self.success = bool(result.get("success"))
             self.last_remote_out = remote_out
 
-            # relay aggregator transfer files into every surviving site's inbox
             with rec.span("engine:relay", cat="relay"):
-                xfer = self.remote_state["transferDirectory"]
-                for f in os.listdir(xfer):
-                    for s in self._alive_site_ids():
-                        shutil.copy(
-                            os.path.join(xfer, f),
-                            os.path.join(self.site_states[s]["baseDirectory"], f),
-                        )
+                self._relay_broadcast(rnd, rec)
         rec.flush()
         self.site_inputs = {s: dict(remote_out) for s in self._alive_site_ids()}
         self.rounds += 1
@@ -356,49 +464,65 @@ class SubprocessEngine(InProcessEngine):
 
     def step_round(self):
         rec = self._recorder()
-        rec.set_context(round=self.rounds + 1)
+        rnd = self.rounds + 1
+        rec.set_context(round=rnd)
         site_outs = {}
-        with rec.span("engine:round", cat="engine"):
+        with self.chaos.activate(rec), rec.span("engine:round", cat="engine"):
             for s in self._alive_site_ids():
+                policy = self._invoke_policy(s)
                 inp = dict(self.site_inputs[s])
                 if s not in self._first_done:
                     inp.update(self.first_input.get(s, {}))
                     self._first_done.add(s)
-                try:
+
+                def attempt(s=s, inp=inp):
+                    # a hung process produces no output until the timeout
+                    # kills it — the chaos hang raises in its place
+                    self.chaos.invoke_fault(rnd, s, rec)
                     with rec.span(f"invoke:{s}", cat="invoke"):
-                        res = self._invoke(self.local_script, {
+                        return self._invoke(self.local_script, {
                             "cache": self.site_caches[s], "input": inp,
                             "state": self.site_states[s],
                         })
+
+                try:
+                    res = self._invoke_with_retry(policy, attempt, s, rec)
                 except Exception as exc:  # noqa: BLE001 — see _site_failure
-                    self._site_failure(s, exc)
+                    self._site_failure(s, exc, attempts=policy.last_attempts)
                     continue
                 self.site_caches[s] = res.get("cache", {})
                 site_outs[s] = res["output"]
+                self.chaos.payload_faults(
+                    rnd, s, self.site_states[s]["transferDirectory"], rec
+                )
 
             if not site_outs:
                 raise RuntimeError(
                     "every site died; nothing to aggregate — failures: "
                     f"{self.site_failures}"
                 )
-            with rec.span("invoke:remote", cat="invoke"):
-                res = self._invoke(self.remote_script, {
-                    "cache": self.remote_cache, "input": site_outs,
-                    "state": self.remote_state,
-                })
+
+            def remote_attempt():
+                # fresh-process nodes load payloads OUTSIDE this process, so
+                # a corrupt payload fails the whole invocation: the retry
+                # (which first heals pending chaos damage) is the recovery
+                self.chaos.invoke_fault(rnd, "remote", rec)
+                with rec.span("invoke:remote", cat="invoke"):
+                    return self._invoke(self.remote_script, {
+                        "cache": self.remote_cache, "input": site_outs,
+                        "state": self.remote_state,
+                    })
+
+            res = self._invoke_with_retry(
+                self._invoke_policy("remote"), remote_attempt, "remote", rec,
+            )
             self.remote_cache = res.get("cache", {})
             remote_out = res["output"]
             self.success = bool(res.get("success"))
             self.last_remote_out = remote_out
 
             with rec.span("engine:relay", cat="relay"):
-                xfer = self.remote_state["transferDirectory"]
-                for f in os.listdir(xfer):
-                    for s in self._alive_site_ids():
-                        shutil.copy(
-                            os.path.join(xfer, f),
-                            os.path.join(self.site_states[s]["baseDirectory"], f),
-                        )
+                self._relay_broadcast(rnd, rec)
         rec.flush()
         self.site_inputs = {s: dict(remote_out) for s in self._alive_site_ids()}
         self.rounds += 1

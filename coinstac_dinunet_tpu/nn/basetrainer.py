@@ -72,6 +72,9 @@ _VOLATILE_CACHE_KEYS = frozenset((
     # every round and the quarantine roster grows — all host-side, never
     # traced (telemetry/watchdog.py)
     "health", "quarantined_sites",
+    # wire retry pressure counters (resilience/retry.py) mutate per load —
+    # host-side bookkeeping, never trace-relevant
+    "wire_retry_stats",
     # Key.* bookkeeping the nodes append per round/fold (metrics rollups,
     # serialized score blobs, one-shot flags) — all host-side, never traced
     Key.TEST_METRICS.value, Key.TRAIN_SERIALIZABLE.value,

@@ -22,6 +22,7 @@ from .. import config, telemetry, utils
 from ..config.keys import AggEngine, Key, LocalWire, Mode, Phase, RemoteWire
 from ..data import COINNDataHandle
 from ..parallel import COINNLearner, DADLearner, PowerSGDLearner
+from ..resilience import transport as wire_transport
 from ..utils import logger
 
 # engine/epoch state cleared on every fold transition
@@ -489,6 +490,9 @@ class COINNLocal:
         if trainer.train_state is not None:
             self.cache["_train_state"] = trainer.train_state
         self._persist_round_state(trainer)
+        # async wire commits (cache['async_wire_commit']) must land — or
+        # fail THIS invocation loudly — before the output JSON names them
+        wire_transport.flush_async()
         return self.out
 
     def __call__(self, *a, **kw):
@@ -528,4 +532,10 @@ class COINNLocal:
                 f"partial out: {self.out}"
             )
         finally:
+            # a failed invocation drains its own pending async commits (and
+            # their errors) so they can never be misattributed to the NEXT
+            # node this process serves; the success path already flushed
+            # loudly at the end of compute()
+            for exc in wire_transport.flush_async(raise_errors=False):
+                logger.warn(f"async wire commit failed: {exc}")
             rec.flush()

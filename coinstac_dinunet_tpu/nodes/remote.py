@@ -24,6 +24,7 @@ from .. import config, telemetry, utils
 from ..config.keys import AggEngine, GatherMode, Key, LocalWire, Mode, Phase, RemoteWire
 from ..data import EmptyDataHandle
 from ..parallel import COINNReducer, DADReducer, PowerSGDReducer
+from ..resilience import transport as wire_transport
 from ..utils import logger
 from ..utils.logger import lazy_debug
 from ..utils.utils import performance_improved_, stop_training_
@@ -307,7 +308,8 @@ class COINNRemote:
                 )
                 if os.path.exists(src):
                     out[RemoteWire.PRETRAINED_WEIGHTS.value] = f"pretrained_{config.weights_file}"
-                    shutil.copy(
+                    # atomic: no site can ever observe a half-copied broadcast
+                    wire_transport.atomic_copy(
                         src,
                         os.path.join(
                             self.state.get("transferDirectory", "."),
@@ -399,6 +401,9 @@ class COINNRemote:
                 fed["sites"] = per_site
             if fed:
                 self.out[RemoteWire.HEALTH.value] = fed
+        # async wire commits must land — or fail loudly — before the output
+        # JSON naming the committed broadcast files leaves this node
+        wire_transport.flush_async()
         return self.out
 
     def __call__(self, *a, **kw):
@@ -427,4 +432,8 @@ class COINNRemote:
                 f"partial out: {self.out}"
             )
         finally:
+            # drain (never re-raise) pending async commits on failure so one
+            # invocation's commit errors cannot leak into the next node
+            for exc in wire_transport.flush_async(raise_errors=False):
+                logger.warn(f"async wire commit failed: {exc}")
             rec.flush()

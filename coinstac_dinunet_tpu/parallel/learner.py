@@ -19,6 +19,7 @@ import numpy as np
 
 from .. import config
 from ..config.keys import Key, Mode
+from ..resilience.retry import RetryPolicy
 from ..utils import tensorutils
 
 
@@ -56,6 +57,15 @@ class COINNLearner:
     def _base_path(self, fname):
         return os.path.join(self.state.get("baseDirectory", "."), fname)
 
+    def _load_wire(self, path):
+        """Inbound payload load under the site's wire retry policy
+        (``Retry.WIRE_*`` cache keys): an absent/incomplete/corrupt payload
+        — usually a broadcast still mid-relay — is retried with backoff
+        before it can surface as a site failure."""
+        return tensorutils.load_arrays(
+            path, retry=RetryPolicy.for_wire(self.cache)
+        )
+
     # ------------------------------------------------------------- site steps
     def step(self):
         """Apply the averaged gradients broadcast by the aggregator, then one
@@ -63,7 +73,7 @@ class COINNLearner:
         ``apply_grads`` and across ALL models)."""
         out = {}
         fname = self.input.get("avg_grads_file", config.avg_grads_file)
-        flat = tensorutils.load_arrays(self._base_path(fname))
+        flat = self._load_wire(self._base_path(fname))
         ts = self.trainer.train_state
         grads = tensorutils.grads_like(ts.params, flat)
         self.trainer.train_state = self.trainer.apply_grads(ts, grads)

@@ -253,7 +253,7 @@ class PowerSGDLearner(COINNLearner):
         """Averaged P arrived: orthogonalize, compute Q, ship Q + rank-1."""
         out = {}
         st = self.psgd
-        avg_P = tensorutils.load_arrays(
+        avg_P = self._load_wire(
             self._base_path(self.input["powerSGD_P_file"])
         )
         Qs, Phats = _compute_Q(st.Ms, [jnp.asarray(P, jnp.float32) for P in avg_P])
@@ -277,9 +277,9 @@ class PowerSGDLearner(COINNLearner):
         out = {}
         avg_Q = [
             jnp.asarray(q, jnp.float32)
-            for q in tensorutils.load_arrays(self._base_path(self.input["powerSGD_Q_file"]))
+            for q in self._load_wire(self._base_path(self.input["powerSGD_Q_file"]))
         ]
-        avg_rank1 = tensorutils.load_arrays(self._base_path(self.input["rank1_file"]))
+        avg_rank1 = self._load_wire(self._base_path(self.input["rank1_file"]))
         recon, errors = _reconstruct(st.Ms, st.Phats, avg_Q)
         rec = _telemetry()
         if rec.enabled:

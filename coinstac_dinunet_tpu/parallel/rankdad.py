@@ -336,8 +336,8 @@ class DADLearner(COINNLearner):
         (≙ ref ``synced_param_update``, ``spi.py:190-210``)."""
         out = {}
         st = self.dad
-        data = tensorutils.load_arrays(self._base_path(self.input["dad_data_file"]))
-        rest = tensorutils.load_arrays(self._base_path(self.input["dad_rest_file"]))
+        data = self._load_wire(self._base_path(self.input["dad_data_file"]))
+        rest = self._load_wire(self._base_path(self.input["dad_rest_file"]))
         ts = self.trainer.train_state
         leaves = jax.tree_util.tree_leaves(ts.params)
         flat = [None] * len(leaves)

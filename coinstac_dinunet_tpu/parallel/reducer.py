@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import config
+from ..resilience.retry import RetryPolicy
 from ..telemetry import get_active as _telemetry
 from ..telemetry import health as _health
 from ..utils import logger, tensorutils
@@ -114,12 +115,17 @@ class COINNReducer:
 
     def _load(self, file_key):
         """Concurrently load one payload per site; returns list-of-lists
-        (site → leaves), site order fixed by sorted site id."""
+        (site → leaves), site order fixed by sorted site id.  Loads run
+        under the wire retry policy (``Retry.WIRE_*`` cache keys): a
+        truncated/corrupt/still-relaying site payload is retried with
+        backoff before the failure can reach the quorum machinery."""
         sites = sorted(self.input.keys())
         paths = [
             self._site_path(site, self.input[site][file_key]) for site in sites
         ]
-        return tensorutils.load_arrays_many(paths)
+        return tensorutils.load_arrays_many(
+            paths, retry=RetryPolicy.for_wire(self.cache)
+        )
 
     def _save_out(self, fname, arrays):
         """Outbound (aggregator → sites) payloads honor the wire precision

@@ -1,0 +1,40 @@
+"""Resilience layer: integrity-checked atomic wire transport, retry/backoff
+policies, and the deterministic chaos/fault-injection harness.
+
+The paper's deployment relays tensors between nodes as bare files dropped in
+``transferDirectory`` by an external engine — so partial writes, truncated
+relays, hung sites and transient crashes are *normal* operating conditions,
+not exceptional ones.  This package makes every one of them a typed,
+retryable, observable event:
+
+- :mod:`.transport` — atomic commit (tmp + fsync + rename), CRC32-checksummed
+  payload format, per-directory commit manifests, typed
+  :class:`~.transport.WireCorruption`/:class:`~.transport.WireIncomplete`
+  errors, opt-in background commit thread.
+- :mod:`.retry` — :class:`~.retry.RetryPolicy` (deadline, exponential
+  backoff + deterministic jitter) applied to wire-payload loads and to
+  engine node invocations, configured by the
+  :class:`~..config.keys.Retry` cache-key vocabulary.
+- :mod:`.chaos` — JSON fault plans pinning site crashes, hangs, payload
+  truncation/corruption, dropped/duplicated relays to exact rounds+sites,
+  so every recovery path runs in CI (``scripts/telemetry_smoke.py
+  --fault-plan``).
+
+See docs/RESILIENCE.md for the operator guide.
+"""
+from .chaos import (  # noqa: F401
+    NULL_CHAOS,
+    ChaosCrash,
+    ChaosFault,
+    ChaosHang,
+    ChaosSession,
+    load_fault_plan,
+)
+from .retry import RetryExhausted, RetryPolicy  # noqa: F401
+from .transport import (  # noqa: F401
+    WireCorruption,
+    WireError,
+    WireIncomplete,
+    atomic_copy,
+    flush_async,
+)

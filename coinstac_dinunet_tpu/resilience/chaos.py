@@ -1,0 +1,387 @@
+"""Deterministic chaos/fault-injection harness for federated runs.
+
+Every recovery path the resilience layer promises — wire retry, corruption
+recovery, invocation retry, quorum dropout after retry exhaustion — is only
+trustworthy if CI can exercise it on demand.  This module injects the
+failure modes of the paper's file-relay deployment *deterministically*: a
+JSON **fault plan** pins each fault to an engine round (1-based) + site, so
+a chaos run is reproducible bit-for-bit and comparable against a golden run.
+
+Fault plan schema (a dict, or a path to a JSON file)::
+
+    {"faults": [
+        {"kind": "crash",    "round": 3, "site": "site_2"},
+        {"kind": "hang",     "round": 3, "site": "site_1"},
+        {"kind": "slow",     "round": 2, "site": "site_0", "seconds": 0.5},
+        {"kind": "truncate_payload",  "round": 2, "site": "site_0",
+         "file": "grads.npy"},
+        {"kind": "corrupt_payload",   "round": 2, "site": "site_1",
+         "file": "grads.npy"},
+        {"kind": "drop_relay",        "round": 4, "site": "site_0",
+         "file": "avg_grads.npy"},
+        {"kind": "duplicate_delivery","round": 4, "site": "site_1",
+         "file": "avg_grads.npy"}
+    ]}
+
+Optional per-fault keys: ``times`` (how many firings before the fault heals;
+default 1 for payload/relay faults, *permanent* for crash/hang — a hung
+process stays hung) and ``heal_after`` (failed load attempts before a
+damaged payload is repaired, default 1 — models the relay completing).
+
+``drop_relay`` on a destination that still holds the previous round's
+payload, and ``duplicate_delivery`` (the previous round's payload arriving
+*after* the fresh one and clobbering it), both leave an intact,
+self-validating stale payload — the cases only the manifest CRC
+cross-check in ``unpack_arrays`` can catch.
+
+Hook points: the engines call :meth:`ChaosSession.invoke_fault` before every
+node invocation (crash/hang raise a :class:`ChaosFault`; the retry policy
+sees an ordinary failure), :meth:`ChaosSession.payload_faults` after a site
+commits its outbound payloads, and :meth:`ChaosSession.relay_fault` per
+relayed file; the transport's load-failure hook lets a damaged payload heal
+between retry attempts so "recovered via retry" is a real, CI-exercisable
+path.  Every firing emits a ``chaos:inject`` event (and heals emit
+``chaos:heal``) on the engine's telemetry lane, which ``telemetry doctor``
+folds into the postmortem.
+"""
+import contextlib
+import json
+import os
+
+from . import transport
+
+#: every fault kind the harness understands
+FAULT_KINDS = (
+    "crash", "hang", "slow",
+    "truncate_payload", "corrupt_payload",
+    "drop_relay", "duplicate_delivery",
+)
+_INVOKE_KINDS = ("crash", "hang", "slow")
+_PAYLOAD_KINDS = ("truncate_payload", "corrupt_payload")
+_RELAY_KINDS = ("drop_relay", "duplicate_delivery")
+#: bytes XOR-flipped at the payload tail by corrupt_payload (data section —
+#: past any header/manifest bytes, so the CRC check is what catches it)
+_CORRUPT_TAIL = 8
+
+
+class ChaosFault(RuntimeError):
+    """An injected invocation fault (simulated crash/hang)."""
+
+    kind = "chaos"
+
+
+class ChaosCrash(ChaosFault):
+    kind = "crash"
+
+
+class ChaosHang(ChaosFault):
+    """A hung site: the invocation never returns and the engine's timeout
+    kills it — simulated by raising instead of invoking, which is exactly
+    the observable behavior (no output, no cache advance)."""
+
+    kind = "hang"
+
+
+class Fault:
+    """One pinned fault from the plan."""
+
+    __slots__ = ("kind", "round", "site", "file", "times", "seconds",
+                 "heal_after", "fired")
+
+    def __init__(self, spec, index):
+        if not isinstance(spec, dict):
+            raise ValueError(f"fault[{index}]: expected an object, got {spec!r}")
+        self.kind = spec.get("kind")
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"fault[{index}]: unknown kind {self.kind!r} "
+                f"(known: {', '.join(FAULT_KINDS)})"
+            )
+        if spec.get("round") is None:
+            raise ValueError(
+                f"fault[{index}] ({self.kind}): 'round' is required — every "
+                "fault is pinned to a 1-based engine round for determinism"
+            )
+        self.round = int(spec["round"])
+        self.site = str(spec["site"]) if spec.get("site") is not None else None
+        self.file = str(spec["file"]) if spec.get("file") is not None else None
+        if self.site is None and self.kind in _INVOKE_KINDS + _PAYLOAD_KINDS:
+            raise ValueError(
+                f"fault[{index}] ({self.kind}): 'site' is required"
+            )
+        if self.file is None and self.kind in _PAYLOAD_KINDS + _RELAY_KINDS:
+            raise ValueError(
+                f"fault[{index}] ({self.kind}): 'file' is required"
+            )
+        # crash/hang default to PERMANENT (a dead process stays dead, so the
+        # invocation retries exhaust); everything else fires once
+        default_times = None if self.kind in ("crash", "hang") else 1
+        self.times = (
+            int(spec["times"]) if spec.get("times") is not None
+            else default_times
+        )
+        self.seconds = float(spec.get("seconds", 0.25))
+        self.heal_after = int(spec.get("heal_after", 1))
+        self.fired = 0
+
+    def matches(self, rnd, site=None):
+        if self.round != int(rnd):
+            return False
+        return self.site is None or site is None or self.site == str(site)
+
+    def can_fire(self):
+        return self.times is None or self.fired < self.times
+
+    def describe(self):
+        where = f"round {self.round}"
+        if self.site:
+            where += f"/{self.site}"
+        if self.file:
+            where += f" ({self.file})"
+        return f"{self.kind} @ {where}"
+
+
+def load_fault_plan(spec):
+    """Fault plan (dict or JSON file path) → validated list of faults."""
+    if isinstance(spec, (str, os.PathLike)):
+        with open(spec, "r", encoding="utf-8") as f:
+            spec = json.load(f)
+    if not isinstance(spec, dict) or not isinstance(spec.get("faults"), list):
+        raise ValueError(
+            "fault plan must be an object with a 'faults' list "
+            "(see docs/RESILIENCE.md)"
+        )
+    return [Fault(s, i) for i, s in enumerate(spec["faults"])]
+
+
+class _NullChaos:
+    """Disabled-mode fast path: every hook is a constant-return no-op (the
+    no-fault-plan overhead bound in tests/test_resilience.py)."""
+
+    __slots__ = ()
+    enabled = False
+
+    def __bool__(self):
+        return False
+
+    def invoke_fault(self, rnd, site, rec):
+        return None
+
+    def payload_faults(self, rnd, site, dirpath, rec):
+        return ()
+
+    def relay_fault(self, rnd, fname, site, rec):
+        return None
+
+    def heal_for_retry(self, rec=None, target=None):
+        return 0
+
+    @contextlib.contextmanager
+    def activate(self, rec):
+        yield self
+
+
+NULL_CHAOS = _NullChaos()
+
+
+class ChaosSession:
+    """One engine run's fault injector (holds firing counts + repairs)."""
+
+    enabled = True
+
+    def __init__(self, plan):
+        self.faults = load_fault_plan(plan)
+        # abs payload path -> [repair_fn, fault, failed_attempts, reader]:
+        # pending damage a retry can heal (the deterministic stand-in for
+        # "the relay completed").  ``reader`` is the node that consumes the
+        # damaged path, so an invocation retry only heals damage blocking
+        # ITS OWN reads — co-scheduled faults must not cancel each other.
+        self._repairs = {}
+        self._rec = None
+
+    @classmethod
+    def from_spec(cls, spec):
+        """``None`` → the no-op singleton; anything else → a live session."""
+        if spec is None:
+            return NULL_CHAOS
+        if isinstance(spec, (ChaosSession, _NullChaos)):
+            return spec
+        return cls(spec)
+
+    # ------------------------------------------------------------ fault query
+    def _fire(self, fault, rec, **attrs):
+        fault.fired += 1
+        if rec is not None:
+            # NB: the injected fault kind rides as ``fault`` — ``kind`` is
+            # the telemetry record-schema discriminator
+            rec.event(
+                "chaos:inject", cat="chaos", fault=fault.kind,
+                fault_round=fault.round,
+                **({"site": fault.site} if fault.site else {}),
+                **({"file": fault.file} if fault.file else {}),
+                **attrs,
+            )
+
+    def invoke_fault(self, rnd, site, rec):
+        """Called before every node invocation attempt; raises for crash/
+        hang (each ATTEMPT at the pinned round fires — a transient fault
+        heals after ``times`` attempts, a permanent one exhausts the retry
+        budget), sleeps for slow."""
+        for fault in self.faults:
+            if fault.kind not in _INVOKE_KINDS:
+                continue
+            if not (fault.matches(rnd, site) and fault.can_fire()):
+                continue
+            self._fire(fault, rec, attempt=fault.fired + 1)
+            if fault.kind == "slow":
+                import time
+
+                time.sleep(fault.seconds)
+                continue
+            exc_cls = ChaosCrash if fault.kind == "crash" else ChaosHang
+            raise exc_cls(
+                f"injected {fault.kind} ({fault.describe()}, "
+                f"firing {fault.fired})"
+            )
+        return None
+
+    # ---------------------------------------------------------- payload damage
+    def payload_faults(self, rnd, site, dirpath, rec):
+        """Damage committed payloads in ``dirpath`` (a site's transfer
+        directory) per the plan; returns the faults that fired.  The
+        original bytes are stashed so a later heal restores them exactly —
+        a recovered payload is bit-identical to the committed one."""
+        fired = []
+        for fault in self.faults:
+            if fault.kind not in _PAYLOAD_KINDS + ("drop_relay",):
+                continue
+            if not (fault.matches(rnd, site) and fault.can_fire()):
+                continue
+            path = os.path.join(dirpath, fault.file)
+            if not os.path.exists(path):
+                continue
+            with open(path, "rb") as f:
+                original = f.read()
+            if fault.kind == "truncate_payload":
+                damaged = original[: max(len(original) * 3 // 5, 1)]
+            elif fault.kind == "corrupt_payload":
+                tail = bytes(b ^ 0xFF for b in original[-_CORRUPT_TAIL:])
+                damaged = original[:-_CORRUPT_TAIL] + tail
+            else:  # drop_relay on a site payload: vanish it entirely
+                damaged = None
+            if damaged is None:
+                os.unlink(path)
+            else:
+                with open(path, "wb") as f:  # dinulint: disable=wire-atomic-commit
+                    f.write(damaged)
+            # a site's outbound payloads are read by the aggregator
+            self._register_repair(path, original, fault, reader="remote")
+            self._fire(fault, rec, bytes=len(original))
+            fired.append(fault)
+        return fired
+
+    def _register_repair(self, path, original, fault, reader=None):
+        def repair(path=path, original=original):
+            with open(path, "wb") as f:  # dinulint: disable=wire-atomic-commit
+                f.write(original)
+
+        self._repairs[os.path.abspath(path)] = [repair, fault, 0, reader]
+
+    # ----------------------------------------------------------------- relay
+    def relay_fault(self, rnd, fname, site, rec):
+        """Engine relay hook (aggregator → site copies): returns the
+        matching drop/duplicate fault (the engine acts on it) or None."""
+        for fault in self.faults:
+            if fault.kind not in _RELAY_KINDS:
+                continue
+            if fault.file != fname:
+                continue
+            if not (fault.matches(rnd, site) and fault.can_fire()):
+                continue
+            self._fire(fault, rec, target=str(site))
+            return fault
+        return None
+
+    def deliver_duplicate(self, src, dst, fault, reader, rec):
+        """The risky duplicate: the PREVIOUS round's payload (already at
+        ``dst`` before this relay) arrives again *after* the fresh delivery
+        and clobbers it — the out-of-order stale copy the manifest CRC
+        cross-check exists to catch.  The repair restores the fresh
+        delivery.  First-ever delivery of a file (nothing stale to
+        duplicate) degrades to a harmless double copy."""
+        stale = None
+        if os.path.exists(dst):
+            with open(dst, "rb") as f:
+                stale = f.read()
+        transport.atomic_copy(src, dst)  # the fresh delivery lands first
+        if stale is None:
+            transport.atomic_copy(src, dst)
+            return
+        with open(dst, "wb") as f:  # dinulint: disable=wire-atomic-commit
+            f.write(stale)  # the late duplicate overwrites it
+
+        def repair(src=src, dst=dst):
+            transport.atomic_copy(src, dst)
+
+        self._repairs[os.path.abspath(dst)] = [repair, fault, 0, reader]
+
+    def register_dropped_relay(self, src, dst, fault, reader=None):
+        """A dropped relay's repair is performing the copy; ``reader`` is
+        the destination site consuming ``dst``."""
+
+        def repair(src=src, dst=dst):
+            transport.atomic_copy(src, dst)
+
+        self._repairs[os.path.abspath(dst)] = [repair, fault, 0, reader]
+
+    # ------------------------------------------------------------------ heal
+    def _heal(self, key, rec):
+        repair, fault, _, _ = self._repairs.pop(key)
+        repair()
+        if rec is not None:
+            rec.event(
+                "chaos:heal", cat="chaos", fault=fault.kind,
+                file=os.path.basename(key),
+            )
+
+    def on_load_failure(self, path, attempt, exc):
+        """Transport load-failure hook (in-process readers): repair the
+        damaged payload once ``heal_after`` failed attempts accumulated —
+        the deterministic 'relay completed' moment."""
+        key = os.path.abspath(str(path))
+        entry = self._repairs.get(key)
+        if entry is None:
+            return False
+        entry[2] += 1
+        if entry[2] < entry[1].heal_after:
+            return False
+        self._heal(key, self._rec)
+        return True
+
+    def heal_for_retry(self, rec=None, target=None):
+        """Repair pending damage blocking ``target``'s reads (engine-side,
+        between invocation retry attempts — the heal path for fresh-process
+        nodes whose loads run outside this process).  ``target=None`` heals
+        everything; damage registered for a DIFFERENT reader is left in
+        place, so retrying one node never cancels a fault aimed at another.
+        Returns how many payloads were repaired."""
+        keys = [
+            k for k, entry in self._repairs.items()
+            if target is None or entry[3] is None or entry[3] == str(target)
+        ]
+        for key in keys:
+            self._heal(key, rec if rec is not None else self._rec)
+        return len(keys)
+
+    # -------------------------------------------------------------- lifetime
+    @contextlib.contextmanager
+    def activate(self, rec):
+        """Scope the session over an engine round: registers the transport
+        load-failure hook (in-process heal) and pins the telemetry lane."""
+        self._rec = rec
+        transport.add_load_failure_hook(self.on_load_failure)
+        try:
+            yield self
+        finally:
+            transport.remove_load_failure_hook(self.on_load_failure)
+            self._rec = None

@@ -1,0 +1,177 @@
+"""Retry/backoff policies for transient federation failures.
+
+One :class:`RetryPolicy` class serves both retry surfaces the
+invocation-per-round process model exposes:
+
+- **wire loads** (``utils/tensorutils.py::load_arrays``): a payload that is
+  absent/incomplete/corrupt may simply be mid-relay — retry with
+  exponential backoff before the quorum machinery ever sees the failure.
+  Enabled by default (3 attempts, ~50 ms base) because the transient is the
+  common case and the cost of a false retry is milliseconds.
+- **node invocations** (``engine.py``): a crashed or hung invocation is
+  re-run before :meth:`~..engine.InProcessEngine._site_failure` declares the
+  site dead.  Disabled by default (1 attempt) — re-invoking a node has side
+  effects (data pipeline state, partial cache mutation) the operator must
+  opt into via the ``invoke_retry_*`` cache keys.
+
+Every knob is a cache key declared in
+:class:`~..config.keys.Retry`; jitter is drawn from a seeded RNG so chaos
+runs (and their golden comparisons) are deterministic.
+"""
+import threading
+import time
+
+from ..config.keys import Retry
+
+# stats sinks are plain cache dicts shared with the caller thread (and, at
+# the aggregator fan-in, across pool threads) — one lock keeps increments
+# exact without per-policy state
+_NOTE_LOCK = threading.Lock()
+
+#: wire-load defaults: retry is cheap, mid-relay payloads are common
+WIRE_DEFAULTS = dict(attempts=3, base_delay=0.05, max_delay=2.0, deadline=30.0)
+#: invocation defaults: retry is side-effectful — OFF until configured
+INVOKE_DEFAULTS = dict(attempts=1, base_delay=0.5, max_delay=30.0,
+                       deadline=None)
+
+
+class RetryExhausted(RuntimeError):
+    """Raised by :meth:`RetryPolicy.run` when every attempt failed; carries
+    ``attempts`` and the final underlying error as ``__cause__``."""
+
+    def __init__(self, describe, attempts, last):
+        super().__init__(
+            f"{describe or 'operation'} failed after {attempts} attempt(s): "
+            f"{type(last).__name__}: {last}"
+        )
+        self.attempts = attempts
+        self.last = last
+
+
+class RetryPolicy:
+    """Deadline-bounded exponential backoff with deterministic jitter.
+
+    ``attempts`` is the TOTAL number of tries (1 = no retry).  ``deadline``
+    (seconds, from the first attempt) stops retrying even with attempts
+    left — a hung relay must not stall the round forever.  ``stats`` is an
+    optional mutable dict (e.g. ``cache['wire_retry_stats']``) the policy
+    increments so retry pressure rides the health rollup over the wire.
+    """
+
+    def __init__(self, attempts=3, base_delay=0.05, max_delay=2.0,
+                 deadline=None, jitter=0.25, seed=0, stats=None):
+        self.attempts = max(int(attempts), 1)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.deadline = float(deadline) if deadline else None
+        self.jitter = float(jitter)
+        self.stats = stats
+        self.last_attempts = 0
+        self._seed = int(seed)
+        import random
+
+        self._rng = random.Random(seed)
+
+    # ------------------------------------------------------------- factories
+    @classmethod
+    def _from_cache(cls, cache, keys, defaults, stats=None):
+        cache = cache if isinstance(cache, dict) else {}
+
+        def get(key, dflt):
+            v = cache.get(key)
+            return dflt if v is None else v
+
+        return cls(
+            attempts=int(get(keys[0], defaults["attempts"])),
+            base_delay=float(get(keys[1], defaults["base_delay"])),
+            max_delay=float(get(keys[2], defaults["max_delay"])),
+            deadline=get(keys[3], defaults["deadline"]),
+            seed=int(cache.get("seed") or 0),
+            stats=stats,
+        )
+
+    @classmethod
+    def for_wire(cls, cache):
+        """Wire-load policy from a node cache (defaults: 3 attempts); retry
+        counts land in ``cache['wire_retry_stats']`` for the health rollup."""
+        stats = None
+        if isinstance(cache, dict):
+            stats = cache.setdefault("wire_retry_stats", {})
+        return cls._from_cache(
+            cache,
+            (Retry.WIRE_ATTEMPTS, Retry.WIRE_BASE_DELAY,
+             Retry.WIRE_MAX_DELAY, Retry.WIRE_DEADLINE),
+            WIRE_DEFAULTS, stats=stats,
+        )
+
+    @classmethod
+    def for_invoke(cls, cache):
+        """Node-invocation policy (defaults: 1 attempt — retry OFF)."""
+        return cls._from_cache(
+            cache,
+            (Retry.INVOKE_ATTEMPTS, Retry.INVOKE_BASE_DELAY,
+             Retry.INVOKE_MAX_DELAY, Retry.INVOKE_DEADLINE),
+            INVOKE_DEFAULTS,
+        )
+
+    # -------------------------------------------------------------- behavior
+    def delay(self, attempt):
+        """Backoff before retry number ``attempt`` (1-based): exponential,
+        capped, with ±``jitter`` fractional spread from the seeded RNG."""
+        d = min(self.base_delay * (2.0 ** (attempt - 1)), self.max_delay)
+        return max(d * (1.0 + self.jitter * self._rng.uniform(-1.0, 1.0)), 0.0)
+
+    def fork(self, k):
+        """Per-task copy for concurrent use (the aggregator's N-payload
+        fan-in): same knobs and the same stats sink, but its own RNG seeded
+        by ``seed + k + 1`` — concurrent tasks never share a jitter stream,
+        so the draw order stays deterministic under any thread schedule."""
+        return RetryPolicy(
+            attempts=self.attempts, base_delay=self.base_delay,
+            max_delay=self.max_delay, deadline=self.deadline,
+            jitter=self.jitter, seed=self._seed + int(k) + 1,
+            stats=self.stats,
+        )
+
+    def note(self, key, n=1):
+        if self.stats is not None:
+            with _NOTE_LOCK:
+                self.stats[key] = int(self.stats.get(key, 0)) + n
+
+    def should_retry(self, attempt, started_at):
+        """True when try number ``attempt`` (1-based, already failed) leaves
+        budget for another: attempts remaining AND deadline not exceeded."""
+        if attempt >= self.attempts:
+            return False
+        if self.deadline is not None and (
+            time.monotonic() - started_at
+        ) >= self.deadline:
+            return False
+        return True
+
+    def run(self, fn, retryable=(Exception,), describe="", on_retry=None):
+        """Call ``fn()`` under this policy.
+
+        ``on_retry(exc, attempt, delay)`` fires before each backoff sleep
+        (telemetry hook).  Exhaustion raises :class:`RetryExhausted` (the
+        final error as ``__cause__``) so callers can attribute *exhausted
+        retries* vs *hard failure*; a non-retryable error propagates as-is
+        with ``last_attempts`` still recording the tries spent."""
+        started = time.monotonic()
+        attempt = 0
+        while True:
+            attempt += 1
+            self.last_attempts = attempt
+            try:
+                return fn()
+            except retryable as exc:
+                if not self.should_retry(attempt, started):
+                    if self.attempts > 1:
+                        raise RetryExhausted(describe, attempt, exc) from exc
+                    raise
+                d = self.delay(attempt)
+                self.note("retries")
+                if on_retry is not None:
+                    on_retry(exc, attempt, d)
+                if d > 0:
+                    time.sleep(d)

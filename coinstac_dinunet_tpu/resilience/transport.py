@@ -1,0 +1,317 @@
+"""Integrity-checked atomic wire transport — the single sanctioned way to
+commit and consume payload files crossing node boundaries.
+
+The paper's execution model ships tensors between nodes as bare files in
+``transferDirectory`` relayed by an external engine, which makes partial
+writes, truncated relays and stale copies first-class failure modes: a
+reader that ``open()``s a file mid-copy silently trains on garbage.  This
+module closes every window:
+
+- **Atomic commit** (:func:`commit_bytes`, :func:`atomic_copy`): payloads are
+  written to a process-unique temp name, fsync'd, then ``os.replace``d into
+  place — a reader can observe *absent* or *complete*, never *partial*, and
+  a crash mid-write can never clobber a previous good payload.
+- **Checksummed format**: ``utils/tensorutils._pack_parts`` embeds a CRC32 of
+  the data section in the payload header (wire format v2); every load
+  verifies it, so relay-level truncation/corruption surfaces as a typed
+  error instead of NaNs three rounds later.
+- **Per-directory manifest** (``.wire_manifest.json``): every committed
+  payload is recorded (bytes + crc) in an atomically-updated manifest next
+  to it.  A receiver can distinguish *not yet relayed* (no entry — keep
+  waiting) from *partially relayed* (entry says N bytes, file has fewer —
+  :class:`WireIncomplete`) from *corrupted* (:class:`WireCorruption`).
+- **Typed errors**: :class:`WireCorruption` / :class:`WireIncomplete`
+  subclass :class:`WireError` (a ``ValueError`` for backward compatibility)
+  and are the retryable vocabulary ``resilience.retry`` policies act on
+  before the quorum machinery ever sees a failure.
+- **Opt-in background commit** (:class:`BackgroundCommitter`, enabled via
+  ``cache['async_wire_commit']``): outbound serialization + fsync run on a
+  worker thread, overlapping the next compute step (the spirit of
+  computation/communication-decoupled SGD, arXiv:1906.12043); the node
+  flushes — and re-raises the first commit error — before its output JSON
+  names the files (:func:`flush_async`).
+
+The ``wire-atomic-commit`` dinulint rule statically flags direct
+``open(..., "wb")`` / ``np.save`` writes aimed at a transfer directory
+anywhere outside this module.
+"""
+import contextlib
+import json
+import os
+import threading
+import zlib
+
+from .. import native
+
+#: name of the per-directory commit manifest written next to payloads
+MANIFEST_NAME = ".wire_manifest.json"
+
+# serializes manifest read-modify-write across the async committer and the
+# caller thread (the process model is one writer per transfer directory,
+# but both threads of one writer may commit concurrently)
+_MANIFEST_LOCK = threading.Lock()
+
+
+class WireError(ValueError):
+    """Base for wire-payload integrity failures.
+
+    Subclasses ``ValueError`` so pre-resilience callers that caught the old
+    ``unpack_arrays`` ``ValueError`` keep working unchanged."""
+
+
+class WireCorruption(WireError):
+    """Payload bytes fail their embedded checksum (or the header is not a
+    COINN wire payload at all) — the content is wrong, not merely late."""
+
+
+class WireIncomplete(WireError):
+    """Payload is shorter than its header/manifest says it should be —
+    a truncated write or a relay caught mid-copy.  Retryable: the complete
+    payload may still arrive."""
+
+
+# ------------------------------------------------------------ atomic commit
+def crc32(*buffers):
+    """CRC32 over a sequence of byte buffers (the payload data section)."""
+    c = 0
+    for b in buffers:
+        c = zlib.crc32(b, c)
+    return c & 0xFFFFFFFF
+
+
+def _fsync_file(path):
+    with open(path, "rb+") as f:
+        os.fsync(f.fileno())
+
+
+def _tmp_name(path):
+    """Process- AND thread-unique temp name: the async committer thread and
+    the caller thread may commit concurrently in one process, and two
+    writers sharing a tmp file would interleave into a garbled payload."""
+    return f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+
+
+def commit_bytes(path, header, blobs, crc=None, fsync=True, manifest=True):
+    """Atomically commit ``header + blobs`` to ``path``; returns total bytes.
+
+    tmp file (process-unique) → fsync → ``os.replace`` — the only visible
+    states are *absent*, *previous payload* and *complete new payload*.
+    Uses the native gather-write (``native/wire.cc``) when available so the
+    blob buffers go straight to the file with no join copy.  ``crc`` (CRC32
+    of ``blobs``, computed by the packer) lands in the directory manifest so
+    receivers can classify failures; pass ``manifest=False`` for payloads
+    outside the wire protocol.
+    """
+    path = str(path)
+    blobs = list(blobs)
+    tmp = _tmp_name(path)
+    try:
+        if not native.pack_file(tmp, header, blobs):
+            with open(tmp, "wb") as f:
+                f.write(header)
+                for b in blobs:
+                    f.write(b)
+                if fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+        elif fsync:
+            _fsync_file(tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+    nbytes = len(header) + sum(len(b) for b in blobs)
+    if manifest:
+        record_manifest(
+            os.path.dirname(path) or ".", os.path.basename(path),
+            nbytes, crc if crc is not None else crc32(*blobs),
+        )
+    return nbytes
+
+
+def atomic_copy(src, dst):
+    """Relay-side atomic file copy: a reader of ``dst`` can never observe a
+    partial copy (the failure mode of a bare ``shutil.copy`` relay)."""
+    import shutil
+
+    tmp = _tmp_name(dst)
+    try:
+        shutil.copyfile(src, tmp)
+        os.replace(tmp, dst)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+# ----------------------------------------------------------------- manifest
+def _manifest_path(dirpath):
+    return os.path.join(dirpath, MANIFEST_NAME)
+
+
+def read_manifest(dirpath):
+    """The directory's commit manifest (``{} `` when absent/corrupt — an
+    unreadable manifest must degrade to 'no expectation', never crash)."""
+    try:
+        with open(_manifest_path(dirpath), "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def record_manifest(dirpath, fname, nbytes, crc):
+    """Atomically record one committed payload in the directory manifest."""
+    with _MANIFEST_LOCK:
+        data = read_manifest(dirpath)
+        files = data.setdefault("files", {})
+        data["v"] = 1
+        seq = int(data.get("seq", 0)) + 1
+        data["seq"] = seq
+        files[str(fname)] = {"bytes": int(nbytes), "crc32": int(crc), "seq": seq}
+        tmp = _tmp_name(_manifest_path(dirpath))
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(data, f, separators=(",", ":"))
+            os.replace(tmp, _manifest_path(dirpath))
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            raise
+
+
+def manifest_entry(path):
+    """The manifest entry naming ``path``, or None (no expectation)."""
+    d, fname = os.path.split(str(path))
+    return read_manifest(d or ".").get("files", {}).get(fname)
+
+
+def classify_load_failure(path, exc):
+    """Map a raw load failure to the most specific typed error.
+
+    - file absent + manifest names it → :class:`WireIncomplete` (committed
+      by the sender, not (fully) relayed yet);
+    - file absent + no manifest entry → the original error (*not yet sent* —
+      the caller's protocol problem, not a transport one);
+    - file present but shorter than the manifest's byte count →
+      :class:`WireIncomplete`;
+    - anything already typed passes through.
+    """
+    if isinstance(exc, WireError):
+        return exc
+    entry = manifest_entry(path)
+    if not os.path.exists(path):
+        if entry:
+            return WireIncomplete(
+                f"{path}: named in the wire manifest ({entry['bytes']} bytes "
+                "committed by the sender) but not present — relay incomplete"
+            )
+        return exc
+    if entry:
+        size = os.path.getsize(path)
+        if size < int(entry["bytes"]):
+            return WireIncomplete(
+                f"{path}: {size} bytes on disk, manifest says "
+                f"{entry['bytes']} — partially relayed"
+            )
+    return exc
+
+
+# ------------------------------------------------------- load-failure hooks
+# In-process observers notified when a verified load fails (attempt-scoped).
+# The chaos harness registers here so a deterministically-damaged payload
+# can be "repaired" (the relay completing) between retry attempts.
+_LOAD_FAILURE_HOOKS = []
+
+
+def add_load_failure_hook(fn):
+    _LOAD_FAILURE_HOOKS.append(fn)
+
+
+def remove_load_failure_hook(fn):
+    with contextlib.suppress(ValueError):
+        _LOAD_FAILURE_HOOKS.remove(fn)
+
+
+def notify_load_failure(path, attempt, exc):
+    """Run registered hooks; True when any hook claims it changed the world
+    (e.g. repaired the payload) so a retry is worth attempting."""
+    changed = False
+    for fn in list(_LOAD_FAILURE_HOOKS):
+        try:
+            changed = bool(fn(path, attempt, exc)) or changed
+        except Exception:  # noqa: BLE001 — hooks must never mask the load error
+            pass
+    return changed
+
+
+# -------------------------------------------------------- background commit
+class BackgroundCommitter:
+    """Single worker thread overlapping outbound payload serialization +
+    commit with the caller's next compute step.
+
+    ``submit`` enqueues a zero-argument commit thunk; ``flush`` blocks until
+    the queue drains and re-raises the FIRST error (a payload that never
+    committed must fail the round loudly before the protocol names it)."""
+
+    def __init__(self):
+        import queue
+
+        self._q = queue.Queue()
+        self._errors = []
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._worker, name="coinn-wire-commit", daemon=True
+        )
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            fn = self._q.get()
+            try:
+                fn()
+            except BaseException as exc:  # noqa: BLE001 — reported at flush
+                with self._lock:
+                    self._errors.append(exc)
+            finally:
+                self._q.task_done()
+
+    def submit(self, fn):
+        self._q.put(fn)
+
+    def flush(self, raise_errors=True):
+        """Wait for every pending commit; re-raise the first failure (or,
+        with ``raise_errors=False``, return the error list — the drain path
+        a FAILED invocation uses so its errors never leak into the next
+        node served by this process)."""
+        self._q.join()
+        with self._lock:
+            errors, self._errors = self._errors, []
+        if errors and raise_errors:
+            raise errors[0]
+        return errors
+
+
+_COMMITTER = None
+_COMMITTER_LOCK = threading.Lock()
+
+
+def async_committer():
+    """The process-wide background committer (created on first use)."""
+    global _COMMITTER
+    with _COMMITTER_LOCK:
+        if _COMMITTER is None:
+            _COMMITTER = BackgroundCommitter()
+        return _COMMITTER
+
+
+def flush_async(raise_errors=True):
+    """Drain pending async commits (no-op when none were ever submitted);
+    re-raises the first commit error.  Nodes call this before returning the
+    output JSON that names the committed files — and again (with
+    ``raise_errors=False``) when an invocation fails, so one node's commit
+    errors are never misattributed to the next node in this process."""
+    if _COMMITTER is not None:
+        return _COMMITTER.flush(raise_errors=raise_errors)
+    return []

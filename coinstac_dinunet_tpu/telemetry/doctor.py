@@ -49,6 +49,12 @@ def build_report(events, bench_history=None, regression_threshold=0.10):
     metrics = {}
     rounds = []
     quarantined = set()
+    # resilience-layer evidence: retry pressure, recovered corruption,
+    # retry-attributed site deaths, injected chaos faults
+    resilience = {"wire_retries": 0, "corruption_recovered": 0,
+                  "invoke_retries": 0}
+    dead_sites = {}
+    chaos = []
 
     def site_entry(site):
         return sites.setdefault(str(site), {
@@ -92,10 +98,40 @@ def build_report(events, bench_history=None, regression_threshold=0.10):
                     site_entry(s)["skipped_rounds"] += 1
             elif name == "quarantine" and rec.get("site") is not None:
                 quarantined.add(str(rec["site"]))
+            elif name == "wire:retry":
+                resilience["wire_retries"] += 1
+            elif name == "wire:corruption_recovered":
+                resilience["corruption_recovered"] += 1
+            elif name == "invoke:retry":
+                resilience["invoke_retries"] += 1
+            elif name == "site_died" and rec.get("site") is not None:
+                dead_sites[str(rec["site"])] = {
+                    "round": rec.get("round"),
+                    "attempts": int(rec.get("attempts", 1) or 1),
+                    "retries_exhausted": bool(rec.get("retries_exhausted")),
+                    "error": str(rec.get("error", ""))[:300],
+                }
+            elif name == "chaos:inject":
+                chaos.append({
+                    "kind": rec.get("fault"),
+                    "round": rec.get("fault_round", rec.get("round")),
+                    "site": rec.get("site"),
+                    "file": rec.get("file"),
+                })
         elif kind == "span" and name == "engine:round":
             rounds.append(float(rec.get("dur", 0.0) or 0.0))
 
     anomalies.sort(key=lambda a: a["t0"])
+    # a permanent fault fires once per invocation attempt — collapse to one
+    # entry per pinned fault, carrying the firing count
+    deduped = {}
+    for c in chaos:
+        key = (c["kind"], c["round"], c["site"], c["file"])
+        if key in deduped:
+            deduped[key]["firings"] += 1
+        else:
+            deduped[key] = dict(c, firings=1)
+    chaos = list(deduped.values())
     for s in sites.values():
         n = s.pop("cosine_n")
         total = s.pop("cosine_sum")
@@ -128,6 +164,9 @@ def build_report(events, bench_history=None, regression_threshold=0.10):
         "metrics": metrics,
         "quarantined": sorted(quarantined),
         "bench": bench,
+        "resilience": resilience,
+        "dead_sites": dead_sites,
+        "chaos": chaos,
     }
     report["verdicts"] = _rank_verdicts(report)
     return report
@@ -159,6 +198,27 @@ def _rank_verdicts(report):
             "severity": severity, "cause": cause, "evidence": evidence,
             "_w": weight,
         })
+
+    # dead sites: the engine's site_died events carry the invocation
+    # attempt count, so the verdict attributes the death to *exhausted
+    # retries* (the retry/backoff policy ran out) vs a *hard failure*
+    # declared on the first invocation (no retry configured)
+    for site, d in sorted(report.get("dead_sites", {}).items()):
+        if d["retries_exhausted"]:
+            how = (
+                f"declared dead after exhausting {d['attempts']} invocation "
+                "attempts (retry/backoff ran out)"
+            )
+        else:
+            how = "hard failure on its first invocation (no retry configured)"
+        add(
+            "critical",
+            f"site {site} died mid-run",
+            how
+            + (f" at round {d['round']}" if d.get("round") is not None else "")
+            + (f": {d['error']}" if d.get("error") else ""),
+            weight=d["attempts"],
+        )
 
     # one-bad-site corruption: the strongest, most attributable signal.
     # nonfinite_rounds (NaN site_cosine samples) and skipped_rounds
@@ -230,6 +290,31 @@ def _rank_verdicts(report):
             f"({bench['drop_pct']:+.1f}% drop, threshold "
             f"{bench['threshold_pct']:g}%)",
         )
+    res = report.get("resilience") or {}
+    if res.get("corruption_recovered"):
+        add(
+            "warning",
+            "wire payload corruption/truncation recovered via retry",
+            f"{res['corruption_recovered']} payload(s) recovered after "
+            f"{res['wire_retries']} wire retry(ies) — the data was intact "
+            "on arrival, but the relay is flaky",
+            weight=res["corruption_recovered"],
+        )
+    chaos = report.get("chaos") or []
+    if chaos:
+        named = ", ".join(
+            f"{c['kind']} @ round {c['round']}"
+            + (f"/{c['site']}" if c.get("site") else "")
+            + (f" ({c['file']})" if c.get("file") else "")
+            for c in chaos
+        )
+        add(
+            "info",
+            f"{len(chaos)} deterministic chaos fault(s) were injected",
+            f"fault plan active: {named} — the failures above are expected "
+            "and the recovery paths they exercised are the evidence",
+            weight=len(chaos),
+        )
     if not verdicts:
         add("info", "no anomalies detected",
             "all watched series stayed within bounds")
@@ -260,6 +345,41 @@ def render_markdown(report):
             f"{v['rank']}. **[{v['severity']}] {v['cause']}** — {v['evidence']}"
         )
     lines.append("")
+
+    chaos = report.get("chaos") or []
+    if chaos:
+        lines.append("## Injected chaos faults")
+        lines.append("")
+        lines.extend(_md_table(
+            ("kind", "round", "site", "file", "firings"),
+            [(c["kind"], c["round"], c.get("site") or "-",
+              c.get("file") or "-", c.get("firings", 1)) for c in chaos],
+        ))
+        lines.append("")
+
+    dead = report.get("dead_sites") or {}
+    res = report.get("resilience") or {}
+    if dead or any(res.values()):
+        lines.append("## Resilience")
+        lines.append("")
+        lines.append(
+            f"{res.get('wire_retries', 0)} wire retry(ies), "
+            f"{res.get('corruption_recovered', 0)} corrupt/truncated "
+            f"payload(s) recovered, {res.get('invoke_retries', 0)} "
+            "invocation retry(ies)."
+        )
+        lines.append("")
+        if dead:
+            lines.extend(_md_table(
+                ("dead site", "round", "attempts", "cause"),
+                [(site,
+                  d["round"] if d.get("round") is not None else "-",
+                  d["attempts"],
+                  "retries exhausted" if d["retries_exhausted"]
+                  else "hard failure")
+                 for site, d in sorted(dead.items())],
+            ))
+            lines.append("")
 
     if report["anomalies"]:
         lines.append("## Anomaly timeline")

@@ -333,14 +333,22 @@ class Watchdog:
     # --------------------------------------------------------------- summary
     def summary(self):
         """Wire-sized health summary (the ``health`` wire key payload):
-        recent anomalies plus per-anomaly counts.  Empty dict = healthy."""
+        recent anomalies plus per-anomaly counts, and — when the resilience
+        layer retried any wire load — the node's retry-pressure counters
+        (``cache['wire_retry_stats']``, resilience/retry.py), so a flaky
+        relay is visible federation-wide before it escalates to a dropout.
+        Empty dict = healthy."""
         anomalies = self.state.get("anomalies", [])
-        if not anomalies and not self.cache.get("quarantined_sites"):
+        wire = self.cache.get("wire_retry_stats") or {}
+        wire = {k: v for k, v in wire.items() if v}
+        if not anomalies and not self.cache.get("quarantined_sites") and not wire:
             return {}
         counts = {}
         for a in anomalies:
             counts[a["anomaly"]] = counts.get(a["anomaly"], 0) + 1
         out = {"counts": counts, "recent": anomalies[-10:]}
+        if wire:
+            out["wire"] = wire
         if self.cache.get("quarantined_sites"):
             out["quarantined"] = list(self.cache["quarantined_sites"])
         return out

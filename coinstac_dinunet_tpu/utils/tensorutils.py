@@ -21,18 +21,31 @@ import time
 import numpy as np
 
 from .. import config
+from ..resilience import transport as _transport
+from ..resilience.transport import (  # noqa: F401 (re-export for callers)
+    WireCorruption,
+    WireError,
+    WireIncomplete,
+)
 from ..telemetry import get_active as _telemetry
 
-_MAGIC = b"COINNTW1"  # COINN Tensor Wire v1
+_MAGIC = b"COINNTW1"  # COINN Tensor Wire v1 (read-compat: no checksum)
+_MAGIC_V2 = b"COINNTW2"  # v2: manifest carries CRC32 + size of the data section
 
 
 def _pack_parts(arrays, codec=None, seed=0):
-    """(header bytes, list of raw data blobs) for a list of ndarrays.
+    """(header bytes, list of raw data blobs, data CRC32) for ndarrays.
 
     ``codec='int8'`` stores each float array as stochastic-rounded group-wise
     int8 values + f32 scales (``ops/quantize.py``) — 4× smaller than f32 on
     the wire, decoded transparently by :func:`unpack_arrays`.  Non-float
     arrays pass through raw.
+
+    The v2 header manifest embeds the CRC32 and byte count of the data
+    section, so every :func:`unpack_arrays` verifies integrity end-to-end —
+    a truncated or bit-flipped relay surfaces as a typed
+    :class:`~..resilience.transport.WireIncomplete` /
+    :class:`~..resilience.transport.WireCorruption` instead of silent NaNs.
     """
     arrays = [np.ascontiguousarray(a) for a in arrays]
     entries, blobs = [], []
@@ -51,26 +64,76 @@ def _pack_parts(arrays, codec=None, seed=0):
         else:
             entries.append({"shape": list(a.shape), "dtype": a.dtype.str})
             blobs.append(a.tobytes())
-    manifest = json.dumps(entries).encode("utf-8")
-    header = b"".join([_MAGIC, struct.pack("<Q", len(manifest)), manifest])
-    return header, blobs
+    crc = _transport.crc32(*blobs)
+    manifest = json.dumps({
+        "e": entries,
+        "crc": crc,
+        "size": sum(len(b) for b in blobs),
+    }).encode("utf-8")
+    header = b"".join([_MAGIC_V2, struct.pack("<Q", len(manifest)), manifest])
+    return header, blobs, crc
 
 
 def pack_arrays(arrays, codec=None, seed=0):
     """Pack a list of ndarrays into one contiguous bytes payload."""
-    header, blobs = _pack_parts(arrays, codec=codec, seed=seed)
+    header, blobs, _ = _pack_parts(arrays, codec=codec, seed=seed)
     return b"".join([header] + blobs)
 
 
-def unpack_arrays(payload):
-    """Inverse of :func:`pack_arrays`. Returns a list of ndarrays (views)."""
-    if payload[: len(_MAGIC)] != _MAGIC:
-        raise ValueError("Not a COINN tensor-wire payload")
+def unpack_arrays(payload, expected_crc=None):
+    """Inverse of :func:`pack_arrays`. Returns a list of ndarrays (views).
+
+    v2 payloads are integrity-verified: a data section shorter than the
+    header promises raises :class:`WireIncomplete`, a CRC32 mismatch raises
+    :class:`WireCorruption` (both ``ValueError`` subclasses).  v1 payloads
+    (no checksum) still load for read-compatibility.
+
+    ``expected_crc`` (the directory manifest's CRC for this file) closes the
+    STALE-copy window a self-validating payload leaves open: a lost relay
+    whose destination still holds the previous round's intact payload would
+    otherwise verify and be consumed silently.  A v2 payload whose embedded
+    CRC differs from the manifest's raises :class:`WireIncomplete` (the
+    committed newer payload hasn't fully arrived — retryable)."""
+    magic = payload[: len(_MAGIC)]
+    if magic not in (_MAGIC, _MAGIC_V2):
+        if len(payload) < len(_MAGIC):
+            raise WireIncomplete(
+                f"payload of {len(payload)} bytes is shorter than the wire "
+                "magic — truncated before the header completed"
+            )
+        raise WireCorruption("Not a COINN tensor-wire payload")
     off = len(_MAGIC)
+    if len(payload) < off + 8:
+        raise WireIncomplete("payload truncated inside the manifest length")
     (mlen,) = struct.unpack_from("<Q", payload, off)
     off += 8
-    manifest = json.loads(payload[off : off + mlen].decode("utf-8"))
+    if len(payload) < off + mlen:
+        raise WireIncomplete("payload truncated inside the manifest")
+    try:
+        manifest = json.loads(payload[off : off + mlen].decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise WireCorruption(f"undecodable wire manifest: {exc}") from exc
     off += mlen
+    if magic == _MAGIC_V2:
+        size = int(manifest["size"])
+        if expected_crc is not None and int(manifest["crc"]) != int(expected_crc):
+            raise WireIncomplete(
+                f"payload embeds CRC {int(manifest['crc'])} but the commit "
+                f"manifest expects {int(expected_crc)} — a stale copy of an "
+                "earlier payload; the committed one hasn't (fully) arrived"
+            )
+        data = memoryview(payload)[off : off + size]  # no data-section copy
+        if len(data) < size:
+            raise WireIncomplete(
+                f"payload data section has {len(data)} of {size} bytes — "
+                "truncated write or partial relay"
+            )
+        if _transport.crc32(data) != int(manifest["crc"]):
+            raise WireCorruption(
+                "payload data section fails its embedded CRC32 — corrupted "
+                "in transit"
+            )
+        manifest = manifest["e"]
     out = []
     for item in manifest:
         dt = np.dtype(item["dtype"])
@@ -96,37 +159,86 @@ def unpack_arrays(payload):
 
 
 def save_arrays(path, arrays, codec=None, seed=0):
-    """Write a list of arrays (or a single array) to ``path``.
+    """Atomically commit a list of arrays (or a single array) to ``path``;
+    returns the payload size in bytes.
 
-    Uses the native gather-write (``native/wire.cc``) when available — the
-    payload buffers go straight from array memory to the file with no
-    intermediate join copy; falls back to a plain Python write."""
+    All writes route through :func:`~..resilience.transport.commit_bytes`
+    (tmp + fsync + rename + directory manifest) — a reader can never observe
+    a partial payload, and the native gather-write (``native/wire.cc``) is
+    still used underneath when available."""
     if isinstance(arrays, np.ndarray):
         arrays = [arrays]
     arrays = [np.asarray(a) for a in arrays]
-    header, blobs = _pack_parts(arrays, codec=codec, seed=seed)
+    # the packer's CRC rides through to the directory manifest — one pass
+    # over the data section, not two
+    header, blobs, crc = _pack_parts(arrays, codec=codec, seed=seed)
+    return _transport.commit_bytes(path, header, blobs, crc=crc)
+
+
+def _read_payload(path):
     from .. import native
 
-    if native.pack_file(path, header, blobs):
-        return
-    with open(path, "wb") as f:
-        f.write(header)
-        for b in blobs:
-            f.write(b)
-
-
-def load_arrays(path):
-    """Read back the list written by :func:`save_arrays` (native bulk read
-    when available)."""
-    from .. import native
-
-    rec = _telemetry()
-    t0 = time.perf_counter() if rec.enabled else 0.0
     payload = native.load_file(path) if native.available() else None
     if payload is None:
         with open(path, "rb") as f:
             payload = f.read()
-    out = unpack_arrays(payload)
+    return payload
+
+
+def load_arrays(path, retry=None):
+    """Read back the list written by :func:`save_arrays` (native bulk read
+    when available), verifying the embedded checksum.
+
+    ``retry`` (a :class:`~..resilience.retry.RetryPolicy`, e.g.
+    ``RetryPolicy.for_wire(cache)``) retries absent / incomplete / corrupt
+    payloads with backoff — a payload mid-relay is a transient, and the
+    quorum machinery must only ever see failures that survived the retry
+    budget.  A recovery after a corruption/truncation failure emits a
+    ``wire:corruption_recovered`` telemetry event."""
+    rec = _telemetry()
+    t0 = time.perf_counter() if rec.enabled else 0.0
+    # inline loop rather than RetryPolicy.run: exhaustion must re-raise the
+    # TYPED error (WireCorruption/WireIncomplete/FileNotFoundError — the
+    # documented transport vocabulary), and every failed attempt (including
+    # the last) notifies the in-process repair hooks
+    attempt = 0
+    saw_integrity_failure = False
+    started = time.monotonic()
+    while True:
+        attempt += 1
+        try:
+            payload = _read_payload(path)
+            entry = _transport.manifest_entry(path)
+            out = unpack_arrays(
+                payload,
+                expected_crc=None if entry is None else entry.get("crc32"),
+            )
+            break
+        except (FileNotFoundError, WireError) as exc:
+            exc = _transport.classify_load_failure(path, exc)
+            saw_integrity_failure = saw_integrity_failure or isinstance(
+                exc, WireError
+            )
+            # in-process chaos/repair observers (harmless when none)
+            _transport.notify_load_failure(path, attempt, exc)
+            if retry is None or not retry.should_retry(attempt, started):
+                raise exc from None
+            delay = retry.delay(attempt)
+            retry.note("retries")
+            rec.event(
+                "wire:retry", cat="wire", file=os.path.basename(str(path)),
+                attempt=attempt, delay=round(delay, 4),
+                error=f"{type(exc).__name__}: {exc}"[:300],
+            )
+            if delay > 0:
+                time.sleep(delay)
+    if saw_integrity_failure:
+        if retry is not None:
+            retry.note("recovered")
+        rec.event(
+            "wire:corruption_recovered", cat="wire",
+            file=os.path.basename(str(path)), attempts=attempt,
+        )
     if rec.enabled:
         rec.wire(
             "load", path, nbytes=len(payload), arrays=len(out),
@@ -136,37 +248,59 @@ def load_arrays(path):
     return out
 
 
-def load_arrays_many(paths):
+def load_arrays_many(paths, retry=None):
     """Load several payload files concurrently — the aggregator's N-site
     fan-in (≙ ref ``distrib/reducer.py:18-23`` multiprocessing pool).
 
-    Native C++ threads when available; a GIL-releasing thread pool otherwise.
-    Individual native read failures retry through the Python reader."""
+    Native C++ threads when available; a GIL-releasing thread pool otherwise
+    (capped at the host's core count — an unbounded pool at high site fan-in
+    thrashes instead of parallelizing).  Individual native read/verify
+    failures retry through the Python reader under ``retry``."""
     from .. import native
 
     paths = list(paths)
     rec = _telemetry()
     t0 = time.perf_counter() if rec.enabled else 0.0
     payloads = native.load_many(paths) if native.available() else None
+
+    def _task_retry(i):
+        # per-task fork: concurrent loads never share a jitter RNG (draw
+        # order would become thread-schedule-dependent) while the retry
+        # counts still land in the one shared stats sink
+        return None if retry is None else retry.fork(i)
+
     if payloads is None:
         from concurrent.futures import ThreadPoolExecutor
 
+        workers = min(max(len(paths), 1), os.cpu_count() or 8)
         # each load_arrays call records its own wire event
-        with ThreadPoolExecutor(max_workers=max(len(paths), 1)) as ex:
-            return list(ex.map(load_arrays, paths))
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            return list(ex.map(
+                lambda ip: load_arrays(ip[1], retry=_task_retry(ip[0])),
+                enumerate(paths),
+            ))
     out = []
-    for p, payload in zip(paths, payloads):
+    for i, (p, payload) in enumerate(zip(paths, payloads)):
         if payload is None:  # transient native failure: retry via Python IO
-            out.append(load_arrays(p))
-        elif rec.enabled:
-            arrays = unpack_arrays(payload)
-            out.append(arrays)
+            out.append(load_arrays(p, retry=_task_retry(i)))
+            continue
+        try:
+            entry = _transport.manifest_entry(p)
+            arrays = unpack_arrays(
+                payload,
+                expected_crc=None if entry is None else entry.get("crc32"),
+            )
+        except WireError:
+            # integrity failure on the native fast path: re-drive this one
+            # file through the retrying reader
+            out.append(load_arrays(p, retry=_task_retry(i)))
+            continue
+        out.append(arrays)
+        if rec.enabled:
             rec.wire(
                 "load", p, nbytes=len(payload), arrays=len(arrays),
                 raw_bytes=sum(int(a.nbytes) for a in arrays),
             )
-        else:
-            out.append(unpack_arrays(payload))
     if rec.enabled:
         rec.event(
             "wire:fan_in", cat="wire", files=len(paths),
@@ -183,31 +317,55 @@ def save_wire(path, arrays, salt="", cache=None, precision_bits=None):
     with a seed salted by ``salt`` (site/aggregator identity) and advanced in
     ``cache['_wire_seed']`` every call — rounding noise must be independent
     across nodes and rounds or averaging gains no variance reduction.
+
+    With ``cache['async_wire_commit']`` the pack + atomic commit run on the
+    background commit thread (overlapping the caller's next compute step);
+    the node's invocation wrapper flushes — and re-raises any commit error —
+    before the output JSON naming this file leaves the node.
     """
     from . import stable_file_id  # deferred: dodges the utils/__init__ cycle
+    from ..config.keys import Retry
 
     cache = cache if cache is not None else {}
     counter = int(cache.get("_wire_seed", 0))
     seed = (stable_file_id(salt) + counter) % (2 ** 31)
     codec = config.wire_codec(precision_bits)
     rec = _telemetry()
+    arr_list = arrays if isinstance(arrays, (list, tuple)) else [arrays]
+    cache["_wire_seed"] = counter + len(arr_list)
+    if cache.get(Retry.ASYNC_WIRE_COMMIT):
+        # materialize host SNAPSHOTS now — the caller may mutate its buffers
+        # after we return.  np.asarray alone is identity on numpy inputs, so
+        # an ndarray needs an explicit copy; device (jax) arrays already
+        # materialize fresh host memory on conversion.
+        host = [
+            np.array(a, copy=True) if isinstance(a, np.ndarray)
+            else np.asarray(a)
+            for a in arr_list
+        ]
+
+        def _commit(path=path, host=host, codec=codec, seed=seed, rec=rec):
+            t0 = time.perf_counter() if rec.enabled else 0.0
+            nbytes = save_arrays(path, host, codec=codec, seed=seed)
+            if rec.enabled:
+                rec.wire(
+                    "save", path, nbytes=nbytes, arrays=len(host),
+                    codec=codec,
+                    raw_bytes=sum(int(a.nbytes) for a in host),
+                    dur=time.perf_counter() - t0,
+                )
+
+        _transport.async_committer().submit(_commit)
+        return
     t0 = time.perf_counter() if rec.enabled else 0.0
-    save_arrays(path, arrays, codec=codec, seed=seed)
+    nbytes = save_arrays(path, arr_list, codec=codec, seed=seed)
     if rec.enabled:
-        arr_list = arrays if isinstance(arrays, (list, tuple)) else [arrays]
-        try:
-            nbytes = os.path.getsize(path)
-        except OSError:
-            nbytes = 0
         rec.wire(
             "save", path, nbytes=nbytes, arrays=len(arr_list), codec=codec,
             # .nbytes exists on numpy AND jax arrays without a host copy
             raw_bytes=sum(int(getattr(a, "nbytes", 0)) for a in arr_list),
             dur=time.perf_counter() - t0,
         )
-    cache["_wire_seed"] = counter + (
-        len(arrays) if isinstance(arrays, (list, tuple)) else 1
-    )
 
 
 def aslist(x):

@@ -15,10 +15,22 @@ reducer excluded it per round, and ``telemetry doctor``'s TOP verdict names
 it — the observability acceptance gate, run by the CI ``telemetry`` job
 which uploads the markdown postmortem as an artifact.
 
+With ``--fault-plan [PATH]`` the run executes under the deterministic chaos
+harness (``resilience/chaos.py``; PATH is a fault-plan JSON, default the
+built-in demo plan: a truncated ``grads.npy`` at round 2 recovered via wire
+retry, and a permanently hung site at round 3 quorum-dropped only after the
+invocation retries exhaust).  The smoke then asserts the resilience
+acceptance contract: ``wire:corruption_recovered`` and ``invoke:retry``
+events in the merged trace, a ``site_died`` event carrying the exhausted
+attempt count, and a ``telemetry doctor`` postmortem naming every injected
+fault — the chaos gate, run by the CI ``chaos`` job which uploads the
+markdown postmortem as an artifact.
+
 Usage::
 
     python scripts/telemetry_smoke.py --workdir /tmp/telemetry_run \
-        --trace /tmp/telemetry_run/trace.json [--inject-nan-site 1]
+        --trace /tmp/telemetry_run/trace.json \
+        [--inject-nan-site 1] [--fault-plan [plan.json]]
 """
 import argparse
 import json
@@ -41,6 +53,12 @@ def main(argv=None):
     p.add_argument("--inject-nan-site", type=int, default=None, metavar="N",
                    help="site index whose inputs go NaN from its second "
                         "epoch on (watchdog/doctor acceptance scenario)")
+    p.add_argument("--fault-plan", nargs="?", const="demo", default=None,
+                   metavar="PATH",
+                   help="run under the chaos harness: PATH is a fault-plan "
+                        "JSON (resilience/chaos.py schema); bare --fault-plan "
+                        "uses the built-in demo plan (truncated payload at "
+                        "round 2 + hung site at round 3)")
     args = p.parse_args(argv)
     trace_path = args.trace or os.path.join(args.workdir, "trace.json")
 
@@ -74,6 +92,30 @@ def main(argv=None):
         f"site_{args.inject_nan_site}" if args.inject_nan_site is not None
         else None
     )
+    # --fault-plan: the chaos acceptance scenario — a truncated payload the
+    # wire retry recovers, and a hung site the quorum drops only after the
+    # invocation retries exhaust (ISSUE 5 acceptance demo)
+    fault_plan = None
+    chaos_args = {}
+    hung_site = None
+    if args.fault_plan is not None:
+        if args.fault_plan == "demo":
+            fault_plan = {"faults": [
+                {"kind": "truncate_payload", "round": 2, "site": "site_0",
+                 "file": "grads.npy"},
+                {"kind": "hang", "round": 3, "site": "site_1"},
+            ]}
+        else:
+            with open(args.fault_plan) as f:
+                fault_plan = json.load(f)
+        os.makedirs(args.workdir, exist_ok=True)
+        # the executed plan rides the CI artifact next to the postmortem
+        with open(os.path.join(args.workdir, "fault_plan.json"), "w") as f:
+            json.dump(fault_plan, f, indent=2)
+        hung = [ft for ft in fault_plan["faults"]
+                if ft["kind"] in ("crash", "hang") and ft.get("times") is None]
+        hung_site = hung[0]["site"] if hung else None
+        chaos_args = dict(site_quorum=1, invoke_retry_attempts=2)
     eng = InProcessEngine(
         args.workdir, n_sites=args.sites, trainer_cls=FSVTrainer,
         dataset_cls=(NaNFSVDataset if nan_site else FSVDataset),
@@ -81,7 +123,7 @@ def main(argv=None):
         data_dir="data", split_ratio=[0.6, 0.2, 0.2], batch_size=4,
         epochs=2, validation_epochs=1, learning_rate=5e-2, input_size=12,
         hidden_sizes=[8], num_classes=2, seed=7, synthetic=True,
-        patience=50, profile=True,
+        patience=50, profile=True, fault_plan=fault_plan, **chaos_args,
         # site epoch counters are 0-based: 1 = the second epoch
         site_args=({nan_site: {"nan_from_epoch": 1}} if nan_site else None),
     )
@@ -105,7 +147,8 @@ def main(argv=None):
 
     span_names = {(e["node"], e["name"]) for e in events
                   if e.get("kind") == "span"}
-    for s in eng.site_ids:
+    # a chaos-killed site legitimately never reaches its computation spans
+    for s in (set(eng.site_ids) - eng.dead_sites):
         assert (s, "local:computation") in span_names, s
         assert (s, "local:to_reduce") in span_names, s
     assert ("remote", "remote:reduce") in span_names
@@ -119,6 +162,52 @@ def main(argv=None):
     metric_names = {e["name"] for e in events if e.get("kind") == "metric"}
     assert "grad_norm" in metric_names, metric_names
     assert "site_cosine" in metric_names, metric_names
+
+    if fault_plan is not None:
+        from coinstac_dinunet_tpu.telemetry.doctor import (
+            build_report, render_markdown,
+        )
+
+        evts = [e for e in events if e.get("kind") == "event"]
+        kinds = {ft["kind"] for ft in fault_plan["faults"]}
+        # assert only the outcomes THIS plan's fault kinds produce — a
+        # custom --fault-plan PATH need not contain every demo fault
+        if kinds & {"truncate_payload", "corrupt_payload", "drop_relay"}:
+            recovered = [e for e in evts
+                         if e["name"] == "wire:corruption_recovered"]
+            assert recovered, (
+                "chaos plan injected payload damage but no "
+                "wire:corruption_recovered event landed in the merged trace"
+            )
+        if kinds & {"crash", "hang"}:
+            iretries = [e for e in evts if e["name"] == "invoke:retry"]
+            assert iretries, (
+                "no invoke:retry events — the retry engine never ran"
+            )
+        if hung_site:
+            died = [e for e in evts if e["name"] == "site_died"]
+            assert any(
+                e.get("site") == hung_site and e.get("retries_exhausted")
+                and int(e.get("attempts", 1)) > 1
+                for e in died
+            ), (
+                f"hung site {hung_site} was not quorum-dropped via retry "
+                f"exhaustion: {died}"
+            )
+            assert eng.dead_sites == {hung_site}, eng.dead_sites
+        report = build_report(events)
+        planned = {ft["kind"] for ft in fault_plan["faults"]}
+        reported = {c["kind"] for c in report["chaos"]}
+        assert planned <= reported, (planned, reported)
+        md = render_markdown(report)
+        for ft in fault_plan["faults"]:  # the postmortem names every fault
+            assert ft["kind"] in md, ft
+        print(
+            "\nchaos scenario verified: "
+            f"{len(report['chaos'])} fault(s) injected, "
+            f"{report['resilience']['corruption_recovered']} payload(s) "
+            f"recovered, dead sites: {sorted(eng.dead_sites)}"
+        )
 
     if nan_site:
         from coinstac_dinunet_tpu.telemetry.doctor import build_report

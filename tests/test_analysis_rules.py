@@ -996,3 +996,88 @@ def test_write_baseline_without_deep_preserves_deep_entries(tmp_path, capsys):
     data = json.loads(baseline.read_text())
     rules = sorted(e["rule"] for e in data["findings"])
     assert rules == ["deep-eval-shape", "jax-api-drift"]
+
+
+# -------------------------------------------------------- wire-atomic-commit
+def test_wire_atomic_flags_open_wb_and_np_save_to_transfer_dir():
+    from coinstac_dinunet_tpu.analysis.wire_atomic import WireAtomicCommitRule
+
+    mod = _module(
+        """
+        import os
+        import numpy as np
+
+        def ship(state, arrays):
+            p = os.path.join(state["transferDirectory"], "grads.npy")
+            with open(p, "wb") as f:          # partial-write window
+                f.write(arrays)
+
+        class L:
+            def _transfer_path(self, f):
+                return f
+
+            def ship2(self, a):
+                np.save(self._transfer_path("g.npy"), a)
+
+        def ship3(xfer_dir, a):
+            np.save(os.path.join(xfer_dir, "g.npy"), a)
+        """
+    )
+    msgs = _messages(WireAtomicCommitRule().visit_module(mod))
+    assert len(msgs) == 3
+    assert any("open(..., 'wb')" in m for m in msgs)
+    assert all("resilience/transport.py" in m for m in msgs)
+
+
+def test_wire_atomic_clean_on_reads_other_dirs_and_transport_itself():
+    from coinstac_dinunet_tpu.analysis.wire_atomic import WireAtomicCommitRule
+
+    clean = _module(
+        """
+        import numpy as np
+
+        def fine(state, out_dir, a):
+            with open(state["transferDirectory"] + "/g.npy", "rb") as f:
+                f.read()                       # reads are never flagged
+            np.save(out_dir + "/scores.npy", a)  # not a transfer dir
+            with open(out_dir + "/log.txt", "w") as f:
+                f.write("x")                   # text mode is not a payload
+        """
+    )
+    assert WireAtomicCommitRule().visit_module(clean) == []
+    # the sanctioned writer itself is exempt
+    exempt = _module(
+        """
+        def commit(path, data):
+            with open(path + ".tmp", "wb") as f:
+                f.write(data)
+        """,
+        path="coinstac_dinunet_tpu/resilience/transport.py",
+    )
+    # even with a transfer mention it stays clean
+    exempt2 = _module(
+        """
+        def commit(xfer_dir, data):
+            with open(xfer_dir + "/g.npy", "wb") as f:
+                f.write(data)
+        """,
+        path="coinstac_dinunet_tpu/resilience/transport.py",
+    )
+    assert WireAtomicCommitRule().visit_module(exempt) == []
+    assert WireAtomicCommitRule().visit_module(exempt2) == []
+
+
+def test_wire_atomic_mode_kwarg_and_variable_modes():
+    from coinstac_dinunet_tpu.analysis.wire_atomic import WireAtomicCommitRule
+
+    mod = _module(
+        """
+        def ship(xfer, data, m):
+            with open(xfer + "/g.npy", mode="wb") as f:   # kwarg mode
+                f.write(data)
+            with open(xfer + "/g.npy", m) as f:           # dynamic: skipped
+                f.write(data)
+        """
+    )
+    msgs = _messages(WireAtomicCommitRule().visit_module(mod))
+    assert len(msgs) == 1

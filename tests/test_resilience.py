@@ -1,0 +1,487 @@
+"""resilience/ subsystem: atomic integrity-checked transport, retry/backoff,
+and the deterministic chaos harness.
+
+The acceptance contract (ISSUE 5): a 3-site run with one corrupted payload
+(recovered via wire retry) and one crashed site (quorum-dropped after invoke
+retry exhaustion) completes and matches the survivor-weighted golden run;
+with no fault plan the chaos/retry hooks are no-op cheap.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu import telemetry
+from coinstac_dinunet_tpu.config.keys import Retry
+from coinstac_dinunet_tpu.engine import InProcessEngine, SubprocessEngine
+from coinstac_dinunet_tpu.resilience import (
+    ChaosCrash,
+    ChaosSession,
+    RetryExhausted,
+    RetryPolicy,
+    WireCorruption,
+    WireIncomplete,
+    load_fault_plan,
+    transport,
+)
+from coinstac_dinunet_tpu.resilience.chaos import NULL_CHAOS
+from coinstac_dinunet_tpu.telemetry.collect import load_events
+from coinstac_dinunet_tpu.telemetry.doctor import build_report, render_markdown
+from coinstac_dinunet_tpu.utils import tensorutils
+
+from test_trainer import XorDataset, XorTrainer
+
+ARRS = [np.arange(24, dtype=np.float32).reshape(4, 6),
+        np.array([7, 8, 9], np.int32)]
+
+
+# ------------------------------------------------------------------ transport
+def test_atomic_commit_roundtrip_manifest_and_nbytes(tmp_path):
+    """save_arrays commits atomically (no tmp leftovers), returns the real
+    byte count (the save_wire nbytes fix), and records the payload in the
+    directory manifest with its CRC."""
+    p = str(tmp_path / "grads.npy")
+    nbytes = tensorutils.save_arrays(p, ARRS)
+    assert nbytes == os.path.getsize(p) > 0
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    entry = transport.manifest_entry(p)
+    assert entry and entry["bytes"] == nbytes and entry["crc32"] >= 0
+    out = tensorutils.load_arrays(p)
+    assert all(np.array_equal(a, b) for a, b in zip(ARRS, out))
+
+
+def test_corruption_and_truncation_raise_typed_errors(tmp_path):
+    p = str(tmp_path / "grads.npy")
+    tensorutils.save_arrays(p, ARRS)
+    raw = open(p, "rb").read()
+    with open(p, "wb") as f:  # bit-flip the data tail: same length, bad CRC
+        f.write(raw[:-4] + bytes(b ^ 0xFF for b in raw[-4:]))
+    with pytest.raises(WireCorruption):
+        tensorutils.load_arrays(p)
+    with open(p, "wb") as f:  # truncate: the mid-copy observation
+        f.write(raw[: len(raw) * 3 // 5])
+    with pytest.raises(WireIncomplete):
+        tensorutils.load_arrays(p)
+    # both are ValueError subclasses: pre-resilience callers keep working
+    assert issubclass(WireCorruption, ValueError)
+    assert issubclass(WireIncomplete, ValueError)
+
+
+def test_manifest_distinguishes_not_yet_sent_from_partially_relayed(tmp_path):
+    """The receiver-side triage the ISSUE demands: a file the manifest
+    names but that is absent was committed and lost in relay (incomplete,
+    retryable); a file nobody ever committed is a plain FileNotFoundError."""
+    p = str(tmp_path / "grads.npy")
+    tensorutils.save_arrays(p, ARRS)
+    os.unlink(p)
+    with pytest.raises(WireIncomplete, match="relay incomplete"):
+        tensorutils.load_arrays(p)
+    with pytest.raises(FileNotFoundError):
+        tensorutils.load_arrays(str(tmp_path / "never_committed.npy"))
+
+
+def test_v1_payload_still_loads(tmp_path):
+    """Read-compat: pre-checksum (COINNTW1) payloads decode unchanged."""
+    import struct
+
+    arr = np.arange(5, dtype=np.float32)
+    manifest = json.dumps([{"shape": [5], "dtype": "<f4"}]).encode()
+    payload = (b"COINNTW1" + struct.pack("<Q", len(manifest)) + manifest
+               + arr.tobytes())
+    out = tensorutils.unpack_arrays(payload)
+    assert np.array_equal(out[0], arr)
+
+
+def test_atomic_copy(tmp_path):
+    src, dst = str(tmp_path / "a"), str(tmp_path / "b")
+    with open(src, "wb") as f:
+        f.write(b"payload")
+    transport.atomic_copy(src, dst)
+    assert open(dst, "rb").read() == b"payload"
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+def test_async_commit_flush_lands_file_and_reraises_errors(tmp_path):
+    cache = {Retry.ASYNC_WIRE_COMMIT: True, "seed": 0}
+    p = str(tmp_path / "async.npy")
+    tensorutils.save_wire(p, ARRS, salt="site_0", cache=cache)
+    transport.flush_async()
+    assert all(np.array_equal(a, b)
+               for a, b in zip(ARRS, tensorutils.load_arrays(p)))
+    # the submit snapshots the arrays: mutating the caller's buffer after
+    # save_wire returns must not corrupt the committed payload
+    buf = np.ones(8, np.float32)
+    p_snap = str(tmp_path / "snap.npy")
+    tensorutils.save_wire(p_snap, [buf], salt="site_0", cache=cache)
+    buf[:] = -1.0
+    transport.flush_async()
+    np.testing.assert_array_equal(tensorutils.load_arrays(p_snap)[0],
+                                  np.ones(8, np.float32))
+    # a commit that cannot land must fail the flush loudly, not vanish
+    bad = str(tmp_path / "no_such_dir" / "x.npy")
+    tensorutils.save_wire(bad, ARRS, salt="site_0", cache=cache)
+    with pytest.raises(OSError):
+        transport.flush_async()
+    transport.flush_async()  # errors drain: the next flush is clean
+    # the failed-invocation drain path: errors returned, never raised, and
+    # fully consumed so they cannot leak into the NEXT node's flush
+    tensorutils.save_wire(bad, ARRS, salt="site_0", cache=cache)
+    errs = transport.flush_async(raise_errors=False)
+    assert errs and isinstance(errs[0], OSError)
+    assert transport.flush_async() == []
+
+
+# ---------------------------------------------------------------------- retry
+def test_retry_backoff_is_deterministic_and_capped():
+    a = RetryPolicy(attempts=6, base_delay=0.1, max_delay=0.5, seed=42)
+    b = RetryPolicy(attempts=6, base_delay=0.1, max_delay=0.5, seed=42)
+    da = [a.delay(i) for i in range(1, 6)]
+    assert da == [b.delay(i) for i in range(1, 6)]  # seeded jitter
+    assert all(d <= 0.5 * 1.25 + 1e-9 for d in da)  # cap + jitter bound
+
+
+def test_retry_run_recovers_exhausts_and_passes_through():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    pol = RetryPolicy(attempts=3, base_delay=0.0)
+    assert pol.run(flaky) == "ok" and len(calls) == 3
+
+    pol = RetryPolicy(attempts=2, base_delay=0.0)
+    with pytest.raises(RetryExhausted) as ei:
+        pol.run(lambda: (_ for _ in ()).throw(OSError("down")), describe="x")
+    assert ei.value.attempts == 2 and isinstance(ei.value.last, OSError)
+
+    # attempts=1 (retry off): the ORIGINAL error propagates untouched
+    pol = RetryPolicy(attempts=1)
+    with pytest.raises(OSError, match="down"):
+        pol.run(lambda: (_ for _ in ()).throw(OSError("down")))
+
+
+def test_retry_policies_read_cache_keys():
+    cache = {Retry.WIRE_ATTEMPTS: 5, Retry.WIRE_BASE_DELAY: 0.5,
+             Retry.INVOKE_ATTEMPTS: 4, Retry.INVOKE_DEADLINE: 9.0}
+    wire = RetryPolicy.for_wire(cache)
+    assert wire.attempts == 5 and wire.base_delay == 0.5
+    assert wire.stats is cache["wire_retry_stats"]
+    inv = RetryPolicy.for_invoke(cache)
+    assert inv.attempts == 4 and inv.deadline == 9.0
+    # defaults: wire retries ON, invocation retries OFF
+    assert RetryPolicy.for_wire({}).attempts == 3
+    assert RetryPolicy.for_invoke({}).attempts == 1
+
+
+def test_retry_fork_decorrelates_jitter_and_shares_stats():
+    """Concurrent fan-in forks: each task gets its own deterministic jitter
+    stream (thread schedule can't reorder draws) but the retry counts land
+    in the one shared stats sink."""
+    stats = {}
+    base = RetryPolicy(attempts=3, base_delay=0.1, seed=7, stats=stats)
+    a, b = base.fork(0), base.fork(1)
+    assert a.stats is stats and b.stats is stats
+    assert [a.delay(i) for i in (1, 2)] != [b.delay(i) for i in (1, 2)]
+    again = RetryPolicy(attempts=3, base_delay=0.1, seed=7).fork(0)
+    assert again.delay(1) == RetryPolicy(
+        attempts=3, base_delay=0.1, seed=7
+    ).fork(0).delay(1)
+
+
+def test_deadline_exhaustion_is_attributed_as_exhausted():
+    """A retry budget killed by the DEADLINE during attempt 1 is still
+    RetryExhausted (attempts=1) — the doctor must never read it as 'no
+    retry configured'."""
+    pol = RetryPolicy(attempts=3, base_delay=0.0, deadline=1e-9)
+    with pytest.raises(RetryExhausted) as ei:
+        pol.run(lambda: (_ for _ in ()).throw(OSError("slow")), describe="x")
+    assert ei.value.attempts == 1
+
+
+def test_load_arrays_retry_recovers_truncated_payload(tmp_path):
+    """The in-process heal path: a truncated payload restored between
+    attempts loads bit-identically, and the retry pressure lands in the
+    policy's stats sink (→ the health rollup)."""
+    p = str(tmp_path / "grads.npy")
+    tensorutils.save_arrays(p, ARRS)
+    raw = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(raw[:30])
+
+    def repair(path, attempt, exc):
+        with open(p, "wb") as f:
+            f.write(raw)
+        return True
+
+    transport.add_load_failure_hook(repair)
+    try:
+        cache = {}
+        out = tensorutils.load_arrays(p, retry=RetryPolicy.for_wire(cache))
+    finally:
+        transport.remove_load_failure_hook(repair)
+    assert all(np.array_equal(a, b) for a, b in zip(ARRS, out))
+    assert cache["wire_retry_stats"] == {"retries": 1, "recovered": 1}
+
+
+def test_load_arrays_many_caps_thread_pool(tmp_path, monkeypatch):
+    """The unbounded-executor fix: fan-in over many payloads uses at most
+    cpu_count workers (and still loads everything correctly)."""
+    import concurrent.futures as cf
+
+    from coinstac_dinunet_tpu import native
+
+    paths = []
+    for i in range(33):
+        p = str(tmp_path / f"p{i}.npy")
+        tensorutils.save_arrays(p, [np.full(4, i, np.float32)])
+        paths.append(p)
+    seen = {}
+    real = cf.ThreadPoolExecutor
+
+    class Spy(real):
+        def __init__(self, max_workers=None, **kw):
+            seen["max_workers"] = max_workers
+            super().__init__(max_workers=max_workers, **kw)
+
+    monkeypatch.setattr(cf, "ThreadPoolExecutor", Spy)
+    monkeypatch.setattr(native, "available", lambda: False)
+    out = tensorutils.load_arrays_many(paths)
+    assert seen["max_workers"] == min(33, os.cpu_count() or 8)
+    assert [int(o[0][0]) for o in out] == list(range(33))
+
+
+# ---------------------------------------------------------------------- chaos
+def test_fault_plan_validation():
+    plan = load_fault_plan({"faults": [
+        {"kind": "crash", "round": 3, "site": "site_2"},
+        {"kind": "truncate_payload", "round": 2, "site": "site_0",
+         "file": "grads.npy", "times": 2, "heal_after": 3},
+    ]})
+    assert plan[0].times is None  # crash/hang default: permanent
+    assert plan[1].times == 2 and plan[1].heal_after == 3
+    for bad in (
+        {"faults": [{"kind": "meteor", "round": 1}]},
+        {"faults": [{"kind": "crash", "site": "site_0"}]},  # no round
+        {"faults": [{"kind": "crash", "round": 1}]},  # no site
+        {"faults": [{"kind": "drop_relay", "round": 1}]},  # no file
+        {"nope": True},
+    ):
+        with pytest.raises(ValueError):
+            load_fault_plan(bad)
+
+
+def test_chaos_faults_pin_to_round_and_site():
+    cs = ChaosSession.from_spec(
+        {"faults": [{"kind": "crash", "round": 3, "site": "site_1"}]}
+    )
+    assert cs.invoke_fault(2, "site_1", None) is None  # wrong round
+    assert cs.invoke_fault(3, "site_0", None) is None  # wrong site
+    with pytest.raises(ChaosCrash):
+        cs.invoke_fault(3, "site_1", None)
+    with pytest.raises(ChaosCrash):  # permanent: every retry attempt fires
+        cs.invoke_fault(3, "site_1", None)
+    assert ChaosSession.from_spec(None) is NULL_CHAOS
+
+
+def test_no_fault_plan_overhead_is_bounded():
+    """The fault-free hot path (no plan, default invoke policy) is constant
+    no-op work — bounded like the disabled-telemetry test: 200k hook sites
+    must stay well under a second."""
+    pol = RetryPolicy.for_invoke({})
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        NULL_CHAOS.invoke_fault(1, "site_0", None)
+        NULL_CHAOS.relay_fault(1, "grads.npy", "site_0", None)
+        NULL_CHAOS.payload_faults(1, "site_0", ".", None)
+        pol.should_retry(1, 0.0)
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"no-fault-plan resilience cost {dt:.3f}s for 200k sites"
+
+
+# -------------------------------------------------------- federated scenarios
+def _engine(workdir, fault_plan=None, per_site=16, **extra):
+    eng = InProcessEngine(
+        workdir, n_sites=3, trainer_cls=XorTrainer, dataset_cls=XorDataset,
+        task_id="xor", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+        batch_size=8, epochs=2, validation_epochs=1, learning_rate=5e-2,
+        input_shape=(2,), seed=11, patience=50, fault_plan=fault_plan,
+        **extra,
+    )
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(per_site):
+            with open(os.path.join(d, f"s_{i * per_site + j}"), "w") as f:
+                f.write("x")
+    return eng
+
+
+def _logs(eng):
+    return {k: np.asarray(eng.remote_cache[k], np.float64)
+            for k in ("train_log", "validation_log", "test_metrics")}
+
+
+CRASH_FAULT = {"kind": "crash", "round": 5, "site": "site_2"}
+
+
+def test_chaos_acceptance_corruption_recovered_crash_quorum_dropped(tmp_path):
+    """The ISSUE 5 acceptance scenario: 3 sites, one payload corrupted at
+    round 3 (recovered via wire retry — bit-identical after heal), one site
+    crashed permanently at round 5 (quorum-dropped only after the invoke
+    retries exhaust).  The run completes and its entire score trajectory
+    equals the survivor-weighted golden run (same crash, no corruption) —
+    recovery is mathematically invisible."""
+    plan = {"faults": [
+        {"kind": "corrupt_payload", "round": 3, "site": "site_1",
+         "file": "grads.npy"},
+        CRASH_FAULT,
+    ]}
+    eng = _engine(tmp_path / "chaos", fault_plan=plan, site_quorum=2,
+                  invoke_retry_attempts=2, profile=True)
+    eng.run(max_rounds=300)
+    assert eng.success and eng.dead_sites == {"site_2"}
+    assert eng.remote_cache.get("dropped_sites") == ["site_2"]
+
+    events = load_events(str(tmp_path / "chaos"))
+    names = [e["name"] for e in events if e.get("kind") == "event"]
+    assert "wire:retry" in names
+    assert "wire:corruption_recovered" in names
+    assert "invoke:retry" in names
+    died = [e for e in events if e.get("name") == "site_died"]
+    assert died and died[0]["site"] == "site_2"
+    assert died[0]["attempts"] == 2 and died[0]["retries_exhausted"]
+
+    # survivor-weighted golden: identical crash, no corruption fault
+    golden = _engine(tmp_path / "golden", fault_plan={"faults": [CRASH_FAULT]},
+                     site_quorum=2, invoke_retry_attempts=2)
+    golden.run(max_rounds=300)
+    assert golden.success and golden.dead_sites == {"site_2"}
+    got, want = _logs(eng), _logs(golden)
+    for key in got:
+        assert got[key].shape == want[key].shape, key
+        np.testing.assert_allclose(got[key], want[key], atol=1e-6,
+                                   err_msg=key)
+
+    # the doctor attributes both injected faults and the retry exhaustion
+    report = build_report(events)
+    assert {c["kind"] for c in report["chaos"]} == {"corrupt_payload", "crash"}
+    assert report["dead_sites"]["site_2"]["retries_exhausted"]
+    md = render_markdown(report)
+    assert "corrupt_payload" in md and "crash" in md
+    assert "retries exhausted" in md
+
+
+def test_transient_crash_recovered_by_invoke_retry(tmp_path):
+    """A crash that heals after one firing (times=1) + a 2-attempt invoke
+    policy: the site SURVIVES, nothing is quorum-dropped, and the run
+    matches the fault-free golden run exactly (the retried invocation is a
+    clean re-run — chaos fires before any node state mutates)."""
+    plan = {"faults": [
+        {"kind": "crash", "round": 4, "site": "site_1", "times": 1},
+    ]}
+    eng = _engine(tmp_path / "transient", fault_plan=plan, site_quorum=2,
+                  invoke_retry_attempts=2, profile=True)
+    eng.run(max_rounds=300)
+    assert eng.success and eng.dead_sites == set()
+    events = load_events(str(tmp_path / "transient"))
+    retries = [e for e in events if e.get("name") == "invoke:retry"]
+    assert retries and retries[0]["target"] == "site_1"
+
+    golden = _engine(tmp_path / "nofault")
+    golden.run(max_rounds=300)
+    got, want = _logs(eng), _logs(golden)
+    for key in got:
+        np.testing.assert_allclose(got[key], want[key], atol=1e-6,
+                                   err_msg=key)
+
+
+def test_drop_relay_and_duplicate_delivery_recovered(tmp_path):
+    """Relay faults in all three observable shapes recover via wire retry:
+    a FIRST broadcast dropped (file absent, manifest names it), a LATER
+    broadcast dropped (the previous round's payload is still on disk — the
+    stale copy self-validates, so only the manifest CRC cross-check can
+    catch it), and an out-of-order duplicate clobbering a fresh delivery
+    with stale bytes.  No site dies and the run matches the fault-free
+    golden run — stale data is never silently consumed."""
+    plan = {"faults": [
+        {"kind": "drop_relay", "round": 2, "site": "site_0",
+         "file": "avg_grads.npy"},
+        {"kind": "drop_relay", "round": 3, "site": "site_2",
+         "file": "avg_grads.npy"},
+        {"kind": "duplicate_delivery", "round": 3, "site": "site_1",
+         "file": "avg_grads.npy"},
+    ]}
+    eng = _engine(tmp_path / "relay", fault_plan=plan, site_quorum=2,
+                  profile=True)
+    eng.run(max_rounds=300)
+    assert eng.success and eng.dead_sites == set()
+    events = load_events(str(tmp_path / "relay"))
+    injected = {(e.get("fault"), e.get("site"))
+                for e in events if e.get("name") == "chaos:inject"}
+    assert ("drop_relay", "site_0") in injected
+    assert ("drop_relay", "site_2") in injected
+    assert ("duplicate_delivery", "site_1") in injected
+    recovered = [e for e in events
+                 if e.get("name") == "wire:corruption_recovered"]
+    assert len(recovered) >= 3, recovered  # each damaged reader recovered
+
+    golden = _engine(tmp_path / "relay_golden")
+    golden.run(max_rounds=300)
+    got, want = _logs(eng), _logs(golden)
+    for key in got:
+        np.testing.assert_allclose(got[key], want[key], atol=1e-6,
+                                   err_msg=key)
+
+
+def test_invoke_retry_policy_is_scoped_per_site(tmp_path):
+    """A retry opt-in scoped to one site must never leak to another (the
+    operator opts into re-invocation side effects per site); the remote
+    scans every channel because its config can only arrive via a site's
+    channels before round 1."""
+    eng = InProcessEngine(
+        tmp_path, n_sites=2,
+        site_args={"site_1": {"invoke_retry_attempts": 3}},
+    )
+    assert eng._invoke_policy("site_0").attempts == 1
+    assert eng._invoke_policy("site_1").attempts == 3
+    assert eng._invoke_policy("remote").attempts == 3
+
+
+def test_subprocess_invoke_retry_with_flaky_script(tmp_path):
+    """SubprocessEngine's invocation retry: a node process that dies on its
+    first run and succeeds on the second is recovered by the retry policy
+    (the flake marker makes the failure deterministic)."""
+    marker = tmp_path / "flaked_once"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import json, os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').write('x'); sys.exit(3)\n"
+        "json.loads(sys.stdin.read())\n"
+        "print(json.dumps({'output': {'ok': True}, 'cache': {}}))\n"
+    )
+    eng = SubprocessEngine(
+        tmp_path / "run", n_sites=1, local_script=str(script),
+        remote_script=str(script),
+    )
+    policy = RetryPolicy(attempts=2, base_delay=0.0)
+    rec = telemetry.NULL_RECORDER
+    res = eng._invoke_with_retry(
+        policy, lambda: eng._invoke(str(script), {"input": {}}), "site_0", rec
+    )
+    assert res["output"] == {"ok": True} and policy.last_attempts == 2
+
+    # exhausted: the wrapped error names the attempts for attribution
+    os.unlink(marker)
+    script.write_text("import sys; sys.exit(3)\n")
+    with pytest.raises(RetryExhausted) as ei:
+        eng._invoke_with_retry(
+            policy, lambda: eng._invoke(str(script), {"input": {}}),
+            "site_0", rec,
+        )
+    assert ei.value.attempts == 2
