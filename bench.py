@@ -531,9 +531,37 @@ def main():
     width = 8 if fast else 16
     steps = 5 if fast else 60
 
+    # BENCH_r03–r05 diagnosis: jax.devices() can hang >900 s in-process when
+    # the relayed TPU pool is wedged.  Probe the backend in a throwaway
+    # interpreter first (hard timeout, CPU fallback) so this run records a
+    # typed backend_init_failed result instead of silently timing out.
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    from _bench_util import ensure_warm_backend
+
+    probe = ensure_warm_backend(
+        timeout=int(os.environ.get("COINN_BENCH_BACKEND_TIMEOUT", "240"))
+    )
+    if not probe.get("ok"):
+        print(json.dumps({
+            "metric": "vbm3d_cnn_samples_per_sec_per_chip",
+            "value": None,
+            "unit": "samples/sec/chip",
+            "error": probe.get("error", "backend_init_failed"),
+            "backend_probe": probe,
+        }))
+        return
+    if probe.get("fallback"):
+        print(f"# default backend failed to init "
+              f"({probe['default_backend_error'].get('error')}); benching on "
+              f"{probe['backend']}", file=sys.stderr)
+
+    # belt for the in-process init: the probe warmed a SEPARATE process, so
+    # a pool that admits probes but wedges real clients still gets caught
     guard = _watchdog(900, "backend init (jax.devices)")
     import jax
 
+    if probe.get("fallback"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     n_dev = len(jax.devices())
     guard.set()
     peak = _peak_flops()
@@ -588,6 +616,7 @@ def main():
         "achieved_tflops": flagship.get("achieved_tflops"),
         "peak_tflops": round(peak / 1e12, 1) if peak else None,
         "devices": n_dev,
+        "backend_probe": probe,
         "input_shape": list(shape),
         "batch_size": batch,
         "configs": configs,

@@ -13,6 +13,10 @@ compiled kernels:
 - **engine transport** — the reference-compatible file+JSON protocol driven by
   an external engine or the bundled in-process simulator
   (:class:`~.engine.InProcessEngine`).
+- **site-vectorized transport** — thousands of simulated sites batched
+  under ONE jit along a stacked ``site`` axis
+  (:class:`~.federation.SiteVectorizedFederation` /
+  :class:`~.federation.SiteVectorizedEngine`; docs/FEDERATION.md).
 
 Top-level exports mirror the reference package surface
 (``coinstac_dinunet/__init__.py:11-14``) plus the TPU-native additions.
@@ -22,6 +26,7 @@ __version__ = "0.1.0"
 from .config import keys  # noqa: F401
 from .data import COINNDataHandle, COINNDataset  # noqa: F401
 from .engine import InProcessEngine, SiteRunner  # noqa: F401
+from .federation import SiteVectorizedEngine, SiteVectorizedFederation  # noqa: F401
 from .metrics import (  # noqa: F401
     AUCROCMetrics,
     COINNAverages,
@@ -45,6 +50,8 @@ __all__ = [
     "COINNTrainer",
     "NNTrainer",
     "MeshFederation",
+    "SiteVectorizedFederation",
+    "SiteVectorizedEngine",
     "InProcessEngine",
     "SiteRunner",
     "COINNMetrics",

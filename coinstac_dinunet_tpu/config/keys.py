@@ -251,6 +251,33 @@ class Retry:
     ASYNC_WIRE_COMMIT = "async_wire_commit"
 
 
+class Federation:
+    """Cache-key vocabulary for the mega-federation scale layer
+    (:mod:`coinstac_dinunet_tpu.federation` + the hierarchical tree-reduce
+    in :mod:`~..parallel.reducer`).
+
+    Plain ``str`` constants, mirroring :class:`Retry`: each names the cache
+    key that configures one knob of the 10³–10⁴-site scale path.
+
+    - ``REDUCE_FANIN`` — k-ary fan-in of the aggregator's hierarchical
+      tree-reduce (``parallel/reducer.py``).  Unset/0 keeps the flat
+      stacked mean; ``k >= 2`` streams site payloads in groups of ``k``,
+      committing partial aggregates through the atomic wire transport so
+      the aggregator never materializes all ``n_sites`` payloads at once.
+      Weighted partial sums + weight totals compose associatively across
+      tree levels and are normalized ONCE at the root, so the result
+      equals the flat :func:`~..parallel.reducer._guarded_mean` to fp
+      tolerance (property-tested in ``tests/test_federation.py``).
+    - ``SITE_SHARDS`` — device count the site-vectorized engine shards its
+      stacked ``MeshAxis.SITE`` axis over (``federation/vector.py``).
+      Default: every local device when it divides ``n_sites``, else 1
+      (pure vmap).
+    """
+
+    REDUCE_FANIN = "reduce_fanin"
+    SITE_SHARDS = "site_shards"
+
+
 # Keys a node reads from ``input`` that the ENGINE/compspec injects on the
 # first invocation (not part of the local↔remote handshake); the
 # protocol-conformance rule treats reads of these as engine-provided rather

@@ -101,8 +101,11 @@ def load_nifti(path, dtype=None):
     arr = np.frombuffer(raw, dtype=base, count=n, offset=vox_offset)
     # NIfTI is column-major (Fortran order) on disk
     arr = arr.reshape(shape, order="F")
-    if slope not in (0.0, 1.0) or inter != 0.0:
-        arr = arr * np.float32(slope if slope != 0.0 else 1.0) + np.float32(inter)
+    # NIfTI-1 spec: scl_slope == 0 means NO scaling at all (scl_inter is
+    # ignored too) — matching nibabel, so the same file loads identically
+    # whether or not nibabel is installed (the API-independence contract)
+    if slope != 0.0 and (slope != 1.0 or inter != 0.0):
+        arr = arr * np.float32(slope) + np.float32(inter)
     if dtype is None:
         dtype = np.float32 if arr.dtype.kind == "f" else arr.dtype
     return np.ascontiguousarray(arr, dtype=dtype)

@@ -69,6 +69,26 @@ def load_inputspec(path, site_index=None):
     return sites[site_index]
 
 
+def _engine_recorder(eng, chans):
+    """Shared engine-lane recorder resolution (``telemetry.engine.jsonl``
+    in the workdir): enabled when any of the engine's arg channels carries
+    ``profile``/``telemetry`` — the same flags that enable the node-side
+    recorders.  Re-checks cheaply until enabled (fresh-process engines only
+    learn the flag from round 1's cache); caches the live recorder on the
+    engine once built."""
+    rec = getattr(eng, "_telemetry_rec", None)
+    if rec is not None:
+        return rec
+
+    def on(d):
+        return isinstance(d, dict) and (d.get("profile") or d.get("telemetry"))
+
+    if any(on(c) for c in chans):
+        eng._telemetry_rec = telemetry.Recorder("engine", out_dir=eng.workdir)
+        return eng._telemetry_rec
+    return telemetry.NULL_RECORDER
+
+
 class InProcessEngine:
     """Runs N site nodes + one aggregator, relaying outputs and files.
 
@@ -146,27 +166,14 @@ class InProcessEngine:
 
     # ------------------------------------------------------------- telemetry
     def _recorder(self):
-        """The engine driver's own timeline lane (``telemetry.engine.jsonl``
-        in the workdir): per-round spans around every node invocation and
-        the file relay, so the merged Perfetto view shows where a federated
-        round's wall-clock actually goes.  Enabled when any arg channel
-        carries ``profile``/``telemetry`` (the same flags that enable the
-        node-side recorders); re-checks cheaply until enabled because
-        fresh-process engines only learn the flag from round 1's cache."""
-        rec = getattr(self, "_telemetry_rec", None)
-        if rec is not None:
-            return rec
-
-        def on(d):
-            return isinstance(d, dict) and (d.get("profile") or d.get("telemetry"))
-
+        """The engine driver's own timeline lane: per-round spans around
+        every node invocation and the file relay, so the merged Perfetto
+        view shows where a federated round's wall-clock actually goes.
+        See :func:`_engine_recorder` for the enable contract."""
         chans = [self.args, *self.site_args.values(), *self.site_spec.values(),
                  *self.site_caches.values()]
         chans += list(getattr(self, "first_input", {}).values() or [])
-        if any(on(c) for c in chans):
-            self._telemetry_rec = telemetry.Recorder("engine", out_dir=self.workdir)
-            return self._telemetry_rec
-        return telemetry.NULL_RECORDER
+        return _engine_recorder(self, chans)
 
     # --------------------------------------------------------- site dropout
     def _alive_site_ids(self):
@@ -592,6 +599,11 @@ class MeshEngine:
         self.success = False
         self.results_zip = None
         self._trainer = None
+        # sites excluded from every subsequent round (their train batches
+        # and eval loaders degrade to fully-masked placeholders — the same
+        # zero-participation path an empty-data site takes).  Empty here;
+        # populated by subclasses with a dropout story (federation/engine).
+        self.dead_sites = set()
 
     def site_data_dir(self, site_id, data_dir=None):
         d = os.path.join(
@@ -722,8 +734,6 @@ class MeshEngine:
         return epoch
 
     def _run_fold(self, split_ix, handles):
-        from .parallel.mesh import MeshFederation
-
         rc = self.cache
         for s in self.site_ids:
             sc = self.site_caches[s]
@@ -749,6 +759,18 @@ class MeshEngine:
         trainer.init_nn()
         self._trainer = trainer
         self._mesh_pretrain(trainer, handles)
+        fed = self._build_federation(rc)
+        self._last_fed = fed
+        self._run_fold_loop(split_ix, handles, trainer, fed, rc)
+
+    def _build_federation(self, rc):
+        """Construct this fold's federation transport — the hook the
+        site-vectorized engine (:mod:`.federation.engine`) overrides to swap
+        the per-rank mesh for the stacked-site vmap/shard_map plane while
+        the whole lifecycle above stays shared."""
+        from .parallel.mesh import MeshFederation
+
+        trainer = self._trainer
         sp = int(rc.get("sequence_parallel", 1) or 1)
         tp = int(rc.get("tensor_parallel", 1) or 1)
         if sp > 1 and tp > 1:
@@ -768,12 +790,12 @@ class MeshEngine:
                 )
             from .parallel.seq_mesh import SeqMeshFederation
 
-            fed = SeqMeshFederation(
+            return SeqMeshFederation(
                 trainer, self.n_sites, sp=sp,
                 agg_engine=str(rc.get("agg_engine", "dSGD")),
                 devices=self.devices,
             )
-        elif tp > 1:
+        if tp > 1:
             # intra-site axis shards the model's heavy matmuls (Megatron
             # col/row parallelism) — the trainer must implement iteration_tp
             if self.devices_per_site not in (None, tp):
@@ -785,19 +807,26 @@ class MeshEngine:
                 )
             from .parallel.tp_mesh import TPMeshFederation
 
-            fed = TPMeshFederation(
+            return TPMeshFederation(
                 trainer, self.n_sites, tp=tp,
                 agg_engine=str(rc.get("agg_engine", "dSGD")),
                 devices=self.devices,
             )
-        else:
-            fed = MeshFederation(
-                trainer, self.n_sites,
-                agg_engine=str(rc.get("agg_engine", "dSGD")),
-                devices=self.devices, devices_per_site=self.devices_per_site,
-            )
-        self._last_fed = fed
+        return MeshFederation(
+            trainer, self.n_sites,
+            agg_engine=str(rc.get("agg_engine", "dSGD")),
+            devices=self.devices, devices_per_site=self.devices_per_site,
+        )
 
+    def _round_hook(self, site_batches):
+        """Per-round boundary before the compiled federated step — the hook
+        subclasses with a per-site dropout/chaos story override (the
+        site-vectorized engine injects invoke faults and masks dead sites
+        here).  Default: pass-through."""
+        return site_batches
+
+    def _run_fold_loop(self, split_ix, handles, trainer, fed, rc):
+        log_dir = rc["log_dir"]
         bs = int(rc.get("batch_size", 16))
         train_sets = {s: handles[s].get_train_dataset() for s in self.site_ids}
         if not any(len(ds) for ds in train_sets.values()):
@@ -830,7 +859,8 @@ class MeshEngine:
                     "train", dataset=train_sets[s], shuffle=True,
                     seed=int(rc.get("seed", 0)), epoch=epoch - 1,
                     target_batches=target_batches,
-                )) if len(train_sets[s]) else None)
+                )) if len(train_sets[s]) and s not in self.dead_sites
+                 else None)
                 for s in self.site_ids
             ]
             done = 0
@@ -847,7 +877,7 @@ class MeshEngine:
                             {**tb, "_mask": np.zeros_like(np.asarray(tb["_mask"]))}
                             for tb in template
                         ]
-                aux = fed.train_step(site_batches)
+                aux = fed.train_step(self._round_hook(site_batches))
                 trainer.fold_train_outputs(aux, ep_averages, ep_metrics)
                 done += take
             if epoch % val_every != 0:
@@ -993,7 +1023,7 @@ class MeshEngine:
         loaders = {
             s: (iter(handles[s].get_loader(
                 which, dataset=datasets[s], shuffle=False, target_batches=nb))
-                if len(datasets[s]) else None)
+                if len(datasets[s]) and s not in self.dead_sites else None)
             for s in self.site_ids
         }
         for _ in range(nb):

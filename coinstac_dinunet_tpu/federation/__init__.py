@@ -1,0 +1,32 @@
+"""federation/ — the mega-federation scale layer (10³–10⁴ simulated sites).
+
+The paper's engine model invokes sites serially and its aggregator loads
+every site payload at once — both walls at production scale.  This package
+is the scale inversion (ROADMAP item 1):
+
+- :mod:`.vector` — :class:`SiteVectorizedFederation`: B simulated sites'
+  local steps + the cross-site reduce as ONE jit, the stacked site
+  dimension on ``MeshAxis.SITE`` (vmap per device block, ``shard_map``
+  across blocks — the Podracer/Anakin shape, PAPERS.md arXiv:2104.06272).
+  Params stay shared; opt/rng/step stack per site.
+- :mod:`.engine` — :class:`SiteVectorizedEngine`: the full MeshEngine
+  lifecycle over that plane, with chaos invoke faults + the
+  ``site_quorum`` dropout contract restored at the per-site round
+  boundary.
+- the file-wire side lives in :mod:`~..parallel.reducer`: the k-ary
+  hierarchical tree-reduce (``cache['reduce_fanin']``) streams the
+  aggregator fan-in through the atomic transport instead of
+  materializing all ``n_sites`` payloads.
+
+Benchmark: ``scripts/bench_federation.py`` (headline: rounds/sec at 1,000
+simulated sites, ledgered for ``telemetry doctor`` regression verdicts).
+See docs/FEDERATION.md for the operator guide.
+"""
+from .engine import SiteVectorizedEngine  # noqa: F401
+from .vector import SiteVectorizedFederation, resolve_site_shards  # noqa: F401
+
+__all__ = [
+    "SiteVectorizedEngine",
+    "SiteVectorizedFederation",
+    "resolve_site_shards",
+]

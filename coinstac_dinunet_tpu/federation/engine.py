@@ -1,0 +1,149 @@
+"""SiteVectorizedEngine — the mega-federation lifecycle driver.
+
+Runs the full :class:`~..engine.MeshEngine` federated lifecycle (folds,
+lockstep epochs, validation cadence, exact cross-site count-merge, best
+checkpoints, early stop, results zip) with the gradient plane swapped for
+:class:`~.vector.SiteVectorizedFederation` — B simulated sites per compiled
+step, no device-count ceiling — and the resilience surface of
+:class:`~..engine.InProcessEngine` restored at the per-site round boundary:
+
+- **chaos invoke faults** (``fault_plan=``, :mod:`~..resilience.chaos`)
+  fire per round + site exactly like the serial engines'; a crash/hang
+  marks the site dead.  There is no per-site invocation to retry (the
+  round is one jit), so a crash is immediately a dropout — transient
+  faults that the serial engines recover via invoke retry kill the site
+  here, which is the honest semantic of a vectorized plane.
+- **site_quorum dropout contract**: without ``site_quorum`` a dead site
+  fails the run loudly (all-site lockstep); with it, the dead site's
+  batches degrade to fully-masked placeholders — weight 0 in the in-jit
+  reduce, excluded from eval — so aggregates are survivor-weighted with
+  exactly the reducer's math, and quorum is judged against the ORIGINAL
+  roster with the same integral-count/fraction normalization as
+  :meth:`~..nodes.remote.COINNRemote._quorum_need`.
+- **telemetry**: an ``engine`` lane records per-round spans, ``site_died``
+  events (doctor-attributable) and quorum decisions when any arg channel
+  carries ``profile``/``telemetry``.
+
+At ISSUE-6 scale this is the "kill 5% of 2,000 sites" story:
+:func:`~..resilience.chaos.fraction_kill_plan` builds the deterministic
+plan, this engine absorbs the deaths, and the stacked step never changes
+shape (dead sites ride along fully masked).
+"""
+import numpy as np
+
+from ..engine import MeshEngine
+from ..nodes.remote import COINNRemote
+from ..resilience.chaos import ChaosFault, ChaosSession
+from ..utils import logger
+from .vector import SiteVectorizedFederation
+
+
+class SiteVectorizedEngine(MeshEngine):
+    """Full federated lifecycle over the site-vectorized gradient plane."""
+
+    def __init__(self, workdir, n_sites, fault_plan=None, site_shards=None,
+                 **kw):
+        kw.pop("devices_per_site", None)  # no per-site device rank here
+        super().__init__(workdir, n_sites, **kw)
+        self.chaos = ChaosSession.from_spec(fault_plan)
+        self.site_shards = site_shards
+        self.rounds = 0
+        self.site_failures = {}
+
+    # ------------------------------------------------------------- telemetry
+    def _recorder(self):
+        """Engine-lane recorder (``telemetry.engine.jsonl`` in the workdir),
+        enabled by the same ``profile``/``telemetry`` flags as the node-side
+        recorders (shared resolution: :func:`~..engine._engine_recorder`)."""
+        from ..engine import _engine_recorder
+
+        return _engine_recorder(self, [self.cache, *self.site_args.values()])
+
+    # ------------------------------------------------------ federation plane
+    def _build_federation(self, rc):
+        sp = int(rc.get("sequence_parallel", 1) or 1)
+        tp = int(rc.get("tensor_parallel", 1) or 1)
+        if sp > 1 or tp > 1:
+            raise ValueError(
+                "SiteVectorizedEngine vectorizes the SITE axis only; "
+                f"sequence_parallel={sp}/tensor_parallel={tp} need the "
+                "per-rank MeshEngine"
+            )
+        return SiteVectorizedFederation(
+            self._trainer, self.n_sites,
+            agg_engine=str(rc.get("agg_engine", "dSGD")),
+            devices=self.devices, site_shards=self.site_shards,
+        )
+
+    # --------------------------------------------------------- site dropout
+    def _site_failure(self, s, exc):
+        """A chaos fault killed site ``s`` this round.  Without
+        ``site_quorum`` the failure propagates (all-site lockstep); with it
+        the site is dead from this round on — survivor-weighted semantics,
+        judged against the original roster."""
+        quorum = self.cache.get("site_quorum")
+        if not quorum:
+            raise exc
+        self.dead_sites.add(s)
+        self.site_failures[s] = f"{type(exc).__name__}: {exc}"
+        self._recorder().event(
+            "site_died", cat="quorum", site=s, error=self.site_failures[s],
+            attempts=1, retries_exhausted=False,
+        )
+        logger.warn(
+            f"site {s} died at round {self.rounds} "
+            f"({self.site_failures[s]}); excluded from the remaining rounds "
+            "(site_quorum set)"
+        )
+        alive = [x for x in self.site_ids if x not in self.dead_sites]
+        need = max(COINNRemote._quorum_need(quorum, self.n_sites), 1)
+        if len(alive) < need:
+            self._recorder().event(
+                "quorum:fail", cat="quorum", reason="quorum unmet",
+                alive=alive, need=need,
+                dropped=sorted(self.dead_sites),
+            )
+            raise RuntimeError(
+                f"quorum unmet: {len(alive)} sites alive, quorum {quorum} "
+                f"of {self.n_sites} requires >= {need}; dead: "
+                f"{sorted(self.dead_sites)}"
+            )
+        self._recorder().event(
+            "quorum:continue", cat="quorum", alive=alive,
+            dropped=sorted(self.dead_sites),
+        )
+
+    def _round_hook(self, site_batches):
+        """The per-site round boundary of the vectorized plane: chaos
+        invoke faults fire here, and dead sites' batches degrade to
+        fully-masked placeholders (weight 0 in the compiled reduce) so the
+        stacked step never changes shape."""
+        self.rounds += 1
+        rec = self._recorder()
+        rec.set_context(round=self.rounds)
+        try:
+            for s in self.site_ids:
+                if s in self.dead_sites:
+                    continue
+                try:
+                    self.chaos.invoke_fault(self.rounds, s, rec)
+                except ChaosFault as exc:
+                    self._site_failure(s, exc)
+            if len(self.dead_sites) >= len(self.site_ids):
+                raise RuntimeError(
+                    f"every site died; failures: {self.site_failures}"
+                )
+        finally:
+            # unlike the serial engines there is no per-round node flush, so
+            # the engine lane flushes here — including on a quorum-unmet
+            # abort, where the site_died/quorum events ARE the postmortem
+            rec.flush()
+        if self.dead_sites:
+            for i, s in enumerate(self.site_ids):
+                if s in self.dead_sites and site_batches[i] is not None:
+                    site_batches[i] = [
+                        {**b,
+                         "_mask": np.zeros_like(np.asarray(b["_mask"]))}
+                        for b in site_batches[i]
+                    ]
+        return site_batches

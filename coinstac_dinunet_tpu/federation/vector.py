@@ -1,0 +1,330 @@
+"""Site-vectorized federation — thousands of simulated sites as ONE jit.
+
+The serial engine transport invokes every site one at a time (one jit
+dispatch + one wire payload per site per round), and even the mesh
+transport (:mod:`~..parallel.mesh`) needs a physical device rank per site —
+neither survives the ROADMAP's 10³–10⁴-site production regime.  This module
+applies the Podracer/Anakin batching shape (PAPERS.md arXiv:2104.06272):
+**many logically-independent site workers vectorized under one compiled
+step**, with the stacked site dimension living on the ``MeshAxis.SITE``
+axis and optionally sharded across the host's devices via ``shard_map``.
+
+State layout (the site-vectorization memory contract):
+
+- ``params`` — UNTOUCHED, one shared copy: dSGD's identical init +
+  identical averaged update keeps every site's parameters bitwise equal
+  (the replication invariant of ``parallel/mesh.py``), so stacking them
+  B× would buy nothing and cost everything at scale.
+- ``opt_state`` / ``rng`` / ``step`` — stacked along a leading
+  ``MeshAxis.SITE`` axis: each simulated site carries its own optimizer
+  state, carried rng stream, and step counter, so per-site divergence
+  (future capacity weighting, per-site schedules) has a place to live.
+  Under dSGD they advance in lockstep on the same averaged gradients,
+  which keeps the stack replicated-by-construction — the invariant
+  :meth:`SiteVectorizedFederation.train_step` relies on when it applies
+  row 0's update to the shared params and when resume rebuilds the stack
+  by tiling the trainer's state.
+- metrics / averages / participation weights — per-site inside the step,
+  reduced exactly like the mesh transport (psum ≙ axis-0 sum).
+
+The cross-site gradient average inside the step is a 2-level hierarchical
+reduce when the site axis is device-sharded: weighted partial sums within
+each device's site block, one ``psum`` across the ``site`` axis, a single
+normalization — the in-jit mirror of the file-wire tree-reduce in
+:mod:`~..parallel.reducer`.
+
+Semantics match :class:`~..parallel.mesh.MeshFederation` exactly where the
+math is shared: same per-site forward-rng derivation
+(``fold_in(carried, site_index)``), same identically-advancing carried rng,
+same participation weighting (a fully-masked site contributes nothing and
+leaves the denominator), same aux reduction — so the vectorized engine's
+score trajectory equals the file/mesh transports' on the same data + seed
+(``tests/test_federation.py``).
+"""
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..config.keys import Federation, MeshAxis
+from ..nn.basetrainer import TrainState
+from ..parallel.mesh import build_site_only_mesh
+from ..utils.jax_compat import shard_map
+
+
+def resolve_site_shards(n_sites, requested=None, devices=None):
+    """Device count the stacked SITE axis shards over: the explicit request
+    (``Federation.SITE_SHARDS``), else every local device when it divides
+    ``n_sites`` evenly, else 1 (pure vmap on one device)."""
+    n_dev = len(devices) if devices is not None else jax.device_count()
+    if requested:
+        requested = int(requested)
+        if n_sites % requested:
+            raise ValueError(
+                f"site_shards={requested} must divide n_sites={n_sites} "
+                "(the stacked site axis shards evenly or not at all)"
+            )
+        return requested
+    return n_dev if (n_dev > 1 and n_sites % n_dev == 0) else 1
+
+
+class SiteVectorizedFederation:
+    """B simulated sites' local steps + the cross-site reduce as one jit.
+
+    Drop-in for :class:`~..parallel.mesh.MeshFederation`'s transport
+    interface (``train_step`` / ``eval_step`` / ``serialize_comm_state`` /
+    ``restore_comm_state``), with no device-count ceiling on ``n_sites``.
+    """
+
+    SUPPORTED_ENGINES = ("dSGD",)
+
+    def __init__(self, trainer, n_sites, agg_engine="dSGD", devices=None,
+                 site_shards=None):
+        self.trainer = trainer
+        self.n_sites = int(n_sites)
+        self.agg_engine = str(agg_engine)
+        if self.agg_engine not in self.SUPPORTED_ENGINES:
+            raise ValueError(
+                f"agg_engine {self.agg_engine!r} is not supported on the "
+                f"site-vectorized transport (supported: "
+                f"{self.SUPPORTED_ENGINES}); use MeshFederation or the "
+                "engine transport — refusing to silently change the "
+                "algorithm"
+            )
+        if site_shards is None and trainer is not None:
+            site_shards = trainer.cache.get(Federation.SITE_SHARDS)
+        self.shards = resolve_site_shards(self.n_sites, site_shards, devices)
+        self.mesh = (build_site_only_mesh(self.shards, devices)
+                     if self.shards > 1 else None)
+        self._site_ix = jnp.arange(self.n_sites, dtype=jnp.int32)
+        self._site_state = None  # stacked {"opt", "rng", "step"}
+        self._step = None
+        self._eval = None
+        self.rounds_done = 0
+
+    # ---------------------------------------------------------- site stacking
+    def _stacked_site_state(self):
+        """Tile the trainer's (replicated-by-construction) opt/rng/step into
+        the leading-SITE-axis stack every simulated site advances."""
+        ts = self.trainer.train_state
+
+        def tile(x):
+            x = jnp.asarray(x)
+            return jnp.tile(x[None], (self.n_sites,) + (1,) * x.ndim)
+
+        return jax.tree_util.tree_map(
+            tile, {"opt": ts.opt_state, "rng": ts.rng, "step": ts.step}
+        )
+
+    def _place(self, tree, spec):
+        if self.mesh is None:
+            return tree
+        sharding = NamedSharding(self.mesh, spec)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), tree
+        )
+
+    def stack_site_batches(self, per_site_batches):
+        """[site → list of k micro-batches] → pytree with leading (site, k)
+        axes, site-sharded across the shards when the mesh is live."""
+        stacked = [self.trainer._stack_batches(b) for b in per_site_batches]
+        glob = {k: jnp.stack([s[k] for s in stacked]) for k in stacked[0]}
+        return self._place(glob, P(MeshAxis.SITE))
+
+    # ------------------------------------------------------------ train step
+    def _build_step(self):
+        trainer = self.trainer
+        metrics_shell, averages_shell = trainer._metrics_shell()
+        n_sites = self.n_sites
+        sharded = self.mesh is not None
+
+        # the whole federated round for a block of sites: vmapped local
+        # steps, hierarchical weighted reduce, per-site optimizer advance
+        def one_site(params, rng, step, six, batch):
+            # per-site decorrelated forward rng; the carried rng advances
+            # identically at every site (mesh-transport parity)
+            ts = TrainState(params=params, opt_state=None, step=step,
+                            rng=jax.random.fold_in(rng, six))
+            grads, aux = trainer._grads_uncompiled(
+                ts, batch, metrics_shell, averages_shell
+            )
+            mask = batch.get("_mask")
+            w = ((jnp.sum(jnp.asarray(mask, jnp.float32)) > 0)
+                 .astype(jnp.float32) if mask is not None else jnp.float32(1))
+            aux = dict(aux)
+            aux["rng"] = jax.random.split(rng)[0]
+            return grads, aux, w
+
+        def block(params, site_state, site_ix, stacked):
+            grads, aux, w = jax.vmap(
+                one_site, in_axes=(None, 0, 0, 0, 0)
+            )(params, site_state["rng"], site_state["step"], site_ix, stacked)
+            # hierarchical reduce: weighted partial sums within this
+            # device's site block, one psum across the SITE shards, one
+            # normalization — the in-jit 2-level tree
+            wpart = jnp.sum(w)
+            gpart = jax.tree_util.tree_map(
+                lambda g: jnp.tensordot(w, g, axes=(0, 0)), grads
+            )
+            if sharded:
+                wsum = jax.lax.psum(wpart, MeshAxis.SITE)
+                gpart = jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, MeshAxis.SITE), gpart
+                )
+            else:
+                wsum = wpart
+            denom = jnp.maximum(wsum, 1.0)
+            avg = jax.tree_util.tree_map(lambda g: g / denom, gpart)
+
+            # per-site apply: every stacked optimizer state advances on the
+            # SAME averaged gradients (replicated-by-construction), and the
+            # shared params take row 0's update
+            def site_update(opt_state):
+                upd, new_opt = {}, {}
+                for name in params:
+                    upd[name], new_opt[name] = trainer.optimizer[name].update(
+                        avg[name], opt_state[name], params[name]
+                    )
+                return upd, new_opt
+            upds, new_opt = jax.vmap(site_update)(site_state["opt"])
+            first = jax.tree_util.tree_map(lambda u: u[0], upds)
+            new_params = {
+                name: optax.apply_updates(params[name], first[name])
+                for name in params
+            }
+            new_site = {"opt": new_opt, "rng": aux.pop("rng"),
+                        "step": site_state["step"] + 1}
+
+            # aux reduction (mesh parity: psum over sites ≙ axis-0 sum)
+            def site_sum(x):
+                x = jnp.sum(x, axis=0)
+                return jax.lax.psum(x, MeshAxis.SITE) if sharded else x
+
+            if aux.get("metrics") is not None:
+                aux["metrics"] = jax.tree_util.tree_map(
+                    site_sum, aux["metrics"]
+                )
+            aux["averages"] = jax.tree_util.tree_map(
+                site_sum, aux["averages"]
+            )
+            aux["loss"] = site_sum(aux["loss"]) / n_sites
+            if "host_scores" in aux:
+                def gather(x):  # (S_local, k, B, ...) → (S·k, B, ...)
+                    x = x.reshape((-1,) + x.shape[2:])
+                    return (jax.lax.all_gather(
+                        x, MeshAxis.SITE, axis=0, tiled=True
+                    ) if sharded else x)
+                aux["host_scores"] = jax.tree_util.tree_map(
+                    gather, aux["host_scores"]
+                )
+            aux["rng"] = new_site["rng"][0]
+            return new_params, new_site, aux
+
+        if not sharded:
+            return jax.jit(block)
+        site_spec = P(MeshAxis.SITE)
+        return jax.jit(shard_map(
+            block, mesh=self.mesh,
+            in_specs=(P(), site_spec, site_spec, site_spec),
+            out_specs=(P(), site_spec, P()),
+            check_vma=False,
+        ))
+
+    def train_step(self, site_batches):
+        """One federated round for every simulated site — a single compiled
+        call over the stacked site axis."""
+        if self._site_state is None:
+            self._site_state = self._place(
+                self._stacked_site_state(), P(MeshAxis.SITE)
+            )
+        if self._step is None:
+            self._step = self._build_step()
+        stacked = (self.stack_site_batches(site_batches)
+                   if isinstance(site_batches, (list, tuple))
+                   else site_batches)
+        new_params, self._site_state, aux = self._step(
+            self.trainer.train_state.params, self._site_state,
+            self._site_ix, stacked,
+        )
+        # keep the trainer's single-site view current (checkpoints, eval):
+        # row 0 IS the shared state under the replication invariant
+        site = self._site_state
+        self.trainer.train_state = self.trainer.train_state.replace(
+            params=new_params,
+            opt_state=jax.tree_util.tree_map(lambda x: x[0], site["opt"]),
+            step=site["step"][0],
+            rng=site["rng"][0],
+        )
+        self.rounds_done += 1
+        return aux
+
+    # ------------------------------------------------------------- evaluation
+    def _build_eval(self):
+        trainer = self.trainer
+        metrics_shell, averages_shell = trainer._metrics_shell()
+        sharded = self.mesh is not None
+
+        def one_site(params, batch):
+            it = trainer.iteration(params, batch, None)
+            m_state, a_state = trainer._step_outputs(
+                it, batch, metrics_shell, averages_shell
+            )
+            hs = None
+            if m_state is None and not getattr(metrics_shell, "jit_safe", True):
+                hs = trainer.host_scores_payload(it, batch)
+            return m_state, a_state, hs
+
+        def block(params, stacked):
+            m, a, hs = jax.vmap(one_site, in_axes=(None, 0))(params, stacked)
+
+            def site_sum(x):
+                x = jnp.sum(x, axis=0)
+                return jax.lax.psum(x, MeshAxis.SITE) if sharded else x
+
+            if m is not None:
+                m = jax.tree_util.tree_map(site_sum, m)
+            a = jax.tree_util.tree_map(site_sum, a)
+            if hs is not None:
+                def gather(x):  # (S_local, B, ...) → (S·B, ...)
+                    x = x.reshape((-1,) + x.shape[2:])
+                    return (jax.lax.all_gather(
+                        x, MeshAxis.SITE, axis=0, tiled=True
+                    ) if sharded else x)
+                hs = jax.tree_util.tree_map(gather, hs)
+            return m, a, hs
+
+        if not sharded:
+            return jax.jit(block)
+        return jax.jit(shard_map(
+            block, mesh=self.mesh,
+            in_specs=(P(), P(MeshAxis.SITE)),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        ))
+
+    def eval_step(self, site_batches):
+        """Globally-reduced evaluation over one batch per site; same return
+        contract as :meth:`~..parallel.mesh.MeshFederation.eval_step`."""
+        if isinstance(site_batches, (list, tuple)):
+            glob = {
+                k: jnp.stack([jnp.asarray(b[k]) for b in site_batches])
+                for k in site_batches[0]
+            }
+        else:
+            glob = site_batches
+        glob = self._place(glob, P(MeshAxis.SITE))
+        if self._eval is None:
+            self._eval = self._build_eval()
+        return self._eval(self.trainer.train_state.params, glob)
+
+    # ----------------------------------------------------------------- resume
+    def serialize_comm_state(self):
+        """The stacked opt/rng/step need no payload: they are replicated-by-
+        construction tiles of the trainer's checkpointed state, rebuilt on
+        restore.  Only the round counter is carried."""
+        return {"rounds_done": int(self.rounds_done)}
+
+    def restore_comm_state(self, payload):
+        self.rounds_done = int(payload.get("rounds_done", 0))
+        # the trainer's state was just reloaded: re-tile lazily on next step
+        self._site_state = None

@@ -86,9 +86,13 @@ class TPDense(nn.Module):
         r = lax.axis_index(self.tp_axis)
         if self.mode == "col":
             g, f = self.groups, self.features // self.groups
-            assert f % n == 0, (
-                f"tp={n} must divide the per-group features {f}"
-            )
+            # config validation must survive ``python -O`` (a stripped
+            # assert would let a mis-sized config reach dynamic_slice with
+            # silently wrong slices) — so ValueError, never assert
+            if f % n != 0:
+                raise ValueError(
+                    f"tp={n} must divide the per-group features {f}"
+                )
             fl = f // n
             # (d, g*f) → (d, g, f) → this rank's (d, g, f/n) → (d, g*f/n)
             k3 = kernel.reshape(d_in, g, f)
@@ -136,12 +140,20 @@ class MultiHeadSelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x):
         b, t, d = x.shape
-        assert d % self.num_heads == 0, "num_heads must divide d_model"
+        # ValueError (not assert): these config checks gate dynamic_slice
+        # sizing and must survive ``python -O``
+        if d % self.num_heads != 0:
+            raise ValueError(
+                f"num_heads={self.num_heads} must divide d_model={d}"
+            )
         hd = d // self.num_heads
         heads = self.num_heads
         if self.tp_axis:
             n = axis_size(self.tp_axis)
-            assert heads % n == 0, "tp must divide num_heads"
+            if heads % n != 0:
+                raise ValueError(
+                    f"tp={n} must divide num_heads={heads}"
+                )
             heads = heads // n
         # qkv groups=3: each of q/k/v slices by this rank's head block.
         # Explicit name= keeps the historical nn.Dense param keys, so

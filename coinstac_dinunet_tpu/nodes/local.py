@@ -68,6 +68,12 @@ class COINNLocal:
         # attributed anomaly zeroes that site's reduce weight from the round
         # it fires; frozen into shared_args so the aggregator sees it
         quarantine_on_anomaly=None,
+        # opt-in k-ary hierarchical tree-reduce fan-in for the aggregator
+        # (parallel/reducer.py; Federation.REDUCE_FANIN): streams site
+        # payloads in groups of k instead of materializing all n_sites at
+        # once; frozen into shared_args so the aggregator sees it on every
+        # transport
+        reduce_fanin=None,
         # engine-specific knobs (present so they freeze into shared_args)
         matrix_approximation_rank=1,
         start_powerSGD_iter=10,

@@ -49,6 +49,27 @@ class COINNRemote:
                 self.cache[Key.ARGS_CACHED.value] = True
 
     # ---------------------------------------------------------- site dropout
+    @staticmethod
+    def _quorum_need(quorum, roster_size):
+        """Normalize ``site_quorum`` to a minimum alive-site COUNT.
+
+        Numeric type must never flip the interpretation (compspec UIs
+        commonly deliver JSON numbers as floats): any INTEGRAL value >= 1
+        (``1``, ``1.0``, ``2.0``) is a site count; a FRACTION must be
+        strictly inside (0, 1) and means that share of the ORIGINAL
+        roster (``ceil``).  Non-integral values >= 1 (e.g. ``1.5``) and
+        values <= 0 are configuration errors, not silent policies."""
+        q = float(quorum)
+        if 0.0 < q < 1.0:
+            return int(math.ceil(q * roster_size))
+        if q >= 1.0 and q == int(q):
+            return int(q)
+        raise ValueError(
+            f"site_quorum {quorum!r} is ambiguous: use an integral value "
+            ">= 1 for a minimum alive-site count, or a fraction strictly "
+            "in (0, 1) for a share of the initial roster"
+        )
+
     def _check_quorum(self):
         """Enforce the site-participation contract at every barrier.
 
@@ -56,15 +77,19 @@ class COINNRemote:
         all-site check (ref ``remote.py:225-258``), so a site that stops
         reporting wedges or kills the run with no diagnosis.  Default here
         is the same lockstep contract but LOUD: a site missing from the
-        round's input raises with the dropped-site list.  Opt-in
-        ``cache['site_quorum']`` (int = min alive sites, float in (0,1] =
-        min alive fraction of the initial roster) lets the run continue
-        with the survivors: reductions are already participation-weighted
-        (absent sites simply contribute nothing), so the math degrades to
-        the survivor average — the documented semantics, never a silent
-        re-weighting.  Once dropped, a site stays dropped (its mid-round
-        state is gone); quorum is always judged against the ORIGINAL
-        roster."""
+        round's input raises with the dropped-site list — on EVERY
+        invocation, not only the round a site first vanishes, so a
+        persisted-cache re-invocation (external engine retry, resume) can
+        never silently continue survivor-weighted without a policy.
+        Opt-in ``cache['site_quorum']`` (integral value >= 1 = min alive
+        sites regardless of int/float type; fraction strictly in (0,1) =
+        min alive share of the initial roster — see :meth:`_quorum_need`)
+        lets the run continue with the survivors: reductions are already
+        participation-weighted (absent sites simply contribute nothing),
+        so the math degrades to the survivor average — the documented
+        semantics, never a silent re-weighting.  Once dropped, a site
+        stays dropped (its mid-round state is gone); quorum is always
+        judged against the ORIGINAL roster."""
         roster = self.cache.get("all_sites")
         if not roster:
             return
@@ -83,16 +108,22 @@ class COINNRemote:
             })
         alive = set(self.input.keys())
         dropped = sorted((set(roster) - alive) | prev)
-        if set(dropped) == prev:
+        if not dropped:
             return
-        self.cache["dropped_sites"] = dropped
         quorum = self.cache.get("site_quorum")
-        # every quorum decision is a timeline event: which sites vanished,
-        # who survives, what policy applied (docs/TELEMETRY.md schema)
-        telemetry.get_active().event(
-            "quorum:drop", cat="quorum", sites=sorted(set(dropped) - prev),
-            alive=sorted(alive), quorum=quorum,
-        )
+        new_drops = sorted(set(dropped) - prev)
+        if not new_drops and quorum:
+            # nothing new under a configured policy: the drop was already
+            # judged (and logged) the round it happened
+            return
+        if new_drops:
+            self.cache["dropped_sites"] = dropped
+            # every quorum decision is a timeline event: which sites
+            # vanished, who survives, what policy applied (docs/TELEMETRY.md)
+            telemetry.get_active().event(
+                "quorum:drop", cat="quorum", sites=new_drops,
+                alive=sorted(alive), quorum=quorum,
+            )
         if not quorum:
             telemetry.get_active().event(
                 "quorum:fail", cat="quorum", reason="no site_quorum policy",
@@ -105,9 +136,7 @@ class COINNRemote:
                 "cache['site_quorum'] (min alive count, or fraction of the "
                 "initial roster) to let the run continue with survivors."
             )
-        need = (int(math.ceil(float(quorum) * len(roster)))
-                if 0 < float(quorum) <= 1 and not isinstance(quorum, int)
-                else int(quorum))
+        need = self._quorum_need(quorum, len(roster))
         if len(alive) < max(need, 1):
             telemetry.get_active().event(
                 "quorum:fail", cat="quorum", reason="quorum unmet",

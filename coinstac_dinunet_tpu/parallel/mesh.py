@@ -34,6 +34,21 @@ from ..utils.jax_compat import shard_map
 from .powersgd import _aslist  # msgpack list/dict normalization (shared)
 
 
+def build_site_only_mesh(n_shards, devices=None):
+    """1-D ``(site,)`` mesh for the site-vectorized federation
+    (:mod:`~..federation.vector`): the stacked ``MeshAxis.SITE`` axis of B
+    simulated sites shards across ``n_shards`` physical devices
+    (Anakin-style — many logical workers per device rank), instead of the
+    one-rank-per-site mapping of :func:`build_site_mesh`."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_shards > len(devices):
+        raise ValueError(
+            f"site-only mesh needs {n_shards} devices; only "
+            f"{len(devices)} available"
+        )
+    return Mesh(np.array(devices[:n_shards]), (MeshAxis.SITE,))
+
+
 def build_site_mesh(n_sites, devices=None, devices_per_site=None):
     """Mesh of shape (site, device) over the available devices.
 

@@ -10,12 +10,14 @@ site's gradient payload, average, ship the result.  TPU-first differences:
   accelerator; leaves stay device-resident until serialization.
 """
 import os
+import shutil
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .. import config
+from ..config.keys import Federation
 from ..resilience.retry import RetryPolicy
 from ..telemetry import get_active as _telemetry
 from ..telemetry import health as _health
@@ -91,6 +93,79 @@ def site_cosines(leaves, w0):
         mnorm2 = mnorm2 + jnp.sum(jnp.square(mean))
     cos = dots / jnp.maximum(jnp.sqrt(norms2) * jnp.sqrt(mnorm2), 1e-30)
     return jnp.where(ok, cos, jnp.nan)
+
+
+@jax.jit
+def _guarded_partial(leaves, w0):
+    """The associative building block of :func:`_guarded_mean` for one
+    k-ary tree-reduce group: weighted partial SUMS (not means) per leaf
+    plus the group's weight total, so partials from different subtrees
+    compose by plain addition and the division happens ONCE at the root —
+    ``sum_g(partial_g) / max(sum_g(wtot_g), 1)`` equals the flat guarded
+    mean to fp tolerance regardless of the grouping.
+
+    Returns ``(partial_sums, wtot, site_ok)``; ``site_ok`` is the group's
+    (k,) finite-health vector (a non-finite site contributes nothing to the
+    sums AND nothing to the weight total — exactly the flat exclusion)."""
+    ok = jnp.ones((leaves[0].shape[0],), jnp.bool_)
+    for x in leaves:
+        ok = ok & jnp.isfinite(x).all(axis=tuple(range(1, x.ndim)))
+    w = ok.astype(jnp.float32) * w0
+    sums = [
+        jnp.tensordot(w, jnp.nan_to_num(x, nan=0.0, posinf=0.0, neginf=0.0),
+                      axes=(0, 0))
+        for x in leaves
+    ]
+    return sums, jnp.sum(w), ok
+
+
+@jax.jit
+def _plain_partial(leaves, w0):
+    """Unguarded counterpart of :func:`_guarded_partial` (``_stacked_mean``'s
+    building block): participation-weighted sums + the weight total."""
+    sums = [jnp.tensordot(w0, x, axes=(0, 0)) for x in leaves]
+    return sums, jnp.sum(w0)
+
+
+@jax.jit
+def _sum_partials(partials):
+    """Combine a level's partial payloads: per-leaf sums add, weight totals
+    add (``partials`` is a list of per-group leaf lists; the LAST entry of
+    each leaf list is that group's (1,) weight-total array)."""
+    return [
+        sum(p[i] for p in partials) for i in range(len(partials[0]))
+    ]
+
+
+@jax.jit
+def _cosine_block(leaves, mean_leaves, mnorm2):
+    """Per-site cosine against an externally supplied (root) mean — the
+    streaming second pass of :func:`site_cosines`: dots/norms accumulate
+    leaf by leaf for one tree-reduce group, ``mnorm2`` is the mean's
+    precomputed squared norm.  Returns ``(cos, ok)`` with NaN marking a
+    non-finite site, matching the flat path's attribution."""
+    n = leaves[0].shape[0]
+    ok = jnp.ones((n,), jnp.bool_)
+    for x in leaves:
+        ok = ok & jnp.isfinite(x).all(axis=tuple(range(1, x.ndim)))
+    dots = jnp.zeros((n,), jnp.float32)
+    norms2 = jnp.zeros((n,), jnp.float32)
+    for x, m in zip(leaves, mean_leaves):
+        v = jnp.nan_to_num(
+            jnp.asarray(x, jnp.float32).reshape(n, -1),
+            nan=0.0, posinf=0.0, neginf=0.0,
+        )
+        dots = dots + v @ jnp.asarray(m, jnp.float32).reshape(-1)
+        norms2 = norms2 + jnp.sum(jnp.square(v), axis=1)
+    cos = dots / jnp.maximum(jnp.sqrt(norms2) * jnp.sqrt(mnorm2), 1e-30)
+    return jnp.where(ok, cos, jnp.nan), ok
+
+
+@jax.jit
+def _mean_norm2(mean_leaves):
+    return sum(
+        jnp.sum(jnp.square(jnp.asarray(m, jnp.float32))) for m in mean_leaves
+    )
 
 
 class COINNReducer:
@@ -210,32 +285,185 @@ class COINNReducer:
         wire = config.wire_dtype(self.precision_bits)
         if self.cache.get("guard_nonfinite", True):
             means, ok = _guarded_mean(stacked, weights)
-            ok = np.asarray(ok)
-            self.cache["_reduce_round"] = int(self.cache.get("_reduce_round", 0)) + 1
-            if not ok.all():
-                sites = sorted(self.input.keys())
-                bad = [s for s, good in zip(sites, ok) if not good]
-                self.cache.setdefault("skipped_sites", []).append({
-                    "reduce_round": self.cache["_reduce_round"],
-                    "epoch": int(self.cache.get("epoch", 0)),
-                    "sites": bad,
-                })
-                _telemetry().event(
-                    "reduce:nonfinite_skip", cat="reduce", sites=bad,
-                    reduce_round=self.cache["_reduce_round"],
-                )
-                # a failure event is never verbosity-gated
-                logger.warn(
-                    f"non-finite gradients from sites {bad}; excluded this round",
-                    True,
-                )
+            self._record_skipped(ok)
             return [np.asarray(x, dtype=wire) for x in means]
         return [np.asarray(x, dtype=wire) for x in _stacked_mean(stacked, weights)]
 
+    def _record_skipped(self, ok):
+        """Round bookkeeping for the nonfinite guard — shared by the flat
+        and tree paths: the skipped site ids land in
+        ``cache['skipped_sites']`` for the control plane/logs."""
+        ok = np.asarray(ok)
+        self.cache["_reduce_round"] = int(self.cache.get("_reduce_round", 0)) + 1
+        if not ok.all():
+            sites = sorted(self.input.keys())
+            bad = [s for s, good in zip(sites, ok) if not good]
+            self.cache.setdefault("skipped_sites", []).append({
+                "reduce_round": self.cache["_reduce_round"],
+                "epoch": int(self.cache.get("epoch", 0)),
+                "sites": bad,
+            })
+            _telemetry().event(
+                "reduce:nonfinite_skip", cat="reduce", sites=bad,
+                reduce_round=self.cache["_reduce_round"],
+            )
+            # a failure event is never verbosity-gated
+            logger.warn(
+                f"non-finite gradients from sites {bad}; excluded this round",
+                True,
+            )
+
+    # ----------------------------------------------------- hierarchical tree
+    def _tree_fanin(self):
+        """k-ary tree-reduce fan-in (``Federation.REDUCE_FANIN``); 0 = the
+        flat stacked mean."""
+        try:
+            k = int(self.cache.get(Federation.REDUCE_FANIN) or 0)
+        except (TypeError, ValueError):
+            return 0
+        return k if k >= 2 else 0
+
+    def _tree_average(self, file_key, payload=None):
+        """Hierarchical k-ary streaming reduce over the site payload files —
+        the 10³-site fan-in path (ROADMAP mega-federation): instead of
+        materializing all ``n_sites`` payloads at once, sites stream in
+        groups of ``k``; each group's participation+finite-weighted partial
+        SUM and weight total commit through the atomic wire transport
+        (:func:`~..utils.tensorutils.save_arrays` — v2 checksummed format),
+        higher levels combine ``k`` partials at a time, and the single
+        normalization happens at the root.  Weighted sums are associative,
+        so the result equals the flat :func:`_guarded_mean` /
+        :func:`_stacked_mean` to fp tolerance for ANY grouping — including
+        all-dead subtrees (their weight total is 0 and they contribute
+        nothing) and a single survivor (property-tested in
+        ``tests/test_federation.py``).
+
+        Peak memory is O(k · payload) instead of O(n_sites · payload); the
+        spilled partials model exactly what a multi-level relay hierarchy
+        would ship.  With telemetry enabled the per-site cosine health
+        series is recorded from a second streaming pass against the root
+        mean (same values as the flat path's :func:`site_cosines`); a
+        quarantine the watchdog issues from THIS round's series takes
+        effect from the next round (the flat path can re-mask in-round —
+        the one documented behavioral difference of the streaming path)."""
+        sites = sorted(self.input.keys())
+        k = self._tree_fanin() or 2
+        paths = [self._site_path(s, self.input[s][file_key]) for s in sites]
+        weights = np.asarray(
+            self._apply_quarantine(self._site_weights()), np.float32
+        )
+        retry = RetryPolicy.for_wire(self.cache)
+        guard = bool(self.cache.get("guard_nonfinite", True))
+        rec = _telemetry()
+        spill = os.path.join(
+            self.state.get("outputDirectory", "."), ".tree_reduce"
+        )
+        os.makedirs(spill, exist_ok=True)
+        ok = np.ones(len(sites), bool)
+        try:
+            entries = []
+            for g in range(0, len(paths), k):
+                site_leaves = tensorutils.load_arrays_many(
+                    paths[g:g + k], retry=retry
+                )
+                n_leaves = len(site_leaves[0])
+                if n_leaves == 0:  # e.g. a payload with no matching params
+                    return []
+                stacked = [
+                    jnp.stack([
+                        jnp.asarray(site[i], jnp.float32)
+                        for site in site_leaves
+                    ])
+                    for i in range(n_leaves)
+                ]
+                w = jnp.asarray(weights[g:g + k])
+                if guard:
+                    sums, wtot, gok = _guarded_partial(stacked, w)
+                    ok[g:g + k] = np.asarray(gok)
+                else:
+                    sums, wtot = _plain_partial(stacked, w)
+                part = os.path.join(spill, f"l0_{g // k}.npy")
+                tensorutils.save_arrays(
+                    part,
+                    [np.asarray(x, np.float32) for x in sums]
+                    + [np.asarray(wtot, np.float32).reshape(1)],
+                )
+                entries.append(part)
+            levels = 1
+            while len(entries) > 1:
+                nxt = []
+                for g in range(0, len(entries), k):
+                    chunk = entries[g:g + k]
+                    if len(chunk) == 1:
+                        # a lone trailing partial is already its own sum:
+                        # carry the committed payload forward untouched
+                        nxt.append(chunk[0])
+                        continue
+                    partials = [
+                        [jnp.asarray(x, jnp.float32) for x in p]
+                        for p in tensorutils.load_arrays_many(
+                            chunk, retry=retry
+                        )
+                    ]
+                    part = os.path.join(spill, f"l{levels}_{g // k}.npy")
+                    tensorutils.save_arrays(
+                        part,
+                        [np.asarray(x, np.float32)
+                         for x in _sum_partials(partials)],
+                    )
+                    nxt.append(part)
+                entries = nxt
+                levels += 1
+            root = tensorutils.load_arrays(entries[0], retry=retry)
+            denom = max(float(np.asarray(root[-1]).ravel()[0]), 1.0)
+            means = [jnp.asarray(x, jnp.float32) / denom for x in root[:-1]]
+            if rec.enabled:
+                self._tree_health(paths, weights, means, retry, payload, k)
+            if guard:
+                self._record_skipped(ok)
+            rec.event(
+                "reduce:tree", cat="reduce", sites=len(sites), fanin=k,
+                levels=levels, payload=payload,
+            )
+            wire = config.wire_dtype(self.precision_bits)
+            return [np.asarray(x, dtype=wire) for x in means]
+        finally:
+            shutil.rmtree(spill, ignore_errors=True)
+
+    def _tree_health(self, paths, weights, mean_leaves, retry, payload, k):
+        """Streaming second pass: per-site cosine-to-root-mean (the same
+        series the flat path records via :func:`site_cosines`)."""
+        sites = sorted(self.input.keys())
+        mnorm2 = _mean_norm2(mean_leaves)
+        cos = np.empty(len(sites), np.float32)
+        for g in range(0, len(paths), k):
+            site_leaves = tensorutils.load_arrays_many(
+                paths[g:g + k], retry=retry
+            )
+            stacked = [
+                jnp.stack([
+                    jnp.asarray(site[i], jnp.float32) for site in site_leaves
+                ])
+                for i in range(len(site_leaves[0]))
+            ]
+            c, _ = _cosine_block(stacked, mean_leaves, mnorm2)
+            cos[g:g + k] = np.asarray(c)
+        _health.record_site_agreement(
+            self.cache, sites, cos, weights=np.asarray(weights),
+            recorder=_telemetry(), payload=payload,
+        )
+
     def reduce(self):
         """Average all sites' gradients → ship ``avg_grads`` + signal update
-        (≙ ref ``reducer.py:43-54``)."""
-        avg = self._average(self._load("grads_file"), payload="grads")
+        (≙ ref ``reducer.py:43-54``).  With ``cache['reduce_fanin'] >= 2``
+        and more sites than the fan-in, the average runs as the streaming
+        hierarchical tree-reduce (:meth:`_tree_average`) instead of the
+        flat all-sites-at-once stacked mean."""
+        k = self._tree_fanin()
+        if k and len(self.input) > k:
+            avg = self._tree_average("grads_file", payload="grads")
+        else:
+            avg = self._average(self._load("grads_file"), payload="grads")
         _telemetry().event(
             "reduce:dSGD", cat="reduce", sites=len(self.input),
             leaves=len(avg),

@@ -28,6 +28,7 @@ from .chaos import (  # noqa: F401
     ChaosFault,
     ChaosHang,
     ChaosSession,
+    fraction_kill_plan,
     load_fault_plan,
 )
 from .retry import RetryExhausted, RetryPolicy  # noqa: F401

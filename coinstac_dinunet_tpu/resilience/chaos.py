@@ -141,6 +141,37 @@ class Fault:
         return f"{self.kind} @ {where}"
 
 
+def fraction_kill_plan(n_sites, fraction, round=2, seed=0, kind="crash"):
+    """Deterministic mega-federation fault plan: permanently kill
+    ``ceil(fraction · n_sites)`` distinct sites at engine round ``round``
+    (1-based) — the ISSUE-6 "kill 5% of 2,000 sites" scenario, scaled to
+    any roster.  Site choice is a seeded shuffle of the roster, so the same
+    ``(n_sites, fraction, seed)`` always kills the same sites and a chaos
+    run stays comparable against its golden run.
+
+    Returns a plan dict in the :func:`load_fault_plan` schema (pass it as
+    ``fault_plan=`` to any engine)."""
+    import math
+
+    n_sites = int(n_sites)
+    if not 0.0 < float(fraction) < 1.0:
+        raise ValueError(
+            f"fraction {fraction!r} must be strictly in (0, 1) — killing "
+            "nobody or everybody is not a chaos scenario"
+        )
+    n_kill = min(int(math.ceil(float(fraction) * n_sites)), n_sites - 1)
+    # seeded Fisher-Yates via numpy-free LCG would do, but random.Random is
+    # deterministic for a fixed seed across platforms, which is all we need
+    import random as _random
+
+    roster = [f"site_{i}" for i in range(n_sites)]
+    _random.Random(int(seed)).shuffle(roster)
+    return {"faults": [
+        {"kind": str(kind), "round": int(round), "site": s}
+        for s in sorted(roster[:n_kill])
+    ]}
+
+
 def load_fault_plan(spec):
     """Fault plan (dict or JSON file path) → validated list of faults."""
     if isinstance(spec, (str, os.PathLike)):

@@ -185,6 +185,11 @@ def _bench_verdict_data(bench_history, threshold):
         "drop_pct": round(100.0 * drop, 1),
         "regressed": drop > threshold,
         "threshold_pct": round(100.0 * threshold, 1),
+        # ledgers are per-metric files (BENCH_HISTORY.jsonl,
+        # BENCH_FEDERATION_HISTORY.jsonl, ...): name the unit so the
+        # verdict reads correctly for any of them
+        "metric": str(last.get("metric") or "bench"),
+        "unit": str(last.get("unit") or "samples/sec/chip"),
     }
 
 
@@ -286,9 +291,9 @@ def _rank_verdicts(report):
         add(
             "warning",
             "benchmark throughput regressed vs the previous run",
-            f"samples/sec/chip {bench['latest']:g} vs {bench['previous']:g} "
-            f"({bench['drop_pct']:+.1f}% drop, threshold "
-            f"{bench['threshold_pct']:g}%)",
+            f"{bench.get('unit', 'samples/sec/chip')} {bench['latest']:g} "
+            f"vs {bench['previous']:g} ({bench['drop_pct']:+.1f}% drop, "
+            f"threshold {bench['threshold_pct']:g}%)",
         )
     res = report.get("resilience") or {}
     if res.get("corruption_recovered"):
@@ -435,9 +440,9 @@ def render_markdown(report):
         lines.append("")
         state = ("**REGRESSED**" if bench["regressed"] else "within bounds")
         lines.append(
-            f"samples/sec/chip {bench['latest']:g} vs previous "
-            f"{bench['previous']:g} ({bench['drop_pct']:+.1f}%; threshold "
-            f"{bench['threshold_pct']:g}%) — {state}."
+            f"{bench.get('unit', 'samples/sec/chip')} {bench['latest']:g} "
+            f"vs previous {bench['previous']:g} ({bench['drop_pct']:+.1f}%; "
+            f"threshold {bench['threshold_pct']:g}%) — {state}."
         )
         lines.append("")
 

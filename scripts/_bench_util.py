@@ -6,11 +6,81 @@ honest timings are ≥60-step host loops with one scalar fence, min of ≥3
 repeats.  And beware XLA DCE: probes must consume what they claim to
 measure (touch every grad leaf in backward probes).
 """
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+
+# ----------------------------------------------------------- backend probing
+# BENCH_r03–r05 aborted >900 s inside ``jax.devices()``: the relayed TPU
+# backend's device claim can block indefinitely when the pool is wedged, and
+# an in-process hang cannot be caught by fail-soft except clauses.  The
+# probe initializes the backend in a THROWAWAY interpreter under a hard
+# timeout, so the bench can record a typed ``backend_init_failed`` result
+# (and optionally fall back to CPU) instead of silently eating the driver's
+# whole timeout.
+
+def probe_backend(timeout=240, platform=None):
+    """Initialize the JAX backend in a subprocess; returns a JSON-able
+    ``{"ok", "devices", "backend", "seconds", "platform", "error"?}``."""
+    env = dict(os.environ)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    code = "import jax\n"
+    if platform:
+        # belt over the env var: the container's sitecustomize may re-pin
+        # jax_platforms after import, overriding JAX_PLATFORMS
+        code += f"jax.config.update('jax_platforms', {platform!r})\n"
+    code += "print(len(jax.devices()), jax.default_backend())"
+    t0 = time.perf_counter()
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": "backend_init_timeout",
+                "timeout_s": timeout, "platform": platform or "default",
+                "seconds": round(time.perf_counter() - t0, 1)}
+    seconds = round(time.perf_counter() - t0, 1)
+    if res.returncode != 0:
+        return {"ok": False, "error": "backend_init_failed",
+                "platform": platform or "default", "seconds": seconds,
+                "detail": res.stderr.strip()[-1000:]}
+    try:
+        n, backend = res.stdout.split()[-2:]
+        return {"ok": True, "devices": int(n), "backend": backend,
+                "platform": platform or "default", "seconds": seconds}
+    except (ValueError, IndexError):
+        return {"ok": False, "error": "backend_init_failed",
+                "platform": platform or "default", "seconds": seconds,
+                "detail": f"unparseable probe output: {res.stdout[-200:]!r}"}
+
+
+def ensure_warm_backend(timeout=240, fallback="cpu"):
+    """Probe the default backend; on failure probe ``fallback`` and — when
+    it works — pin ``JAX_PLATFORMS`` to it for this process so the bench
+    still produces numbers (flagged via the returned probe record).
+    Returns the probe dict of the backend the process will actually use
+    (``probe["fallback"]`` marks a downgrade; ``probe["ok"] is False``
+    means no backend initializes and the caller should emit a typed
+    ``backend_init_failed`` result instead of timing anything)."""
+    probe = probe_backend(timeout=timeout)
+    if probe["ok"]:
+        return probe
+    if fallback and os.environ.get("JAX_PLATFORMS") != fallback:
+        fb = probe_backend(timeout=timeout, platform=fallback)
+        if fb["ok"]:
+            fb["fallback"] = True
+            fb["default_backend_error"] = probe
+            os.environ["JAX_PLATFORMS"] = fallback
+            return fb
+    return probe
 
 
 def fence(out):

@@ -1,0 +1,266 @@
+"""Mega-federation benchmark: rounds/sec at 10/100/1,000 simulated sites.
+
+Headline metric (the ONE JSON line's ``value``): **federated rounds per
+second of the site-vectorized engine at the ``--sites`` point** (default
+1,000 simulated sites) — the ROADMAP item-1 scale target.  One "round" is
+one global SGD step: every site's local gradient step + the cross-site
+participation-weighted reduce + the synchronized update.
+
+Also reported inside the same JSON line:
+
+- ``vectorized``: rounds/sec of :class:`SiteVectorizedFederation` (one jit
+  for all sites, site axis sharded over the host's devices) at each site
+  count up to ``--sites``.
+- ``serial``: rounds/sec of the serial per-site ``InProcessEngine``
+  (one node invocation + wire payload per site per round — the paper's
+  engine model) at the site counts small enough to time honestly.
+- ``speedup_vs_serial``: vectorized/serial at the largest common point —
+  the ISSUE-6 acceptance number (>= 5x at 100+ sites).
+
+Ledger + doctor: pipe the output through ``scripts/bench_history.py append
+--history BENCH_FEDERATION_HISTORY.jsonl`` and point ``telemetry doctor
+--bench-history`` at that file — the doctor's regression verdict machinery
+is metric-agnostic (it diffs the last two entries' ``value``), so a
+rounds/sec drop >10% becomes a ranked verdict exactly like an MFU drop.
+The CI ``federation`` job runs the 64-site smoke this way and uploads the
+ledger entry + postmortem as an artifact.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/bench_federation.py --sites 1000
+    python scripts/bench_federation.py --sites 64 --smoke --workdir /tmp/fb
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _bench_util import ensure_warm_backend  # noqa: E402
+
+
+# ---------------------------------------------------------- synthetic task
+def _mlp():
+    import flax.linen as fnn
+
+    class MLP(fnn.Module):
+        @fnn.compact
+        def __call__(self, x):
+            x = fnn.relu(fnn.Dense(16)(x))
+            return fnn.Dense(2)(x)
+
+    return MLP()
+
+
+def _make_trainer_cls():
+    from coinstac_dinunet_tpu.metrics import cross_entropy
+    from coinstac_dinunet_tpu.trainer import COINNTrainer
+    import jax.numpy as jnp
+
+    class BenchTrainer(COINNTrainer):
+        def _init_nn_model(self):
+            self.nn["net"] = _mlp()
+
+        def iteration(self, params, batch, rng=None):
+            logits = self.nn["net"].apply(params["net"], batch["inputs"])
+            loss = cross_entropy(logits, batch["labels"],
+                                 mask=batch.get("_mask"))
+            pred = jnp.argmax(logits, axis=-1)
+            return {"loss": loss, "pred": pred, "true": batch["labels"]}
+
+    return BenchTrainer
+
+
+def _make_dataset_cls():
+    from coinstac_dinunet_tpu.data import COINNDataset
+
+    class BenchDataset(COINNDataset):
+        def __getitem__(self, ix):
+            _, f = self.indices[ix]
+            fid = int(str(f).split("_")[-1])
+            rng = np.random.default_rng(fid)
+            bits = rng.integers(0, 2, size=2)
+            x = ((bits * 2 - 1).astype(np.float32)
+                 + rng.normal(0, 0.1, 2).astype(np.float32))
+            return {"inputs": x, "labels": np.int32(bits[0] ^ bits[1])}
+
+    return BenchDataset
+
+
+_CACHE = dict(
+    task_id="fedbench", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+    batch_size=8, learning_rate=5e-2, input_shape=(2,), seed=11,
+    patience=10_000, validation_epochs=10_000, epochs=10_000,
+)
+
+
+# -------------------------------------------------------------- vectorized
+def _bench_vectorized(n_sites, rounds, batch=8):
+    """rounds/sec of the one-jit site-vectorized plane at ``n_sites``."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from coinstac_dinunet_tpu.config.keys import MeshAxis
+    from coinstac_dinunet_tpu.federation import SiteVectorizedFederation
+
+    trainer = _make_trainer_cls()(cache=dict(_CACHE), state={},
+                                  data_handle=None)
+    trainer.init_nn()
+    fed = SiteVectorizedFederation(trainer, n_sites)
+    rng = np.random.default_rng(0)
+    bits = rng.integers(0, 2, size=(n_sites, 1, batch, 2))
+    stacked = {
+        "inputs": jnp.asarray(
+            (bits * 2 - 1) + rng.normal(0, 0.1, bits.shape), jnp.float32
+        ),
+        "labels": jnp.asarray(bits[..., 0] ^ bits[..., 1], jnp.int32),
+        "_mask": jnp.ones((n_sites, 1, batch), jnp.float32),
+    }
+    stacked = fed._place(stacked, P(MeshAxis.SITE))
+    aux = fed.train_step(stacked)  # warm-up: compile + first dispatch
+    float(np.asarray(aux["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        aux = fed.train_step(stacked)
+    float(np.asarray(aux["loss"]))  # fence
+    dt = time.perf_counter() - t0
+    return {"rounds_per_sec": round(rounds / dt, 3),
+            "round_ms": round(1e3 * dt / rounds, 3),
+            "shards": fed.shards}
+
+
+# ------------------------------------------------------------------ serial
+def _bench_serial(n_sites, rounds, workdir, per_site=64, telemetry=False):
+    """rounds/sec of the paper-shaped serial engine (one node invocation +
+    wire payload per site per round) at ``n_sites``."""
+    from coinstac_dinunet_tpu.engine import InProcessEngine
+
+    eng = InProcessEngine(
+        workdir, n_sites=n_sites, trainer_cls=_make_trainer_cls(),
+        dataset_cls=_make_dataset_cls(),
+        **dict(_CACHE, profile=bool(telemetry)),
+    )
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(per_site):
+            with open(os.path.join(d, f"s_{i * per_site + j}"), "w") as f:
+                f.write("x")
+    # warm-up rounds: INIT_RUNS handshake + first compiled steps
+    for _ in range(3):
+        eng.step_round()
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        eng.step_round()
+    dt = time.perf_counter() - t0
+    return {"rounds_per_sec": round(rounds / dt, 3),
+            "round_ms": round(1e3 * dt / rounds, 3)}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--sites", type=int, default=1000,
+                   help="headline site count for the vectorized engine")
+    p.add_argument("--rounds", type=int, default=None,
+                   help="timed rounds per point (default 10; 3 with --smoke)")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI mode: fewer rounds, serial capped at 16 sites")
+    p.add_argument("--serial-cap", type=int, default=None,
+                   help="largest site count to time the serial engine at "
+                        "(default 100; 16 with --smoke)")
+    p.add_argument("--workdir", default=None,
+                   help="serial-engine + telemetry workdir (default: a "
+                        "temp dir); `telemetry doctor <workdir>` consumes "
+                        "its event lanes")
+    args = p.parse_args(argv)
+    rounds = args.rounds or (3 if args.smoke else 10)
+    serial_cap = args.serial_cap or (16 if args.smoke else 100)
+
+    probe = ensure_warm_backend(
+        timeout=int(os.environ.get("COINN_BENCH_BACKEND_TIMEOUT", "240"))
+    )
+    if not probe.get("ok"):
+        # typed result instead of a silent hang/timeout (BENCH_r03–r05)
+        print(json.dumps({
+            "metric": "federation_rounds_per_sec",
+            "value": None, "unit": "rounds/sec", "sites": args.sites,
+            "error": probe.get("error", "backend_init_failed"),
+            "backend_probe": probe,
+        }))
+        return 0
+    if probe.get("fallback"):
+        # jax is already imported (via _bench_util), so the env var alone
+        # cannot retarget this process — and a sitecustomize may re-pin
+        # platforms anyway; config.update works until first backend use
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        print(f"# default backend failed to init "
+              f"({probe['default_backend_error'].get('error')}); benching "
+              f"on {probe['backend']}", file=sys.stderr)
+
+    workdir = args.workdir
+    if workdir is None:
+        import tempfile
+
+        workdir = tempfile.mkdtemp(prefix="fedbench_")
+    os.makedirs(workdir, exist_ok=True)
+
+    vec_points = sorted({s for s in (10, 100, args.sites) if s <= args.sites})
+    ser_points = [s for s in vec_points if s <= serial_cap]
+    if args.smoke:
+        vec_points = sorted({min(16, args.sites), args.sites})
+        ser_points = [s for s in vec_points if s <= serial_cap]
+
+    vectorized, serial = {}, {}
+    for s in vec_points:
+        vectorized[str(s)] = _bench_vectorized(s, rounds)
+        print(f"# vectorized {s:>5} sites: "
+              f"{vectorized[str(s)]['rounds_per_sec']:g} rounds/s "
+              f"({vectorized[str(s)]['shards']} shard(s))", file=sys.stderr)
+    for s in ser_points:
+        # telemetry OFF during timing (the recorder is not the thing being
+        # measured); a separate tiny profiled run below feeds the doctor
+        serial[str(s)] = _bench_serial(
+            s, max(rounds // 2, 2), os.path.join(workdir, f"serial_{s}"),
+        )
+        print(f"# serial     {s:>5} sites: "
+              f"{serial[str(s)]['rounds_per_sec']:g} rounds/s",
+              file=sys.stderr)
+    # one small profiled run so `telemetry doctor <workdir>` has event lanes
+    # (round spans, reduce spans, wire bytes) to report over
+    _bench_serial(min(ser_points or [4]), 2,
+                  os.path.join(workdir, "telemetry"), telemetry=True)
+
+    common = max((int(s) for s in serial), default=None)
+    speedup = None
+    if common is not None:
+        speedup = round(
+            vectorized[str(common)]["rounds_per_sec"]
+            / serial[str(common)]["rounds_per_sec"], 2,
+        )
+    head = str(max(vec_points))
+    print(json.dumps({
+        "metric": "federation_rounds_per_sec",
+        "value": vectorized[head]["rounds_per_sec"],
+        "unit": "rounds/sec",
+        "sites": int(head),
+        "rounds_timed": rounds,
+        "vectorized": vectorized,
+        "serial": serial,
+        "speedup_vs_serial": speedup,
+        "speedup_at_sites": common,
+        "workdir": workdir,
+        "backend_probe": probe,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,6 +86,7 @@ _SLOW_TESTS = frozenset((
     "test_sp_model_matches_unsharded",
     "test_mesh_engine_pretrain_matches_file_transport",
     "test_mesh_engine_sparse_test_mode",
+    "test_vectorized_engine_matches_file_and_mesh_transports",
 ))
 
 

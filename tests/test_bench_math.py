@@ -51,3 +51,32 @@ def test_flagship_is_first_in_matrix(bench):
     """Short tunnel windows must measure the headline first."""
     names = [n for n, *_ in bench._config_matrix(True)]
     assert names[0] == "vbm3d_cnn_8site"
+
+
+def test_backend_probe_typed_results():
+    """The BENCH_r03–r05 fix: backend init is probed in a throwaway
+    interpreter with a hard timeout — a healthy backend reports its device
+    count, a broken one yields a typed backend_init_failed record (never a
+    silent in-process hang)."""
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    from _bench_util import ensure_warm_backend, probe_backend
+
+    ok = probe_backend(timeout=180, platform="cpu")
+    assert ok["ok"] and ok["devices"] >= 1 and ok["backend"] == "cpu"
+
+    bad = probe_backend(timeout=180, platform="bogus_backend")
+    assert not bad["ok"]
+    assert bad["error"] == "backend_init_failed"
+    assert "bogus_backend" in bad.get("detail", "")
+
+    # fallback: a dead default backend downgrades to cpu and flags it
+    os.environ["JAX_PLATFORMS"] = "bogus_backend"
+    try:
+        fb = ensure_warm_backend(timeout=180, fallback="cpu")
+    finally:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    assert fb["ok"] and fb.get("fallback") and fb["backend"] == "cpu"
+    assert fb["default_backend_error"]["error"] == "backend_init_failed"

@@ -1,0 +1,398 @@
+"""federation/ subsystem: the site-vectorized mega-federation engine and the
+hierarchical tree-reduce (ISSUE 6).
+
+Acceptance contract: the vectorized engine's score trajectory equals the
+file and mesh transports' on the same data + seed; the k-ary tree-reduce
+equals the flat ``_guarded_mean`` to fp tolerance over arbitrary
+survivor/participation masks (all-dead subtrees and single survivors
+included) AND leaves the 3-site chaos acceptance scenario's golden score
+trajectory untouched; chaos kill-fraction plans drop sites under the
+``site_quorum`` contract without changing the stacked step's shape."""
+import os
+import types
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from coinstac_dinunet_tpu.config.keys import Federation
+from coinstac_dinunet_tpu.engine import InProcessEngine, MeshEngine
+from coinstac_dinunet_tpu.federation import (
+    SiteVectorizedEngine,
+    SiteVectorizedFederation,
+    resolve_site_shards,
+)
+from coinstac_dinunet_tpu.nodes.remote import COINNRemote
+from coinstac_dinunet_tpu.parallel.reducer import (
+    COINNReducer,
+    _guarded_mean,
+    _stacked_mean,
+)
+from coinstac_dinunet_tpu.resilience import fraction_kill_plan
+from coinstac_dinunet_tpu.utils import tensorutils
+
+from test_trainer import XorDataset, XorTrainer
+
+BASE = dict(
+    task_id="xor", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+    batch_size=8, epochs=2, validation_epochs=1, learning_rate=5e-2,
+    input_shape=(2,), seed=11, patience=50,
+)
+
+
+def _fill_sites(eng, per_site=24):
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(per_site):
+            with open(os.path.join(d, f"s_{i * per_site + j}"), "w") as f:
+                f.write("x")
+
+
+def _logs(cache):
+    return {k: np.asarray(cache[k], np.float64)
+            for k in ("train_log", "validation_log", "test_metrics",
+                      "global_test_metrics")}
+
+
+# ----------------------------------------------------- vectorized transport
+def test_vectorized_engine_matches_file_and_mesh_transports(tmp_path):
+    """Same data, same seed → the SAME score trajectory on all three
+    transports: serial file engine, per-rank mesh, site-vectorized vmap
+    (8 sites over the 8-device test platform exercises the shard_map
+    site-sharded path)."""
+    fe = InProcessEngine(tmp_path / "file", n_sites=8, trainer_cls=XorTrainer,
+                         dataset_cls=XorDataset, **BASE)
+    _fill_sites(fe)
+    fe.run(max_rounds=900)
+    assert fe.success
+
+    ve = SiteVectorizedEngine(tmp_path / "vec", n_sites=8,
+                              trainer_cls=XorTrainer,
+                              dataset_cls=XorDataset, **BASE)
+    _fill_sites(ve)
+    ve.run()
+    assert ve.success
+
+    me = MeshEngine(tmp_path / "mesh", n_sites=8, trainer_cls=XorTrainer,
+                    dataset_cls=XorDataset, **BASE)
+    _fill_sites(me)
+    me.run()
+    assert me.success
+
+    got, mesh, want = _logs(ve.cache), _logs(me.cache), _logs(fe.remote_cache)
+    for key in want:
+        assert want[key].shape == got[key].shape, key
+        np.testing.assert_allclose(got[key], want[key], atol=2e-3,
+                                   err_msg=f"file vs vectorized: {key}")
+        np.testing.assert_allclose(got[key], mesh[key], atol=2e-3,
+                                   err_msg=f"mesh vs vectorized: {key}")
+
+
+def test_vectorized_roster_larger_than_device_count(tmp_path):
+    """The whole point: n_sites ≫ n_devices runs as one jit (48 simulated
+    sites on 8 virtual devices), reaches SUCCESS, and keeps the replication
+    invariant (stacked per-site opt states identical across the site axis)."""
+    eng = SiteVectorizedEngine(tmp_path, n_sites=48, trainer_cls=XorTrainer,
+                               dataset_cls=XorDataset, **{**BASE, "epochs": 1})
+    _fill_sites(eng, per_site=8)
+    eng.run()
+    assert eng.success
+    fed = eng._last_fed
+    assert fed.shards == 8  # 48 % 8 == 0 → site axis sharded over devices
+    site = fed._site_state
+    assert site is not None
+    for leaf in jax.tree_util.tree_leaves(site["opt"]):
+        arr = np.asarray(leaf)
+        np.testing.assert_allclose(
+            arr, np.broadcast_to(arr[:1], arr.shape), atol=1e-6,
+            err_msg="stacked opt states diverged across the site axis",
+        )
+
+
+def test_vectorized_rejects_unsupported_engine():
+    with pytest.raises(ValueError, match="site-vectorized"):
+        SiteVectorizedFederation(None, n_sites=4, agg_engine="powerSGD")
+
+
+def test_resolve_site_shards():
+    assert resolve_site_shards(16, requested=4, devices=list(range(8))) == 4
+    assert resolve_site_shards(16, devices=list(range(8))) == 8
+    assert resolve_site_shards(15, devices=list(range(8))) == 1  # no divisor
+    with pytest.raises(ValueError, match="must divide"):
+        resolve_site_shards(15, requested=4, devices=list(range(8)))
+
+
+# -------------------------------------------------------- chaos + dropout
+def test_fraction_kill_plan_is_deterministic():
+    plan = fraction_kill_plan(40, 0.05, round=2, seed=3)
+    again = fraction_kill_plan(40, 0.05, round=2, seed=3)
+    assert plan == again
+    assert len(plan["faults"]) == 2  # ceil(0.05 * 40)
+    assert all(f["kind"] == "crash" and f["round"] == 2
+               for f in plan["faults"])
+    other = fraction_kill_plan(40, 0.05, round=2, seed=4)
+    assert other != plan  # seeded site choice
+    for bad in (0.0, 1.0, -0.5):
+        with pytest.raises(ValueError):
+            fraction_kill_plan(40, bad)
+
+
+def test_vectorized_chaos_kill_fraction_under_quorum(tmp_path):
+    """The mega-federation chaos drill scaled down: kill 15% of a 20-site
+    roster at round 2 under site_quorum — the run completes with exactly
+    the planned sites dead, survivor-weighted from that round on."""
+    plan = fraction_kill_plan(20, 0.15, round=2, seed=1)
+    planned = {f["site"] for f in plan["faults"]}
+    eng = SiteVectorizedEngine(
+        tmp_path, n_sites=20, trainer_cls=XorTrainer, dataset_cls=XorDataset,
+        fault_plan=plan, **{**BASE, "epochs": 1, "site_quorum": 0.5},
+    )
+    _fill_sites(eng, per_site=16)  # 2 batches/epoch → the round-2 kill fires
+    eng.run()
+    assert eng.success
+    assert eng.dead_sites == planned
+    assert set(eng.site_failures) == planned
+
+
+def test_vectorized_chaos_without_quorum_fails_loudly(tmp_path):
+    plan = fraction_kill_plan(8, 0.2, round=1, seed=0)
+    eng = SiteVectorizedEngine(
+        tmp_path, n_sites=8, trainer_cls=XorTrainer, dataset_cls=XorDataset,
+        fault_plan=plan, **{**BASE, "epochs": 1},
+    )
+    _fill_sites(eng, per_site=8)
+    with pytest.raises(Exception, match="injected crash"):
+        eng.run()
+
+
+def test_vectorized_quorum_unmet_fails_loudly(tmp_path):
+    """Killing half the roster under a 0.9 quorum must raise, naming the
+    dead sites."""
+    plan = fraction_kill_plan(8, 0.49, round=1, seed=0)
+    eng = SiteVectorizedEngine(
+        tmp_path, n_sites=8, trainer_cls=XorTrainer, dataset_cls=XorDataset,
+        fault_plan=plan, **{**BASE, "epochs": 1, "site_quorum": 0.9},
+    )
+    _fill_sites(eng, per_site=8)
+    with pytest.raises(RuntimeError, match="quorum unmet"):
+        eng.run()
+
+
+# ------------------------------------------------------- tree-reduce algebra
+def _fake_reducer(tmp_path, leaves_per_site, weights, fanin, guard=True):
+    """A COINNReducer over real on-disk payloads (the actual streaming
+    path), with a minimal stand-in trainer."""
+    base = os.path.join(tmp_path, "base")
+    inp = {}
+    for i, site_leaves in enumerate(leaves_per_site):
+        s = f"site_{i:03d}"
+        d = os.path.join(base, s)
+        os.makedirs(d, exist_ok=True)
+        tensorutils.save_arrays(os.path.join(d, "grads.npy"), site_leaves)
+        inp[s] = {"grads_file": "grads.npy",
+                  "grad_weight": float(weights[i])}
+    trainer = types.SimpleNamespace(
+        cache={Federation.REDUCE_FANIN: fanin, "seed": 0,
+               "guard_nonfinite": guard},
+        input=inp,
+        state={"baseDirectory": base,
+               "outputDirectory": os.path.join(tmp_path, "out"),
+               "transferDirectory": os.path.join(tmp_path, "xfer")},
+    )
+    os.makedirs(trainer.state["outputDirectory"], exist_ok=True)
+    return COINNReducer(trainer=trainer)
+
+
+@pytest.mark.parametrize("fanin", [2, 3, 8])
+def test_tree_reduce_property_matches_flat_guarded_mean(tmp_path, fanin):
+    """Property: for random payloads, random participation weights, and
+    random injected non-finite sites, the k-ary hierarchical file-streaming
+    reduce equals the flat ``_guarded_mean`` to fp tolerance."""
+    rng = np.random.default_rng(fanin)
+    n = 13
+    shapes = [(3, 4), (5,), (2, 2, 2)]
+    leaves = [rng.normal(size=(n,) + s).astype(np.float32) for s in shapes]
+    # random survivor mask: non-finite payloads at ~1/4 of the sites
+    for i in range(n):
+        if rng.random() < 0.25:
+            j = rng.integers(0, len(shapes))
+            leaves[j][i].flat[0] = [np.nan, np.inf, -np.inf][int(rng.integers(3))]
+    w0 = rng.integers(0, 2, size=n).astype(np.float32)
+    w0[rng.integers(0, n)] = 1.0  # at least one participant
+    flat, ok = _guarded_mean([jnp.asarray(x) for x in leaves], jnp.asarray(w0))
+    red = _fake_reducer(
+        tmp_path, [[leaf[i] for leaf in leaves] for i in range(n)], w0, fanin,
+    )
+    tree = red._tree_average("grads_file")
+    assert len(tree) == len(flat)
+    for a, b in zip(flat, tree):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b, np.float32),
+                                   rtol=2e-6, atol=2e-6)
+    # the nonfinite bookkeeping matches the flat path's
+    bad = [f"site_{i:03d}" for i in range(n) if not np.asarray(ok)[i]]
+    skipped = red.cache.get("skipped_sites")
+    if bad:
+        assert skipped and skipped[-1]["sites"] == bad
+    else:
+        assert not skipped
+    # no spill residue
+    assert not os.path.exists(
+        os.path.join(red.state["outputDirectory"], ".tree_reduce")
+    )
+
+
+def test_tree_reduce_all_dead_subtree_and_single_survivor(tmp_path):
+    """Edge cases the weight-total composition must absorb: a whole k-ary
+    subtree with zero surviving weight contributes nothing, and a single
+    global survivor reproduces its own payload exactly."""
+    n, k = 9, 3
+    rng = np.random.default_rng(0)
+    leaves = [rng.normal(size=(n, 4)).astype(np.float32)]
+    # sites 0..2 (exactly the first k-subtree): all non-finite
+    leaves[0][:3] = np.nan
+    # sites 3..5: participation weight 0 (fully-padded lockstep rounds)
+    w0 = np.ones(n, np.float32)
+    w0[3:6] = 0.0
+    flat, _ = _guarded_mean([jnp.asarray(leaves[0])], jnp.asarray(w0))
+    red = _fake_reducer(tmp_path, [[leaves[0][i]] for i in range(n)], w0, k)
+    tree = red._tree_average("grads_file")
+    np.testing.assert_allclose(np.asarray(flat[0]), tree[0], rtol=2e-6,
+                               atol=2e-6)
+
+    # single survivor: everyone else dead or non-participating
+    w1 = np.zeros(n, np.float32)
+    w1[7] = 1.0
+    red = _fake_reducer(tmp_path / "single",
+                        [[leaves[0][i]] for i in range(n)], w1, k)
+    tree = red._tree_average("grads_file")
+    np.testing.assert_allclose(tree[0], leaves[0][7], rtol=2e-6, atol=2e-6)
+
+    # everyone dead: a zero gradient, not NaN weights (flat-path contract)
+    leaves_dead = [np.full((n, 4), np.nan, np.float32)]
+    red = _fake_reducer(tmp_path / "dead",
+                        [[leaves_dead[0][i]] for i in range(n)],
+                        np.ones(n, np.float32), k)
+    tree = red._tree_average("grads_file")
+    np.testing.assert_array_equal(tree[0], np.zeros(4, np.float32))
+
+
+def test_tree_reduce_unguarded_matches_stacked_mean(tmp_path):
+    n, k = 7, 2
+    rng = np.random.default_rng(1)
+    leaves = [rng.normal(size=(n, 3, 2)).astype(np.float32)]
+    w0 = rng.uniform(0.0, 2.0, size=n).astype(np.float32)
+    flat = _stacked_mean([jnp.asarray(leaves[0])], jnp.asarray(w0))
+    red = _fake_reducer(tmp_path, [[leaves[0][i]] for i in range(n)], w0, k,
+                        guard=False)
+    tree = red._tree_average("grads_file")
+    np.testing.assert_allclose(np.asarray(flat[0]), tree[0], rtol=2e-6,
+                               atol=2e-6)
+
+
+def test_reduce_fanin_activates_tree_path(tmp_path, monkeypatch):
+    """``cache['reduce_fanin']`` routes ``reduce()`` through the streaming
+    tree; unset keeps the flat load-everything path."""
+    rng = np.random.default_rng(2)
+    leaves = [rng.normal(size=(5, 4)).astype(np.float32)]
+    red = _fake_reducer(tmp_path, [[leaves[0][i]] for i in range(5)],
+                        np.ones(5, np.float32), 2)
+    called = {}
+
+    def spy_tree(*a, **kw):
+        called["tree"] = True
+        return [leaves[0][0]]
+
+    monkeypatch.setattr(red, "_tree_average", spy_tree)
+    red.reduce()
+    assert called.get("tree")
+    red2 = _fake_reducer(tmp_path / "flat",
+                         [[leaves[0][i]] for i in range(5)],
+                         np.ones(5, np.float32), 0)
+    assert red2._tree_fanin() == 0
+
+
+def test_tree_reduce_golden_equality_on_chaos_acceptance_run(tmp_path):
+    """The ISSUE-6 acceptance gate: the 3-site chaos scenario (corrupted
+    payload recovered via wire retry + crashed site quorum-dropped after
+    retry exhaustion — ISSUE 5's golden test) re-run with the tree-reduce
+    active (fanin 2 over 3 sites) produces a score trajectory equal to the
+    flat reducer's run, fault plan and all."""
+    plan = {"faults": [
+        {"kind": "corrupt_payload", "round": 3, "site": "site_1",
+         "file": "grads.npy"},
+        {"kind": "crash", "round": 5, "site": "site_2"},
+    ]}
+
+    def engine(workdir, **extra):
+        eng = InProcessEngine(
+            workdir, n_sites=3, trainer_cls=XorTrainer,
+            dataset_cls=XorDataset, fault_plan=plan, site_quorum=2,
+            invoke_retry_attempts=2, **{**BASE, **extra},
+        )
+        _fill_sites(eng, per_site=16)
+        return eng
+
+    tree = engine(tmp_path / "tree", reduce_fanin=2)
+    tree.run(max_rounds=300)
+    assert tree.success and tree.dead_sites == {"site_2"}
+
+    flat = engine(tmp_path / "flat")
+    flat.run(max_rounds=300)
+    assert flat.success and flat.dead_sites == {"site_2"}
+
+    for key in ("train_log", "validation_log", "test_metrics"):
+        a = np.asarray(tree.remote_cache[key], np.float64)
+        b = np.asarray(flat.remote_cache[key], np.float64)
+        assert a.shape == b.shape, key
+        np.testing.assert_allclose(a, b, atol=1e-6, err_msg=key)
+
+
+# ------------------------------------------------- quorum normalization fix
+def test_quorum_need_normalizes_numeric_types():
+    """int-vs-float must never flip the interpretation: integral values are
+    site counts, fractions live strictly in (0, 1)."""
+    need = COINNRemote._quorum_need
+    assert need(1, 10) == 1
+    assert need(1.0, 10) == 1      # was: '100% of roster' before the fix
+    assert need(2.0, 10) == 2
+    assert need(0.5, 3) == 2       # ceil(1.5)
+    assert need(0.999, 10) == 10
+    for bad in (1.5, -1, 0.0, -0.25):
+        with pytest.raises(ValueError):
+            need(bad, 10)
+
+
+def test_quorum_unset_raises_on_every_reinvocation():
+    """The ADVICE r5 medium bug: a persisted-cache re-invocation with a
+    still-missing site and NO site_quorum must raise again, not silently
+    continue survivor-weighted."""
+    cache = {"all_sites": ["site_0", "site_1", "site_2"],
+             "dropped_sites": ["site_2"]}
+    remote = COINNRemote(cache=cache, input={
+        "site_0": {"phase": "computation"},
+        "site_1": {"phase": "computation"},
+    }, state={})
+    with pytest.raises(RuntimeError, match="stopped reporting"):
+        remote._check_quorum()
+    # and again — the failure is not edge-triggered
+    remote2 = COINNRemote(cache=dict(cache), input={
+        "site_0": {"phase": "computation"},
+        "site_1": {"phase": "computation"},
+    }, state={})
+    with pytest.raises(RuntimeError, match="stopped reporting"):
+        remote2._check_quorum()
+
+
+def test_quorum_configured_reinvocation_stays_quiet():
+    """With a policy configured, an unchanged drop set stays accepted (the
+    drop was judged the round it happened)."""
+    cache = {"all_sites": ["site_0", "site_1", "site_2"],
+             "dropped_sites": ["site_2"], "site_quorum": 2}
+    remote = COINNRemote(cache=cache, input={
+        "site_0": {"phase": "computation"},
+        "site_1": {"phase": "computation"},
+    }, state={})
+    remote._check_quorum()  # no raise
+    assert cache["dropped_sites"] == ["site_2"]

@@ -151,3 +151,15 @@ def test_resnet_s2d_stem_equals_plain_conv():
         )
         assert got.shape == want.shape
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=3e-5)
+
+
+def test_transformer_config_validation_survives_optimize_mode():
+    """TPDense/MultiHeadSelfAttention divisibility guards raise ValueError
+    (not bare assert, which ``python -O`` strips — ADVICE r5): a mis-sized
+    config must never reach dynamic_slice with silently wrong slices."""
+    from coinstac_dinunet_tpu.models.transformer import MultiHeadSelfAttention
+
+    mha = MultiHeadSelfAttention(num_heads=3)
+    x = jnp.zeros((2, 4, 8), jnp.float32)  # d_model 8 % 3 != 0
+    with pytest.raises(ValueError, match="must divide d_model"):
+        mha.init(jax.random.PRNGKey(0), x)

@@ -38,6 +38,20 @@ def test_nifti_scl_slope_applied(tmp_path):
     np.testing.assert_allclose(back, arr * 0.5 + 10.0, atol=1e-5)
 
 
+def test_nifti_scl_slope_zero_means_no_scaling(tmp_path):
+    """NIfTI-1 spec: scl_slope == 0 disables scaling entirely (scl_inter is
+    ignored too) — matching nibabel, so the same file loads identically
+    with or without it installed (ADVICE r5)."""
+    arr = np.arange(24, dtype=np.int16).reshape(2, 3, 4)
+    p = str(tmp_path / "unscaled.nii")
+    save_nifti(p, arr)
+    raw = bytearray(open(p, "rb").read())
+    struct.pack_into("<2f", raw, 112, 0.0, 10.0)  # slope 0, inter set
+    open(p, "wb").write(bytes(raw))
+    back = load_nifti(p)
+    np.testing.assert_array_equal(back, arr.astype(back.dtype))
+
+
 def test_nifti_big_endian(tmp_path):
     """Endianness comes from sizeof_hdr's byte order, not assumed."""
     arr = np.arange(8, dtype=np.int16).reshape(2, 2, 2)
