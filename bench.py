@@ -38,25 +38,15 @@ import numpy as np
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
-# bf16 peak FLOPS per chip by device kind (dense, no sparsity)
-_PEAK_TFLOPS = {
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,
-    "TPU v5e": 197.0,
-    "TPU v5": 459.0,
-    "TPU v5p": 459.0,
-    "TPU v6 lite": 918.0,
-}
-
-
 def _peak_flops():
+    """bf16 peak FLOPS of this chip from the shared per-backend table
+    (``telemetry/perf.py::PEAK_TFLOPS_BY_DEVICE_KIND`` — one source of
+    truth with the perf flight recorder's MFU denominator)."""
     import jax
 
-    kind = jax.devices()[0].device_kind
-    for k, v in _PEAK_TFLOPS.items():
-        if kind.startswith(k):
-            return v * 1e12
-    return None
+    from coinstac_dinunet_tpu.telemetry.perf import peak_flops_for
+
+    return peak_flops_for(jax.devices()[0].device_kind)
 
 
 def _fence(x):
@@ -64,16 +54,15 @@ def _fence(x):
 
 
 def _step_flops(fn, *args):
-    """Model FLOPs of one compiled step from XLA's cost analysis."""
-    import jax
+    """Model FLOPs of one compiled step via the shared XLA cost-analysis
+    helper (``telemetry/perf.py::step_flops``).  A failure is a typed
+    reason on stderr (e.g. ``cost_analysis_unavailable``), never silent."""
+    from coinstac_dinunet_tpu.telemetry.perf import step_flops
 
-    try:
-        cost = jax.jit(fn).lower(*args).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        return float(cost["flops"])
-    except Exception:
-        return None
+    flops, reason = step_flops(fn, *args)
+    if flops is None:
+        print(f"# step flops unavailable: {reason}", file=sys.stderr)
+    return flops
 
 
 def _bench_single_step(trainer, batch, steps, warmup):

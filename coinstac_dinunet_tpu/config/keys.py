@@ -174,6 +174,23 @@ class Metric:
       ``‖G−CᵀB‖/‖G‖``).
     - ``EFFECTIVE_RANK`` — entropy effective rank of the factorization's
       spectrum (rank-collapse signal).
+
+    Perf flight-recorder series (``telemetry/perf.py``):
+
+    - ``SAMPLES_PER_SEC`` — per-round training throughput of the compiled
+      step (padded samples / wall seconds, one host fence per round).
+    - ``ACHIEVED_TFLOPS`` — XLA cost-analysis FLOPs of the executed step
+      divided by its wall time.
+    - ``MFU`` — ``ACHIEVED_TFLOPS`` over the backend's peak
+      (``telemetry/perf.py::PEAK_TFLOPS_BY_DEVICE_KIND``, overridable via
+      ``cache['peak_tflops']``).
+    - ``HBM_IN_USE`` / ``HBM_PEAK`` / ``HBM_LIMIT`` — device memory bytes
+      per round (``device.memory_stats()``; live-buffer census fallback).
+    - ``HBM_UTILIZATION`` — in-use / limit (the pressure detector's series;
+      only recorded when a limit is known).
+    - ``ROUNDS_PER_SEC`` / ``SITES_PER_SEC`` — mega-federation engine
+      throughput per round (``federation/engine.py``), same round
+      definition as ``scripts/bench_federation.py``'s headline.
     """
 
     GRAD_NORM = "grad_norm"
@@ -186,6 +203,15 @@ class Metric:
     SURVIVORS = "survivors"
     COMPRESSION_ERROR = "compression_error"
     EFFECTIVE_RANK = "effective_rank"
+    SAMPLES_PER_SEC = "samples_per_sec"
+    ACHIEVED_TFLOPS = "achieved_tflops"
+    MFU = "mfu"
+    HBM_IN_USE = "hbm_in_use_bytes"
+    HBM_PEAK = "hbm_peak_bytes"
+    HBM_LIMIT = "hbm_limit_bytes"
+    HBM_UTILIZATION = "hbm_utilization"
+    ROUNDS_PER_SEC = "rounds_per_sec"
+    SITES_PER_SEC = "sites_per_sec"
 
 
 class Anomaly:
@@ -206,6 +232,10 @@ class Anomaly:
     - ``COMPRESSION_SPIKE`` — compression reconstruction error spiked vs
       its EMA.
     - ``RANK_COLLAPSE`` — the factorization's effective rank collapsed.
+    - ``MEMORY_LEAK`` — device memory in use grew for N consecutive rounds
+      (the buffers-retained-across-rounds signature).
+    - ``MEMORY_PRESSURE`` — device memory utilization crossed the
+      near-limit threshold (next stop: OOM).
     """
 
     NONFINITE = "nonfinite"
@@ -214,6 +244,8 @@ class Anomaly:
     VAL_STALL = "val_stall"
     COMPRESSION_SPIKE = "compression_spike"
     RANK_COLLAPSE = "rank_collapse"
+    MEMORY_LEAK = "memory_leak"
+    MEMORY_PRESSURE = "memory_pressure"
 
 
 class Retry:
@@ -276,6 +308,49 @@ class Federation:
 
     REDUCE_FANIN = "reduce_fanin"
     SITE_SHARDS = "site_shards"
+
+
+class Perf:
+    """Cache-key vocabulary for the perf flight recorder
+    (:mod:`coinstac_dinunet_tpu.telemetry.perf`).
+
+    Plain ``str`` constants, mirroring :class:`Retry`:
+
+    - ``PEAK_TFLOPS`` — override the per-backend peak-FLOPS table
+      (``telemetry/perf.py::PEAK_TFLOPS_BY_DEVICE_KIND``) for the MFU
+      denominator, in TFLOPS.  Required for an honest MFU on backends the
+      table does not know (CPU hosts, exotic GPUs).
+    - ``MFU_CEILING`` — the model's *structural* MFU ceiling (docs/PERF.md
+      lane-fill argument; the width-16 flagship's is ~0.25).  Shown in the
+      doctor's roofline section as the third line of the
+      achieved / ceiling / peak comparison.
+    - ``MEMORY_LIMIT`` — device memory budget in bytes for the
+      live-buffer-census fallback (backends whose ``memory_stats()``
+      reports no ``bytes_limit``); enables the ``hbm_utilization`` series
+      and the memory-pressure detector there.
+    """
+
+    PEAK_TFLOPS = "peak_tflops"
+    MFU_CEILING = "mfu_ceiling"
+    MEMORY_LIMIT = "memory_limit_bytes"
+
+
+class Capture:
+    """Cache-key vocabulary for anomaly-triggered profiler capture
+    (:mod:`coinstac_dinunet_tpu.telemetry.capture`).
+
+    - ``ON_ANOMALY`` — arm deep capture: ``True`` captures on ANY watchdog
+      anomaly; a string or list names the :class:`Anomaly` kinds that
+      trigger it.  When armed, the round AFTER the anomaly runs under
+      ``utils/profiling.py::device_trace`` and the XLA profile is retained
+      under the node's ``outputDirectory`` with a ``capture:profile``
+      event linking it to the trigger.  Default off (profiles are heavy).
+    - ``MAX_PROFILES`` — retained-capture budget per node per run
+      (default 2): anomalies can repeat; disk must not.
+    """
+
+    ON_ANOMALY = "capture_on_anomaly"
+    MAX_PROFILES = "capture_max_profiles"
 
 
 # Keys a node reads from ``input`` that the ENGINE/compspec injects on the

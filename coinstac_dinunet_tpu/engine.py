@@ -24,6 +24,7 @@ import numpy as np
 
 from . import config, telemetry, utils
 from .config.keys import Key, Mode, Phase
+from .telemetry import capture as _capture
 from .data import COINNDataHandle
 from .nodes import COINNLocal, COINNRemote
 from .resilience import transport as wire_transport
@@ -818,6 +819,16 @@ class MeshEngine:
             devices=self.devices, devices_per_site=self.devices_per_site,
         )
 
+    def _recorder(self):
+        """Engine-lane recorder (``telemetry.engine.jsonl`` in the
+        workdir), enabled by the same ``profile``/``telemetry`` flags as
+        the node-side recorders.  The base mesh engine records no per-site
+        invocation spans, but capture events (``capture:profile``/
+        ``capture:failed`` from the anomaly-triggered profiler wrap in
+        ``_run_fold_loop``) must land on a REAL lane — a null recorder
+        here would silently drop the postmortem's capture links."""
+        return _engine_recorder(self, [self.cache, *self.site_args.values()])
+
     def _round_hook(self, site_batches):
         """Per-round boundary before the compiled federated step — the hook
         subclasses with a per-site dropout/chaos story override (the
@@ -877,7 +888,13 @@ class MeshEngine:
                             {**tb, "_mask": np.zeros_like(np.asarray(tb["_mask"]))}
                             for tb in template
                         ]
-                aux = fed.train_step(self._round_hook(site_batches))
+                # an anomaly-armed deep capture (telemetry/capture.py)
+                # wraps the whole compiled federated round; no-op (one
+                # dict lookup) unless a watchdog detector armed it
+                with _capture.captured_round(
+                    rc, self.remote_out_dir, self._recorder()
+                ):
+                    aux = fed.train_step(self._round_hook(site_batches))
                 trainer.fold_train_outputs(aux, ep_averages, ep_metrics)
                 done += take
             if epoch % val_every != 0:

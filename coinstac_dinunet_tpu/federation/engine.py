@@ -29,11 +29,15 @@ At ISSUE-6 scale this is the "kill 5% of 2,000 sites" story:
 plan, this engine absorbs the deaths, and the stacked step never changes
 shape (dead sites ride along fully masked).
 """
+import time
+
 import numpy as np
 
+from ..config.keys import Metric
 from ..engine import MeshEngine
 from ..nodes.remote import COINNRemote
 from ..resilience.chaos import ChaosFault, ChaosSession
+from ..telemetry import perf as _perf
 from ..utils import logger
 from .vector import SiteVectorizedFederation
 
@@ -49,15 +53,7 @@ class SiteVectorizedEngine(MeshEngine):
         self.site_shards = site_shards
         self.rounds = 0
         self.site_failures = {}
-
-    # ------------------------------------------------------------- telemetry
-    def _recorder(self):
-        """Engine-lane recorder (``telemetry.engine.jsonl`` in the workdir),
-        enabled by the same ``profile``/``telemetry`` flags as the node-side
-        recorders (shared resolution: :func:`~..engine._engine_recorder`)."""
-        from ..engine import _engine_recorder
-
-        return _engine_recorder(self, [self.cache, *self.site_args.values()])
+        self._round_t = None  # (wall, perf) stamp of the previous hook
 
     # ------------------------------------------------------ federation plane
     def _build_federation(self, rc):
@@ -69,11 +65,15 @@ class SiteVectorizedEngine(MeshEngine):
                 f"sequence_parallel={sp}/tensor_parallel={tp} need the "
                 "per-rank MeshEngine"
             )
-        return SiteVectorizedFederation(
+        fed = SiteVectorizedFederation(
             self._trainer, self.n_sites,
             agg_engine=str(rc.get("agg_engine", "dSGD")),
             devices=self.devices, site_shards=self.site_shards,
         )
+        # the engine-lane recorder doubles as the vectorized plane's perf
+        # sink (jit_cost of the one-jit round + per-step wall time)
+        fed.recorder = self._recorder()
+        return fed
 
     # --------------------------------------------------------- site dropout
     def _site_failure(self, s, exc):
@@ -117,9 +117,29 @@ class SiteVectorizedEngine(MeshEngine):
         """The per-site round boundary of the vectorized plane: chaos
         invoke faults fire here, and dead sites' batches degrade to
         fully-masked placeholders (weight 0 in the compiled reduce) so the
-        stacked step never changes shape."""
-        self.rounds += 1
+        stacked step never changes shape.
+
+        Perf flight recorder: each hook closes the PREVIOUS round — an
+        ``engine:round`` span (hook-to-hook wall time, the same round
+        definition ``scripts/bench_federation.py`` times) plus
+        ``rounds_per_sec`` / ``sites_per_sec`` metric records and one
+        device-memory sample, so the doctor's throughput trend and
+        roofline cover the mega-federation path."""
         rec = self._recorder()
+        now_wall, now = time.time(), time.perf_counter()
+        prev, self._round_t = self._round_t, (now_wall, now)
+        if prev is not None and rec.enabled:
+            dt = now - prev[1]
+            rec.record_span("engine:round", prev[0], dt, cat="engine",
+                            round=self.rounds)
+            if dt > 0:
+                alive = len(self.site_ids) - len(self.dead_sites)
+                rec.metric(Metric.ROUNDS_PER_SEC, 1.0 / dt,
+                           round=self.rounds)
+                rec.metric(Metric.SITES_PER_SEC, alive / dt,
+                           round=self.rounds)
+            _perf.sample_device_memory(self.cache, recorder=rec)
+        self.rounds += 1
         rec.set_context(round=self.rounds)
         try:
             for s in self.site_ids:

@@ -49,6 +49,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..config.keys import Federation, MeshAxis
 from ..nn.basetrainer import TrainState
 from ..parallel.mesh import build_site_only_mesh
+from ..telemetry import NULL_RECORDER
+from ..telemetry import perf as _perf
 from ..utils.jax_compat import shard_map
 
 
@@ -101,6 +103,10 @@ class SiteVectorizedFederation:
         self._step = None
         self._eval = None
         self.rounds_done = 0
+        # perf flight recorder sink — the engine binds its own lane here
+        # (federation/engine.py); the null singleton keeps every perf
+        # branch a single attribute test otherwise
+        self.recorder = NULL_RECORDER
 
     # ---------------------------------------------------------- site stacking
     def _stacked_site_state(self):
@@ -232,20 +238,44 @@ class SiteVectorizedFederation:
 
     def train_step(self, site_batches):
         """One federated round for every simulated site — a single compiled
-        call over the stacked site axis."""
+        call over the stacked site axis.  With the engine's recorder bound
+        (``self.recorder``), the build records its XLA cost (``jit_cost``
+        for the WHOLE B-site round) and every step records fenced wall
+        time → the ``samples_per_sec``/``achieved_tflops``/``mfu`` series
+        cover the mega-federation path."""
         if self._site_state is None:
             self._site_state = self._place(
                 self._stacked_site_state(), P(MeshAxis.SITE)
             )
-        if self._step is None:
-            self._step = self._build_step()
+        rec = self.recorder
         stacked = (self.stack_site_batches(site_batches)
                    if isinstance(site_batches, (list, tuple))
                    else site_batches)
+        built = self._step is None
+        if built:
+            self._step = self._build_step()
+            if rec.enabled:
+                _perf.record_jit_cost(
+                    self.trainer.cache, "fed_step", self._step,
+                    (self.trainer.train_state.params, self._site_state,
+                     self._site_ix, stacked),
+                    recorder=rec,
+                )
+        timer = _perf.StepTimer() if rec.enabled else None
         new_params, self._site_state, aux = self._step(
             self.trainer.train_state.params, self._site_state,
             self._site_ix, stacked,
         )
+        if timer is not None and not built:
+            # fenced wall time — skipped on the build round, whose wall
+            # time is XLA compile, not a step (jit_cost marks the build)
+            jax.block_until_ready(aux["loss"])
+            leaf = jax.tree_util.tree_leaves(stacked)[0]
+            timer.done(
+                self.trainer.cache, "fed_step",
+                int(leaf.shape[0]) * int(leaf.shape[1]) * int(leaf.shape[2]),
+                recorder=rec,
+            )
         # keep the trainer's single-site view current (checkpoints, eval):
         # row 0 IS the shared state under the replication invariant
         site = self._site_state

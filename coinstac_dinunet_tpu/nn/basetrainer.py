@@ -20,6 +20,7 @@ User subclasses implement ``_init_nn_model`` (build flax modules) and
 ``iteration(params, batch, rng)`` — a PURE function of its inputs returning at
 least ``{'loss': scalar}`` (plus optional ``pred``/``true``/``averages``).
 """
+import contextlib
 import os
 from typing import Any
 
@@ -33,8 +34,10 @@ import optax
 from .. import config
 from ..config.keys import Key, MeshAxis, Mode
 from ..metrics import COINNAverages, Prf1a
+from ..telemetry import capture as _capture
 from ..telemetry import get_active as _telemetry
 from ..telemetry import health as _health
+from ..telemetry import perf as _perf
 from ..utils import atomic_write, logger
 from ..utils.jax_compat import shard_map
 from ..utils.utils import performance_improved_, stop_training_
@@ -545,6 +548,33 @@ class NNTrainer:
             trainer=type(self).__qualname__,
         )
 
+    def _note_jit_cost(self, key, fn, args):
+        """Perf flight recorder: XLA cost analysis (flops, bytes accessed)
+        of a freshly built executable, as a ``jit_cost`` event + the flops
+        registry feeding the per-round achieved-TFLOPS/MFU series
+        (telemetry/perf.py).  One extra trace per build when telemetry is
+        enabled; nothing otherwise."""
+        rec = _telemetry()
+        if rec.enabled:
+            _perf.record_jit_cost(self.cache, str(key), fn, args,
+                                  recorder=rec)
+
+    def _perf_round_end(self, timer, key, stacked, rec, built=False):
+        """Per-round perf bookkeeping after the step's host fence: the
+        samples/s + achieved-TFLOPS/MFU series and one device-memory
+        sample (leak/pressure detectors).  ``stacked`` carries the padded
+        (k, B, ...) batch the step consumed.  ``built`` marks the round
+        that (re)compiled the executable: its wall time is XLA compile
+        time, not a step — recording it would put a ~1000x-low sample at
+        the head of every throughput series (the ``jit_cost`` event
+        already marks the build), so only the memory sample is kept."""
+        if not built:
+            leaf = jax.tree_util.tree_leaves(stacked)[0]
+            timer.done(self.cache, key,
+                       int(leaf.shape[0]) * int(leaf.shape[1]),
+                       recorder=rec)
+        _perf.sample_device_memory(self.cache, recorder=rec)
+
     # ---- local multi-device data parallelism ----------------------------
     # ≙ the reference's automatic torch.nn.DataParallel fan-out over a
     # site's GPUs (ref ``nn/basetrainer.py:62-74``): train/eval steps shard
@@ -659,23 +689,40 @@ class NNTrainer:
         n = self._dp_device_count(
             jax.tree_util.tree_leaves(stacked_batches)[0].shape[1]
         )
-        if n > 1:
-            grads, aux = self._compute_grads_dp(ts, stacked_batches, n)
-        else:
-            fn = self._compiled.get("grads")
-            if fn is None:
-                self._note_jit_build("grads")
-                metrics_shell, averages_shell = self._metrics_shell()
+        # perf flight recorder: time the round (the grad-health norm below
+        # is the host fence) and wrap it in the profiler when an anomaly
+        # armed a deep capture (telemetry/capture.py) — both enabled-only
+        timer = _perf.StepTimer() if rec.enabled else None
+        cm = (_capture.captured_round(
+                  self.cache, self.state.get("outputDirectory"), rec)
+              if rec.enabled else contextlib.nullcontext())
+        built = False
+        with cm:
+            if n > 1:
+                key = f"grads_dp:{n}"
+                built = ("grads_dp", n) not in self._compiled
+                grads, aux = self._compute_grads_dp(ts, stacked_batches, n)
+            else:
+                key = "grads"
+                fn = self._compiled.get("grads")
+                if fn is None:
+                    built = True
+                    self._note_jit_build("grads")
+                    metrics_shell, averages_shell = self._metrics_shell()
 
-                def _grads(ts, stacked):
-                    return self._grads_uncompiled(ts, stacked, metrics_shell, averages_shell)
+                    def _grads(ts, stacked):
+                        return self._grads_uncompiled(ts, stacked, metrics_shell, averages_shell)
 
-                fn = self._compiled["grads"] = jax.jit(_grads)
-            grads, aux = fn(ts, stacked_batches)
-        if rec.enabled:
-            # host-side, AROUND the compiled call: global grad norm + its
-            # watchdog EMA + the round's mean loss (docs/TELEMETRY.md)
-            _health.record_grad_health(self.cache, grads, aux, recorder=rec)
+                    fn = self._compiled["grads"] = jax.jit(_grads)
+                    self._note_jit_cost("grads", fn, (ts, stacked_batches))
+                grads, aux = fn(ts, stacked_batches)
+            if rec.enabled:
+                # host-side, AROUND the compiled call: global grad norm +
+                # its watchdog EMA + the round's mean loss — the host sync
+                # also fences the step for the timer (docs/TELEMETRY.md)
+                _health.record_grad_health(self.cache, grads, aux, recorder=rec)
+        if timer is not None:
+            self._perf_round_end(timer, key, stacked_batches, rec, built=built)
         return grads, aux
 
     def _build_dp_step(self, n, apply_updates, donate):
@@ -722,6 +769,7 @@ class NNTrainer:
             fn = self._compiled[("grads_dp", n)] = self._build_dp_step(
                 n, apply_updates=False, donate=()
             )
+            self._note_jit_cost(f"grads_dp:{n}", fn, (ts, stacked_batches))
         return fn(ts, stacked_batches)
 
     def apply_grads(self, ts, grads, new_rng=None):
@@ -754,34 +802,54 @@ class NNTrainer:
         (≙ the reference's automatic DataParallel, ``nn/basetrainer.py:
         62-74``); the mask-weighted reduction keeps the update identical to
         the single-device step (up to per-shard dropout streams)."""
-        _telemetry().count("train_steps")
+        rec = _telemetry()
+        rec.count("train_steps")
         n = self._dp_device_count(
             jax.tree_util.tree_leaves(stacked_batches)[0].shape[1]
         )
-        if n > 1:
-            return self._train_step_dp(ts, stacked_batches, n)
-        fn = self._compiled.get("train")
-        if fn is None:
-            self._note_jit_build("train")
-            metrics_shell, averages_shell = self._metrics_shell()
+        timer = _perf.StepTimer() if rec.enabled else None
+        cm = (_capture.captured_round(
+                  self.cache, self.state.get("outputDirectory"), rec)
+              if rec.enabled else contextlib.nullcontext())
+        built = False
+        with cm:
+            if n > 1:
+                key = f"train_dp:{n}"
+                built = ("train_dp", n) not in self._compiled
+                out = self._train_step_dp(ts, stacked_batches, n)
+            else:
+                key = "train"
+                fn = self._compiled.get("train")
+                if fn is None:
+                    built = True
+                    self._note_jit_build("train")
+                    metrics_shell, averages_shell = self._metrics_shell()
 
-            def _full(ts, stacked):
-                grads, aux = self._grads_uncompiled(ts, stacked, metrics_shell, averages_shell)
-                ts = self._apply_updates(ts, grads)
-                ts = ts.replace(rng=aux["rng"])
-                return ts, aux
+                    def _full(ts, stacked):
+                        grads, aux = self._grads_uncompiled(ts, stacked, metrics_shell, averages_shell)
+                        ts = self._apply_updates(ts, grads)
+                        ts = ts.replace(rng=aux["rng"])
+                        return ts, aux
 
-            # donate the incoming train state: params/opt buffers update in
-            # place on the accelerator instead of doubling HBM footprint
-            # (no-op on CPU, where donation only emits warnings)
-            donate = (
-                (0,)
-                if jax.default_backend() != "cpu"
-                and self.cache.get("donate_buffers", True)
-                else ()
-            )
-            fn = self._compiled["train"] = jax.jit(_full, donate_argnums=donate)
-        return fn(ts, stacked_batches)
+                    # donate the incoming train state: params/opt buffers update in
+                    # place on the accelerator instead of doubling HBM footprint
+                    # (no-op on CPU, where donation only emits warnings)
+                    donate = (
+                        (0,)
+                        if jax.default_backend() != "cpu"
+                        and self.cache.get("donate_buffers", True)
+                        else ()
+                    )
+                    fn = self._compiled["train"] = jax.jit(_full, donate_argnums=donate)
+                    self._note_jit_cost("train", fn, (ts, stacked_batches))
+                out = fn(ts, stacked_batches)
+            if timer is not None:
+                # one scalar fence per round: the flight recorder trades a
+                # sliver of pipelining for honest wall time (enabled only)
+                jax.block_until_ready(out[1]["loss"])
+        if timer is not None:
+            self._perf_round_end(timer, key, stacked_batches, rec, built=built)
+        return out
 
     def _train_step_dp(self, ts, stacked_batches, n):
         fn = self._compiled.get(("train_dp", n))
@@ -796,6 +864,7 @@ class NNTrainer:
             fn = self._compiled[("train_dp", n)] = self._build_dp_step(
                 n, apply_updates=True, donate=donate
             )
+            self._note_jit_cost(f"train_dp:{n}", fn, (ts, stacked_batches))
         return fn(ts, stacked_batches)
 
     def _grads_uncompiled(self, ts, stacked, metrics_shell, averages_shell,
@@ -877,6 +946,7 @@ class NNTrainer:
                 return m_state, a_state, it
 
             fn = self._compiled["eval"] = jax.jit(_eval)
+            self._note_jit_cost("eval", fn, (ts, batch))
         return fn(ts, batch)
 
     def _eval_step_dp(self, ts, batch, n):
@@ -920,6 +990,7 @@ class NNTrainer:
                     check_vma=False,
                 )
             )
+            self._note_jit_cost(f"eval_dp:{n}", fn, (ts, batch))
         return fn(ts, batch)
 
     # ----------------------------------------------------------- train / eval

@@ -425,7 +425,13 @@ class COINNRemote:
             for site, site_vars in self.input.items():
                 h = site_vars.get(LocalWire.HEALTH.value)
                 if h:
-                    per_site[site] = {"counts": h.get("counts", {})}
+                    entry = {"counts": h.get("counts", {})}
+                    # federation-wide utilization: each site's perf
+                    # flight-recorder rollup (samples/s, MFU, HBM) rides
+                    # the same health broadcast (telemetry/perf.py)
+                    if h.get("perf"):
+                        entry["perf"] = h["perf"]
+                    per_site[site] = entry
             if per_site:
                 fed["sites"] = per_site
             if fed:

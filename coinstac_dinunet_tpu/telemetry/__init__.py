@@ -15,10 +15,18 @@ Public surface:
   ``metric`` records at the host-side choke points.
 - :mod:`.watchdog` — pluggable anomaly detectors over those series
   (non-finite, explosion, divergence outlier, stall, compression spike,
-  rank collapse); observe-and-report, with opt-in site quarantine.
+  rank collapse, memory leak/pressure); observe-and-report, with opt-in
+  site quarantine.
+- :mod:`.perf` — the perf flight recorder: XLA cost analysis per compiled
+  executable (``jit_cost``), per-round achieved-TFLOPS/MFU/samples-per-sec
+  series vs a per-backend peak table, and device-memory sampling
+  (``memory_stats()`` / live-buffer census).
+- :mod:`.capture` — anomaly-triggered deep capture: a watchdog firing can
+  arm the XLA profiler for the next round, retaining the profile under the
+  node's output directory with a ``capture:profile`` event linking it.
 - :mod:`.doctor` — postmortem report over a merged run (anomaly timeline,
-  per-site divergence, ranked verdicts); CLI at
-  ``python -m coinstac_dinunet_tpu.telemetry doctor``.
+  per-site divergence, roofline + MFU/memory floor verdicts, ranked
+  verdicts); CLI at ``python -m coinstac_dinunet_tpu.telemetry doctor``.
 
 jax-free by design: importing this package never pulls in jax (the recorder
 bridges to ``jax.monitoring`` only if jax is already loaded, and

@@ -1,0 +1,137 @@
+"""Anomaly-triggered deep capture: attach an XLA profile to the postmortem.
+
+The watchdog (:mod:`.watchdog`) tells you *that* a round went wrong; the
+profiler (:func:`~..utils.profiling.device_trace`) tells you *what the
+device was doing* — but nothing ever triggered it, so postmortems shipped
+timelines without profiles.  This module wires the two together:
+
+- **Arming** (:func:`maybe_arm`): when a watchdog detector fires and
+  ``cache['capture_on_anomaly']`` covers its kind (``True`` = any; a
+  string/list names specific :class:`~..config.keys.Anomaly` kinds), a
+  pending-capture marker lands in ``cache['health']`` (JSON-able, rides
+  the same fresh-process persistence as the detector state).
+- **Capturing** (:func:`captured_round`): the NEXT round's compiled-step
+  choke point (``nn/basetrainer.py``, ``MeshEngine._run_fold_loop``) wraps
+  itself in ``device_trace`` when a capture is pending.  The profile is
+  retained under the node's ``outputDirectory`` (``profile_capture/
+  round<N>_<anomaly>/``) and a ``capture:profile`` event links it to the
+  triggering anomaly — the doctor lists it in the postmortem.
+- **Budget**: ``cache['capture_max_profiles']`` (default 2) bounds
+  retained profiles per node per run; anomalies can repeat, disk must not.
+
+A profiler failure (already active, unsupported backend) emits a
+``capture:failed`` event and never harms the run.  Zero overhead when
+disabled: call sites only consult this module under ``rec.enabled``.
+"""
+import contextlib
+import os
+
+from ..config.keys import Capture
+from .recorder import get_active
+
+__all__ = ["maybe_arm", "captured_round", "capture_wanted"]
+
+#: subdirectory of the node's outputDirectory holding retained profiles
+CAPTURE_DIR = "profile_capture"
+
+_DEFAULT_MAX_PROFILES = 2
+
+
+def capture_wanted(cache, anomaly):
+    """True when ``cache['capture_on_anomaly']`` covers ``anomaly``."""
+    want = (cache or {}).get(Capture.ON_ANOMALY)
+    if not want:
+        return False
+    if want is True:
+        return True
+    if isinstance(want, str):
+        return want == str(anomaly)
+    try:
+        return str(anomaly) in [str(w) for w in want]
+    except TypeError:
+        return False
+
+
+def maybe_arm(cache, anomaly, recorder=None):
+    """Arm a deep capture for the next round if configuration asks for it.
+
+    Called by the watchdog at anomaly-emission time.  First trigger wins
+    (an armed capture is not re-targeted by later anomalies in the same
+    round); the budget check happens here so an exhausted node stops
+    arming instead of repeatedly skipping at capture time.
+    """
+    if cache is None or not capture_wanted(cache, anomaly):
+        return False
+    health = cache.setdefault("health", {})
+    if health.get("capture_pending"):
+        return False
+    budget = int(cache.get(Capture.MAX_PROFILES, _DEFAULT_MAX_PROFILES))
+    if int(health.get("captures_taken", 0)) >= budget:
+        return False
+    health["capture_pending"] = {
+        "anomaly": str(anomaly),
+        "armed_round": int(cache.get("telemetry_round", 0) or 0),
+    }
+    rec = recorder if recorder is not None else get_active()
+    rec.event("capture:armed", cat="capture", anomaly=str(anomaly))
+    return True
+
+
+@contextlib.contextmanager
+def _profiled(cache, out_dir, rec, pending):
+    anomaly = pending.get("anomaly", "anomaly")
+    rnd = int(cache.get("telemetry_round", 0) or 0)
+    path = os.path.join(
+        str(out_dir), CAPTURE_DIR, f"round{rnd}_{_sanitize(anomaly)}"
+    )
+    health = cache.setdefault("health", {})
+    from ..utils.profiling import device_trace
+
+    try:
+        trace = device_trace(path)
+        trace.__enter__()
+    except Exception as exc:  # noqa: BLE001 — capture must never kill a run
+        rec.event("capture:failed", cat="capture", anomaly=anomaly,
+                  error=f"{type(exc).__name__}: {exc}"[:300])
+        yield None
+        return
+    try:
+        yield path
+    finally:
+        try:
+            trace.__exit__(None, None, None)
+        except Exception as exc:  # noqa: BLE001
+            rec.event("capture:failed", cat="capture", anomaly=anomaly,
+                      error=f"{type(exc).__name__}: {exc}"[:300])
+        else:
+            health["captures_taken"] = int(health.get("captures_taken", 0)) + 1
+            rec.event(
+                "capture:profile", cat="capture", anomaly=anomaly,
+                path=path, armed_round=pending.get("armed_round"),
+            )
+
+
+def captured_round(cache, out_dir, recorder=None):
+    """Context manager for a round's compiled step: a no-op unless a
+    capture is pending (one dict lookup), else the XLA profiler wraps the
+    block and the profile is retained + event-linked.  The pending marker
+    is consumed either way — a failed capture does not retry forever, and
+    a node with no output directory records a ``capture:failed`` instead
+    of wedging the armed marker (which would block all future arming)."""
+    pending = (cache or {}).get("health", {}).get("capture_pending")
+    if not pending:
+        return contextlib.nullcontext()
+    cache["health"].pop("capture_pending", None)
+    rec = recorder if recorder is not None else get_active()
+    if not out_dir:
+        rec.event(
+            "capture:failed", cat="capture",
+            anomaly=pending.get("anomaly"),
+            error="no outputDirectory to retain the profile under",
+        )
+        return contextlib.nullcontext()
+    return _profiled(cache, out_dir, rec, pending)
+
+
+def _sanitize(name):
+    return "".join(c if (c.isalnum() or c in "-_") else "_" for c in str(name))

@@ -15,6 +15,7 @@ import math
 import os
 import re
 
+from ..config.keys import Metric
 from .recorder import FILE_PREFIX, FILE_SUFFIX
 
 _FILE_RE = re.compile(
@@ -252,6 +253,14 @@ def render_summary(summary):
 
 
 # ------------------------------------------------------------- chrome trace
+# perf flight-recorder series (telemetry/perf.py) exported as per-node
+# "utilization" counter tracks — built from the config/keys.py vocabulary
+# so a new perf series can never silently fall back to the plain category
+_UTILIZATION_METRICS = frozenset((
+    Metric.SAMPLES_PER_SEC, Metric.ACHIEVED_TFLOPS, Metric.MFU,
+    Metric.HBM_IN_USE, Metric.HBM_PEAK, Metric.HBM_LIMIT,
+    Metric.HBM_UTILIZATION, Metric.ROUNDS_PER_SEC, Metric.SITES_PER_SEC,
+))
 _CTX_KEYS = ("round", "fold", "epoch", "phase")
 _RECORD_KEYS = ("v", "kind", "name", "cat", "t0", "dur", "node", "op",
                 "file", "bytes", "arrays", "codec", "raw_bytes", "ratio",
@@ -329,7 +338,11 @@ def chrome_trace(events):
             if math.isfinite(v):
                 suffix = f":{rec['site']}" if rec.get("site") else ""
                 out.append({
-                    "name": f"metric:{name}{suffix}", "cat": "metric",
+                    "name": f"metric:{name}{suffix}",
+                    # perf flight-recorder series get their own Perfetto
+                    # category so per-node utilization tracks are filterable
+                    "cat": ("utilization" if name in _UTILIZATION_METRICS
+                            else "metric"),
                     "ph": "C", "ts": ts, "pid": p, "tid": 0,
                     "args": {"value": v},
                 })

@@ -55,6 +55,12 @@ def build_report(events, bench_history=None, regression_threshold=0.10):
                   "invoke_retries": 0}
     dead_sites = {}
     chaos = []
+    # perf flight recorder evidence: the backend's roofline constants
+    # (perf:backend), retained profiler captures (capture:profile), and
+    # cost-analysis degradation (perf:cost_unavailable)
+    backend = None
+    captures = []
+    cost_unavailable = []
 
     def site_entry(site):
         return sites.setdefault(str(site), {
@@ -118,6 +124,23 @@ def build_report(events, bench_history=None, regression_threshold=0.10):
                     "site": rec.get("site"),
                     "file": rec.get("file"),
                 })
+            elif name == "perf:backend" and backend is None:
+                backend = {
+                    "device_kind": rec.get("device_kind"),
+                    "devices": rec.get("devices"),
+                    "peak_tflops": rec.get("peak_tflops"),
+                    "peak_source": rec.get("peak_source"),
+                    "ceiling_mfu": rec.get("ceiling_mfu"),
+                }
+            elif name == "capture:profile":
+                captures.append({
+                    "anomaly": rec.get("anomaly"),
+                    "round": rec.get("round"),
+                    "node": rec.get("node"),
+                    "path": rec.get("path"),
+                })
+            elif name == "perf:cost_unavailable":
+                cost_unavailable.append(str(rec.get("reason", "")))
         elif kind == "span" and name == "engine:round":
             rounds.append(float(rec.get("dur", 0.0) or 0.0))
 
@@ -167,9 +190,65 @@ def build_report(events, bench_history=None, regression_threshold=0.10):
         "resilience": resilience,
         "dead_sites": dead_sites,
         "chaos": chaos,
+        "roofline": _roofline_data(metrics, backend, cost_unavailable),
+        "captures": captures,
+        "mfu_floor": _mfu_floor_data(
+            bench_history, metrics, regression_threshold
+        ),
     }
     report["verdicts"] = _rank_verdicts(report)
     return report
+
+
+# --------------------------------------------------------------- roofline
+_PERF_SERIES = ("achieved_tflops", "mfu", "samples_per_sec",
+                "rounds_per_sec", "sites_per_sec")
+_MEMORY_SERIES = {
+    "in_use_bytes": "hbm_in_use_bytes", "peak_bytes": "hbm_peak_bytes",
+    "limit_bytes": "hbm_limit_bytes", "utilization": "hbm_utilization",
+}
+
+
+def _roofline_data(metrics, backend, cost_unavailable):
+    """Achieved-vs-ceiling-vs-peak comparison + device-memory summary from
+    the folded metric series.  None when the run recorded no perf series
+    at all (a pre-flight-recorder trace renders exactly as before)."""
+    perf = {k: metrics[k] for k in _PERF_SERIES if k in metrics}
+    memory = {k: metrics[m] for k, m in _MEMORY_SERIES.items()
+              if m in metrics}
+    if not perf and not memory and backend is None:
+        return None
+    out = {"backend": backend, "memory": memory or None}
+    out.update({k: perf.get(k) for k in _PERF_SERIES})
+    if cost_unavailable:
+        # the series can be truncated/absent for a VISIBLE reason
+        out["cost_unavailable"] = sorted(set(cost_unavailable))
+    return out
+
+
+def _mfu_floor_data(bench_history, metrics, threshold):
+    """MFU-floor check: the run's best measured MFU vs the ledger's last
+    recorded ``mfu`` (``bench.py`` writes one per entry).  One entry is
+    enough — the floor is an absolute reference, unlike the two-entry
+    throughput diff."""
+    if not bench_history:
+        return None
+    ref = next(
+        (float(e["mfu"]) for e in reversed(bench_history)
+         if _finite(e.get("mfu")) and float(e["mfu"]) > 0),
+        None,
+    )
+    measured = (metrics.get("mfu") or {}).get("max")
+    if ref is None or measured is None:
+        return None
+    return {
+        "ledger_mfu": ref,
+        # 6 decimals: CPU-host MFU against a nominal peak is legitimately
+        # ~1e-6, which a 4-decimal round would display as a confusing 0.0
+        "measured_mfu": round(float(measured), 6),
+        "threshold_pct": round(100.0 * threshold, 1),
+        "below_floor": float(measured) < ref * (1.0 - threshold),
+    }
 
 
 def _bench_verdict_data(bench_history, threshold):
@@ -263,6 +342,10 @@ def _rank_verdicts(report):
             )
     for kind, severity, cause in (
         ("grad_explosion", "critical", "gradient explosion"),
+        ("memory_pressure", "critical",
+         "device memory near its limit (OOM imminent)"),
+        ("memory_leak", "warning",
+         "device memory grew round over round (leak)"),
         ("compression_spike", "warning",
          "compression reconstruction error spiked"),
         ("rank_collapse", "warning",
@@ -294,6 +377,37 @@ def _rank_verdicts(report):
             f"{bench.get('unit', 'samples/sec/chip')} {bench['latest']:g} "
             f"vs {bench['previous']:g} ({bench['drop_pct']:+.1f}% drop, "
             f"threshold {bench['threshold_pct']:g}%)",
+        )
+    floor = report.get("mfu_floor")
+    if floor and floor["below_floor"]:
+        add(
+            "warning",
+            "MFU below the benchmark ledger floor",
+            f"measured MFU {floor['measured_mfu']:g} vs ledger "
+            f"{floor['ledger_mfu']:g} (threshold "
+            f"{floor['threshold_pct']:g}%) — the run sustained less of the "
+            "hardware than the last recorded bench",
+        )
+    roof = report.get("roofline") or {}
+    util = (roof.get("memory") or {}).get("utilization") or {}
+    if util.get("max") is not None and util["max"] >= 0.9:
+        add(
+            "warning",
+            "device memory headroom below 10%",
+            f"peak HBM utilization {util['max']:.1%} — the next allocation "
+            "spike is an OOM; shrink the batch or shard the state",
+        )
+    captures = report.get("captures") or []
+    if captures:
+        named = "; ".join(
+            f"{c['anomaly']} @ round {c['round']} → {c['path']}"
+            for c in captures
+        )
+        add(
+            "info",
+            f"{len(captures)} profiler capture(s) retained",
+            f"anomaly-triggered deep captures attached: {named}",
+            weight=len(captures),
         )
     res = report.get("resilience") or {}
     if res.get("corruption_recovered"):
@@ -331,6 +445,90 @@ def _rank_verdicts(report):
 
 
 # --------------------------------------------------------------- renderers
+def _stat(series, fmt="{:.4g}"):
+    """last (min..max, n) rendering of one folded metric-stats dict."""
+    if not series or series.get("last") is None:
+        return "-"
+    return (fmt.format(series["last"])
+            + f" (min {fmt.format(series['min'])}, "
+              f"max {fmt.format(series['max'])}, n={series['count']})")
+
+
+def _gib(series):
+    if not series or series.get("last") is None:
+        return "-"
+    scaled = dict(series)
+    for k in ("last", "min", "max"):
+        if isinstance(scaled.get(k), (int, float)):
+            scaled[k] = scaled[k] / 2**30
+    return _stat(scaled, fmt="{:.3f}GiB")
+
+
+def _render_roofline(report):
+    """The roofline + device-memory block: achieved TFLOPS/MFU against the
+    structural ceiling and the backend peak, plus the HBM series.  Empty
+    list when the run recorded no perf flight-recorder series."""
+    roof = report.get("roofline")
+    if not roof:
+        return []
+    lines = ["## Roofline (perf flight recorder)", ""]
+    backend = roof.get("backend") or {}
+    if backend.get("device_kind"):
+        peak = backend.get("peak_tflops")
+        lines.append(
+            f"Backend: {backend['device_kind']} × "
+            f"{backend.get('devices') or '?'}"
+            + (f", peak {peak:g} TFLOPS ({backend.get('peak_source')})"
+               if peak else ", peak unknown (set cache['peak_tflops'])")
+            + (f", structural ceiling {backend['ceiling_mfu']:.0%} MFU "
+               "(docs/PERF.md)" if backend.get("ceiling_mfu") else "")
+            + "."
+        )
+        lines.append("")
+    rows = [
+        ("achieved TFLOPS", _stat(roof.get("achieved_tflops"))),
+        ("MFU", _stat(roof.get("mfu"))),
+        ("samples/sec", _stat(roof.get("samples_per_sec"))),
+    ]
+    if roof.get("rounds_per_sec"):
+        rows.append(("rounds/sec", _stat(roof["rounds_per_sec"])))
+    if roof.get("sites_per_sec"):
+        rows.append(("sites/sec", _stat(roof["sites_per_sec"])))
+    lines.extend(_md_table(("series", "last (min..max, samples)"), rows))
+    lines.append("")
+    memory = roof.get("memory")
+    if memory:
+        rows = [(label, _gib(memory.get(key)) if "bytes" in key
+                 else _stat(memory.get(key)))
+                for key, label in (
+                    ("in_use_bytes", "HBM in use"),
+                    ("peak_bytes", "HBM peak"),
+                    ("limit_bytes", "HBM limit"),
+                    ("utilization", "HBM utilization"),
+                ) if memory.get(key)]
+        lines.append("### Device memory")
+        lines.append("")
+        lines.extend(_md_table(("series", "last (min..max, samples)"), rows))
+        lines.append("")
+    floor = report.get("mfu_floor")
+    if floor:
+        state = ("**BELOW FLOOR**" if floor["below_floor"]
+                 else "at or above the floor")
+        lines.append(
+            f"MFU floor: measured {floor['measured_mfu']:g} vs ledger "
+            f"{floor['ledger_mfu']:g} (threshold {floor['threshold_pct']:g}%)"
+            f" — {state}."
+        )
+        lines.append("")
+    if roof.get("cost_unavailable"):
+        lines.append(
+            "Cost analysis unavailable for some executables: "
+            + ", ".join(roof["cost_unavailable"]) + "."
+        )
+        lines.append("")
+    return lines
+
+
 def _md_table(headers, rows):
     out = ["| " + " | ".join(headers) + " |",
            "|" + "|".join("---" for _ in headers) + "|"]
@@ -432,6 +630,20 @@ def render_markdown(report):
             f"max {rounds['max_s']}s, second-half trend "
             f"{rounds['trend_pct']:+.1f}%."
         )
+        lines.append("")
+
+    lines.extend(_render_roofline(report))
+
+    captures = report.get("captures") or []
+    if captures:
+        lines.append("## Profiler captures")
+        lines.append("")
+        lines.extend(_md_table(
+            ("anomaly", "round", "node", "profile"),
+            [(c["anomaly"] or "-", c["round"] if c["round"] is not None
+              else "-", c["node"] or "-", c["path"] or "-")
+             for c in captures],
+        ))
         lines.append("")
 
     bench = report.get("bench")

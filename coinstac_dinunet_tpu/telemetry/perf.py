@@ -1,0 +1,320 @@
+"""Perf flight recorder — XLA cost/MFU accounting and device-memory series.
+
+The ROADMAP's MFU arc ("regressions are verdicts, not vibes") needs
+utilization measured continuously per round, not benchmarked occasionally
+(Podracer, arXiv:2104.06272): ``bench.py`` knew the flagship's FLOPs but
+only at bench time, nothing recorded device memory at all, and a throughput
+regression between bench runs was invisible.  This module closes that gap
+as a layer on the PR-3 :class:`~.recorder.Recorder`:
+
+- :func:`step_cost` / :func:`step_flops` — the SHARED XLA cost-analysis
+  helper (extracted from ``bench.py::_step_flops``): FLOPs + bytes accessed
+  of a staged computation, with a TYPED failure reason
+  (:data:`COST_UNAVAILABLE`, ``lower_failed: ...``) instead of a silent
+  ``None``.  Prefers ``Lowered.cost_analysis()`` (no compile) and only
+  falls back to compiling when the caller allows it.
+- :func:`record_jit_cost` — called at every trainer ``jit_build``: records
+  a ``jit_cost`` event (flops, bytes accessed) per compiled executable and
+  stashes the FLOPs under ``cache['_perf_flops']`` (leading underscore:
+  never part of the compiled-bucket key) so per-round utilization can be
+  computed from wall time alone.
+- :func:`record_step_perf` — per-round throughput series:
+  ``samples_per_sec``, ``achieved_tflops``, ``mfu`` vs the per-backend
+  peak table (:data:`PEAK_TFLOPS_BY_DEVICE_KIND`, ``cache['peak_tflops']``
+  override), plus a JSON-able rollup under ``cache['health']['perf']``
+  that rides the existing ``HEALTH`` wire keys — the aggregator sees
+  federation-wide utilization without a new wire field.
+- :func:`sample_device_memory` — per-round HBM in-use/peak/limit from
+  ``device.memory_stats()`` where the backend provides it, with a
+  live-buffer census fallback (``jax.live_arrays()``) elsewhere; feeds the
+  watchdog's memory-leak and near-limit-pressure detectors.
+
+Zero overhead when disabled: every public helper early-returns on the
+null recorder, so a disabled call site costs the usual one attribute
+lookup + one no-op call.  Host-side only, like all telemetry — never
+inside a traced function (the ``trace-telemetry`` dinulint rule applies).
+"""
+import time
+
+from ..config.keys import Metric, Perf
+from .health import record_metric
+from .recorder import get_active
+
+__all__ = [
+    "COST_UNAVAILABLE", "PEAK_TFLOPS_BY_DEVICE_KIND", "peak_flops_for",
+    "step_cost", "step_flops", "record_jit_cost", "record_step_perf",
+    "sample_device_memory",
+]
+
+#: typed reason when XLA reports no cost analysis for a computation
+COST_UNAVAILABLE = "cost_analysis_unavailable"
+
+#: bf16 peak FLOPS (TFLOPS, dense, no sparsity) by device-kind prefix —
+#: the MFU denominator.  Single source of truth shared with ``bench.py``.
+#: No CPU entry on purpose: a made-up CPU peak would be a fake MFU — set
+#: ``cache['peak_tflops']`` explicitly for CPU/unknown backends.
+PEAK_TFLOPS_BY_DEVICE_KIND = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5": 459.0,
+    "TPU v5p": 459.0,
+    "TPU v6 lite": 918.0,
+}
+
+#: flops-registry cache key (leading underscore: excluded from the shared
+#: compiled-step bucket key by the basetrainer's underscore rule)
+FLOPS_CACHE_KEY = "_perf_flops"
+
+
+def peak_flops_for(device_kind):
+    """Peak FLOPS/sec for a device kind from the table, or None."""
+    kind = str(device_kind)
+    for prefix, tflops in PEAK_TFLOPS_BY_DEVICE_KIND.items():
+        if kind.startswith(prefix):
+            return tflops * 1e12
+    return None
+
+
+def resolve_peak_flops(cache=None):
+    """The MFU denominator in FLOPS/sec: ``cache['peak_tflops']`` wins,
+    else the device-kind table, else None (MFU not recordable)."""
+    if cache:
+        override = cache.get(Perf.PEAK_TFLOPS)
+        if override:
+            return float(override) * 1e12
+    import jax
+
+    return peak_flops_for(jax.devices()[0].device_kind)
+
+
+# ----------------------------------------------------------- cost analysis
+def _cost_dict(cost):
+    """Normalize XLA's cost analysis (dict, or list of per-executable
+    dicts) to one {'flops', 'bytes_accessed'} dict, or None."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not isinstance(cost, dict) or "flops" not in cost:
+        return None
+    flops = float(cost["flops"])
+    if flops < 0:  # XLA reports -1 for "unknown"
+        return None
+    return {
+        "flops": flops,
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0) or 0.0),
+    }
+
+
+def step_cost(staged, *args, allow_compile=False):
+    """XLA cost analysis of a jit-staged callable at ``args``.
+
+    Returns ``(cost, reason)``: ``cost`` is ``{'flops', 'bytes_accessed'}``
+    or None, in which case ``reason`` is the typed failure
+    (``lower_failed: ...`` or :data:`COST_UNAVAILABLE`).  Lowering traces
+    the function once (cheap); ``allow_compile=True`` additionally permits
+    a throwaway compile when the lowered module reports no cost — only for
+    callers that can afford a duplicate compile (``bench.py``).
+    """
+    try:
+        lowered = staged.lower(*args)
+    except Exception as exc:  # noqa: BLE001 — reason is the contract
+        return None, f"lower_failed: {exc}"[:300]
+    cost = None
+    try:
+        cost = _cost_dict(lowered.cost_analysis())
+    except Exception:  # noqa: BLE001 — backend may not implement it
+        cost = None
+    if cost is None and allow_compile:
+        try:
+            cost = _cost_dict(lowered.compile().cost_analysis())
+        except Exception as exc:  # noqa: BLE001
+            return None, f"compile_failed: {exc}"[:300]
+    if cost is None:
+        return None, COST_UNAVAILABLE
+    return cost, None
+
+
+def step_flops(fn, *args, allow_compile=True):
+    """Model FLOPs of one step — the shared successor to
+    ``bench.py::_step_flops``.  ``fn`` may be a plain callable (it is
+    jit-wrapped here) or an already-staged jit function.  Returns
+    ``(flops, reason)``: exactly one of the two is None.
+    """
+    import jax
+
+    staged = fn if hasattr(fn, "lower") else jax.jit(fn)
+    cost, reason = step_cost(staged, *args, allow_compile=allow_compile)
+    if cost is None:
+        return None, reason
+    return cost["flops"], None
+
+
+def record_jit_cost(cache, key, staged, args, recorder=None):
+    """Flight-record one compiled executable's cost at ``jit_build`` time.
+
+    Emits a ``jit_cost`` event (flops + bytes accessed, ``cat=compile`` —
+    the compile DURATION arrives separately via the ``jax.monitoring``
+    bridge) and stashes the FLOPs under ``cache['_perf_flops'][key]`` for
+    :func:`record_step_perf`.  An unavailable cost is a
+    ``perf:cost_unavailable`` event with the typed reason — visible in the
+    trace, never silent.  Returns the flops or None.
+    """
+    rec = recorder if recorder is not None else get_active()
+    if not rec.enabled:
+        return None
+    _emit_backend_event(cache, rec)
+    cost, reason = step_cost(staged, *args, allow_compile=False)
+    if cost is None:
+        rec.event("perf:cost_unavailable", cat="perf", fn=str(key),
+                  reason=reason)
+        return None
+    rec.event(
+        "jit_cost", cat="compile", fn=str(key), flops=cost["flops"],
+        bytes_accessed=cost["bytes_accessed"],
+    )
+    if cache is not None:
+        cache.setdefault(FLOPS_CACHE_KEY, {})[str(key)] = cost["flops"]
+    return cost["flops"]
+
+
+def _emit_backend_event(cache, rec):
+    """One ``perf:backend`` event per recorder: device kind, peak TFLOPS
+    (+ its source) and the structural MFU ceiling — the roofline constants
+    the doctor reads back out of the merged timeline (it keeps the first).
+    Per-recorder, not per-process: a later run in the same process must
+    still get one into ITS timeline, and jit builds are rare enough
+    (shared compiled bucket) that the duplication is a few lines."""
+    if getattr(rec, "_perf_backend_emitted", False):
+        return
+    try:
+        rec._perf_backend_emitted = True
+    except AttributeError:  # a slotted custom sink: emit every time
+        pass
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    override = (cache or {}).get(Perf.PEAK_TFLOPS)
+    peak = resolve_peak_flops(cache)
+    attrs = {"device_kind": str(kind), "devices": jax.device_count()}
+    if peak:
+        attrs["peak_tflops"] = round(peak / 1e12, 3)
+        attrs["peak_source"] = "cache" if override else "table"
+    ceiling = (cache or {}).get(Perf.MFU_CEILING)
+    if ceiling:
+        attrs["ceiling_mfu"] = float(ceiling)
+    rec.event("perf:backend", cat="perf", **attrs)
+
+
+# ------------------------------------------------------- per-round metrics
+def record_step_perf(cache, key, dur_s, samples, recorder=None):
+    """Per-round utilization series from one compiled step's wall time.
+
+    ``key`` names the executable (the ``_compiled`` bucket key whose
+    ``jit_cost`` stashed the FLOPs), ``dur_s`` the host-fenced wall
+    seconds, ``samples`` the padded sample count the step consumed.
+    Records ``samples_per_sec`` always, ``achieved_tflops`` when the
+    FLOPs are known, ``mfu`` when the peak is too — and mirrors the
+    latest values into ``cache['health']['perf']`` so they ride the
+    ``HEALTH`` wire rollup federation-wide.
+    """
+    rec = recorder if recorder is not None else get_active()
+    if not rec.enabled or not dur_s or dur_s <= 0:
+        return
+    sps = float(samples) / dur_s
+    record_metric(Metric.SAMPLES_PER_SEC, sps, recorder=rec, fn=str(key))
+    roll = {"samples_per_sec": round(sps, 2), "step_s": round(dur_s, 6)}
+    flops = (cache.get(FLOPS_CACHE_KEY) or {}).get(str(key)) if cache else None
+    if flops:
+        tflops = flops / dur_s / 1e12
+        record_metric(Metric.ACHIEVED_TFLOPS, tflops, recorder=rec,
+                      fn=str(key))
+        roll["achieved_tflops"] = round(tflops, 4)
+        peak = resolve_peak_flops(cache)
+        if peak:
+            mfu = tflops * 1e12 / peak
+            record_metric(Metric.MFU, mfu, recorder=rec, fn=str(key))
+            roll["mfu"] = round(mfu, 4)
+    if cache is not None:
+        health = cache.setdefault("health", {})
+        health.setdefault("perf", {}).update(roll)
+
+
+def sample_device_memory(cache, recorder=None, leak_watch=True):
+    """One device-memory sample: HBM in-use/peak/limit metric series plus
+    the ``hbm_utilization`` fraction when a limit is known, feeding the
+    watchdog's leak and pressure detectors.
+
+    Source is ``device.memory_stats()`` where the backend implements it
+    (TPU/GPU); otherwise a live-buffer census (``jax.live_arrays()``) with
+    an optional ``cache['memory_limit_bytes']`` budget.  Returns the
+    in-use byte count or None.
+
+    ``leak_watch=False`` records the in-use sample without feeding the
+    leak detector — for out-of-cadence samples (the validation phase),
+    whose legitimate allocation spike would otherwise reset the detector's
+    growth streak and mask a genuine training-loop leak.  The pressure
+    detector still sees the utilization either way: an eval spike near the
+    limit is a real OOM risk.
+    """
+    rec = recorder if recorder is not None else get_active()
+    if not rec.enabled:
+        return None
+    import jax
+
+    dev = jax.local_devices()[0]
+    stats = None
+    try:
+        stats = dev.memory_stats()
+    except Exception:  # noqa: BLE001 — optional backend API
+        stats = None
+    if stats:
+        in_use = float(stats.get("bytes_in_use", 0) or 0)
+        peak = stats.get("peak_bytes_in_use")
+        limit = stats.get("bytes_limit")
+        source = "memory_stats"
+    else:
+        try:
+            in_use = float(sum(a.nbytes for a in jax.live_arrays()))
+        except Exception:  # noqa: BLE001 — census is best-effort too
+            return None
+        peak, limit = None, None
+        source = "live_buffer_census"
+    if limit is None and cache:
+        limit = cache.get(Perf.MEMORY_LIMIT)
+    # leak detection watches the in-use series (cache-bound → watchdog)
+    record_metric(Metric.HBM_IN_USE, in_use,
+                  cache=(cache if leak_watch else None), recorder=rec,
+                  source=source)
+    if peak is not None:
+        record_metric(Metric.HBM_PEAK, float(peak), recorder=rec)
+    roll = {"hbm_in_use_bytes": in_use, "memory_source": source}
+    if peak is not None:
+        roll["hbm_peak_bytes"] = float(peak)
+    if limit:
+        record_metric(Metric.HBM_LIMIT, float(limit), recorder=rec)
+        util = in_use / float(limit)
+        record_metric(Metric.HBM_UTILIZATION, util, cache=cache,
+                      recorder=rec)
+        roll["hbm_limit_bytes"] = float(limit)
+        roll["hbm_utilization"] = round(util, 4)
+    if cache is not None:
+        health = cache.setdefault("health", {})
+        health.setdefault("perf", {}).update(roll)
+    return in_use
+
+
+class StepTimer:
+    """Tiny helper for the per-round timing pattern: construct when the
+    recorder is enabled, ``done(...)`` after the host fence.  Exists so
+    choke points stay one line each."""
+
+    __slots__ = ("t0",)
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+
+    def done(self, cache, key, samples, recorder=None):
+        record_step_perf(
+            cache, key, time.perf_counter() - self.t0, samples,
+            recorder=recorder,
+        )

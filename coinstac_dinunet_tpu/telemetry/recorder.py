@@ -89,6 +89,9 @@ class _NullRecorder:
     def span(self, name, cat="phase", **attrs):
         return _NULL_SPAN
 
+    def record_span(self, name, t0, dur, cat="phase", **attrs):
+        pass
+
     def begin_invocation(self, **context):
         pass
 
@@ -193,6 +196,7 @@ class Recorder:
         self._lock = threading.Lock()
         self._io_lock = threading.Lock()  # keeps concurrent flushes' JSONL lines whole
         _maybe_install_jax_compile_listener()
+        _maybe_emit_degraded(self)
 
     # ------------------------------------------------------------- factories
     @classmethod
@@ -257,6 +261,12 @@ class Recorder:
     def span(self, name, cat="phase", **attrs):
         """Context manager measuring one section as a span record."""
         return _Span(self, name, cat, attrs)
+
+    def record_span(self, name, t0, dur, cat="phase", **attrs):
+        """Emit an already-measured span (start wall-clock + duration).
+        For sections whose boundaries are only known retroactively — e.g.
+        the site-vectorized engine's round N, measured hook-to-hook."""
+        self._end_span(name, cat, float(t0), float(dur), attrs)
 
     def _end_span(self, name, cat, t0, dt, attrs, failed=False):
         rec = {"v": SCHEMA_VERSION, "kind": "span", "name": name, "cat": cat,
@@ -373,6 +383,11 @@ def _sanitize(name):
 
 # --------------------------------------------------------------- jax bridge
 _JAX_LISTENER_INSTALLED = False
+# registration failure, kept for the one-time telemetry:degraded event (a
+# missing jax.monitoring API must be VISIBLE in the trace, not silent —
+# the compile-duration series simply ending would read as "no compiles")
+_JAX_LISTENER_ERROR = None
+_DEGRADED_EMITTED = False
 
 
 def _maybe_install_jax_compile_listener():
@@ -380,8 +395,10 @@ def _maybe_install_jax_compile_listener():
     compiles, tracing) to the ambient recorder — the recompile counter the
     per-invocation process model otherwise hides.  Installed once per
     process, only when jax is ALREADY imported (telemetry itself must never
-    pull in jax), and tolerant of the monitoring API not existing."""
-    global _JAX_LISTENER_INSTALLED
+    pull in jax), and tolerant of the monitoring API not existing (the
+    failure is recorded as a ``telemetry:degraded`` event by the first
+    enabled recorder, see :func:`_maybe_emit_degraded`)."""
+    global _JAX_LISTENER_INSTALLED, _JAX_LISTENER_ERROR
     if _JAX_LISTENER_INSTALLED or "jax" not in sys.modules:
         return
     _JAX_LISTENER_INSTALLED = True  # one attempt per process, even on failure
@@ -389,8 +406,23 @@ def _maybe_install_jax_compile_listener():
         from jax import monitoring
 
         monitoring.register_event_duration_secs_listener(_on_jax_duration)
-    except Exception:  # noqa: BLE001 — monitoring is best-effort
-        pass
+    except Exception as exc:  # noqa: BLE001 — monitoring is best-effort
+        _JAX_LISTENER_ERROR = f"{type(exc).__name__}: {exc}"[:300]
+
+
+def _maybe_emit_degraded(recorder):
+    """One ``telemetry:degraded`` event per process on the first recorder
+    built after a failed jax.monitoring registration — the compile-duration
+    bridge being dead is now evidence in the trace instead of silence."""
+    global _DEGRADED_EMITTED
+    if _JAX_LISTENER_ERROR is None or _DEGRADED_EMITTED:
+        return
+    _DEGRADED_EMITTED = True
+    recorder.event(
+        "telemetry:degraded", cat="telemetry",
+        what="jax.monitoring compile-duration bridge unavailable",
+        error=_JAX_LISTENER_ERROR,
+    )
 
 
 def _on_jax_duration(event, duration, **kw):

@@ -40,6 +40,7 @@ import math
 
 from ..config.keys import Anomaly, Metric
 from ..utils import logger
+from .capture import maybe_arm
 from .recorder import get_active
 
 # bounded rollup: the health summary rides the wire every round
@@ -242,6 +243,71 @@ class RankCollapseDetector(Detector):
         return None
 
 
+@register_detector(Anomaly.MEMORY_LEAK, metric=Metric.HBM_IN_USE)
+class MemoryLeakDetector(Detector):
+    """Device memory in use growing for ``cache['watchdog_leak_rounds']``
+    consecutive observations (default 5, each >1% over the previous — XLA
+    allocators jitter, monotone-to-the-byte would false-negative), after a
+    ``cache['watchdog_leak_warmup']`` grace period (default 8: startup
+    legitimately allocates params/opt state/first buffers round over
+    round).  The buffers-retained-across-rounds signature: a healthy
+    steady-state round returns to a flat in-use level; a leak ratchets.
+    Fires once per sustained-growth excursion; re-arms when the series
+    stops growing."""
+
+    tolerance = 1.01
+
+    def check(self, state, value, site, cache):
+        if not _finite(value):
+            return None
+        prev = state.get("prev")
+        state["prev"] = value
+        n = int(state.get("n", 0))
+        state["n"] = n + 1
+        if n < int(cache.get("watchdog_leak_warmup", 8)):
+            return None
+        if prev is None or value <= prev * self.tolerance:
+            state["streak"] = 0
+            state["tripped"] = False
+            return None
+        streak = int(state.get("streak", 0)) + 1
+        state["streak"] = streak
+        patience = int(cache.get("watchdog_leak_rounds", 5))
+        if streak >= patience and not state.get("tripped"):
+            state["tripped"] = True
+            return {
+                "detail": (
+                    f"device memory grew {streak} consecutive rounds to "
+                    f"{value:.4g} bytes"
+                ),
+            }
+        return None
+
+
+@register_detector(Anomaly.MEMORY_PRESSURE, metric=Metric.HBM_UTILIZATION)
+class MemoryPressureDetector(Detector):
+    """Device memory utilization crossing
+    ``cache['watchdog_memory_pressure']`` (default 0.92) — the next
+    allocation spike is an OOM.  Edge-triggered on the crossing."""
+
+    def check(self, state, value, site, cache):
+        if not _finite(value):
+            return None
+        threshold = float(cache.get("watchdog_memory_pressure", 0.92))
+        if value >= threshold:
+            if not state.get("tripped"):
+                state["tripped"] = True
+                return {
+                    "detail": (
+                        f"device memory at {value:.1%} of its limit "
+                        f"(threshold {threshold:g})"
+                    ),
+                }
+        else:
+            state["tripped"] = False
+        return None
+
+
 class Watchdog:
     """The per-node anomaly watchdog bound to a node cache + recorder.
 
@@ -316,6 +382,9 @@ class Watchdog:
             + f" — {hit.get('detail', '')}",
             True,
         )
+        # anomaly-triggered deep capture: arm the profiler for the NEXT
+        # round when configured (telemetry/capture.py; default off)
+        maybe_arm(self.cache, anomaly, self.rec)
         if self.cache.get("quarantine_on_anomaly") and site is not None:
             q = self.cache.setdefault("quarantined_sites", [])
             if str(site) not in q:
@@ -333,15 +402,20 @@ class Watchdog:
     # --------------------------------------------------------------- summary
     def summary(self):
         """Wire-sized health summary (the ``health`` wire key payload):
-        recent anomalies plus per-anomaly counts, and — when the resilience
-        layer retried any wire load — the node's retry-pressure counters
+        recent anomalies plus per-anomaly counts; the node's wire
+        retry-pressure counters when the resilience layer retried any load
         (``cache['wire_retry_stats']``, resilience/retry.py), so a flaky
-        relay is visible federation-wide before it escalates to a dropout.
+        relay is visible federation-wide before it escalates to a dropout;
+        and the perf flight recorder's latest utilization rollup
+        (``telemetry/perf.py`` — samples/s, MFU, device memory), so the
+        aggregator sees federation-wide utilization over the same wire.
         Empty dict = healthy."""
         anomalies = self.state.get("anomalies", [])
         wire = self.cache.get("wire_retry_stats") or {}
         wire = {k: v for k, v in wire.items() if v}
-        if not anomalies and not self.cache.get("quarantined_sites") and not wire:
+        perf = self.state.get("perf") or {}
+        if (not anomalies and not self.cache.get("quarantined_sites")
+                and not wire and not perf):
             return {}
         counts = {}
         for a in anomalies:
@@ -349,6 +423,8 @@ class Watchdog:
         out = {"counts": counts, "recent": anomalies[-10:]}
         if wire:
             out["wire"] = wire
+        if perf:
+            out["perf"] = dict(perf)
         if self.cache.get("quarantined_sites"):
             out["quarantined"] = list(self.cache["quarantined_sites"])
         return out

@@ -14,6 +14,7 @@ from .metrics import new_metrics as _metric_factory
 from .nn.basetrainer import NNTrainer
 from .telemetry import get_active as _telemetry
 from .telemetry import health as _health
+from .telemetry import perf as _perf
 from .utils.utils import performance_improved_
 
 
@@ -61,6 +62,12 @@ class COINNTrainer(NNTrainer):
             except AttributeError:
                 score = averages.average
             _health.record_val_score(self.cache, score, recorder=rec)
+            # eval allocates its own buffers: a memory sample here catches
+            # validation-phase growth the train-round samples would miss.
+            # leak_watch=False: this out-of-cadence spike must not reset
+            # the leak detector's train-round growth streak
+            _perf.sample_device_memory(self.cache, recorder=rec,
+                                       leak_watch=False)
         return {
             Key.VALIDATION_SERIALIZABLE.value: [
                 {"averages": averages.serialize(), "metrics": metrics.serialize()}

@@ -59,12 +59,21 @@ class PhaseTimer:
 
 @contextlib.contextmanager
 def device_trace(log_dir):
-    """XLA profiler trace for the wrapped section (TensorBoard format)."""
+    """XLA profiler trace for the wrapped section (TensorBoard format).
+
+    Yields the trace directory (created if absent) so callers that retain
+    the profile — the anomaly-triggered capture in
+    :mod:`~coinstac_dinunet_tpu.telemetry.capture` — can link it into
+    their own records."""
+    import os
+
     import jax
 
-    jax.profiler.start_trace(str(log_dir))
+    log_dir = str(log_dir)
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
     try:
-        yield
+        yield log_dir
     finally:
         jax.profiler.stop_trace()
 

@@ -62,8 +62,25 @@ for _ in range(1 if fast else 3):
         ts, aux = t.train_step(ts, stacked)
     float(np.asarray(aux["loss"]))
     best = min(best, (time.perf_counter() - t0) / steps)
-print(json.dumps({"ms_per_step": round(best * 1e3, 3),
-                  "samples_per_sec": round(batch / best, 1)}))
+entry = {"ms_per_step": round(best * 1e3, 3),
+         "samples_per_sec": round(batch / best, 1)}
+# achieved TFLOPS / MFU via the shared cost-analysis helper (typed
+# failure reason instead of a silently missing field)
+import jax
+from coinstac_dinunet_tpu.telemetry.perf import peak_flops_for, step_flops
+flops, reason = step_flops(
+    lambda ts, st: t._grads_uncompiled(ts, st, *t._metrics_shell())[0],
+    ts, stacked,
+)
+if flops:
+    tf = flops / best / 1e12
+    entry["achieved_tflops"] = round(tf, 4)
+    peak = peak_flops_for(jax.devices()[0].device_kind)
+    if peak:
+        entry["mfu"] = round(tf * 1e12 / peak, 4)
+else:
+    entry["flops_reason"] = reason
+print(json.dumps(entry))
 """
 
 

@@ -1,0 +1,551 @@
+"""Perf flight recorder (ISSUE 7): XLA cost/MFU accounting, device-memory
+telemetry, anomaly-triggered profiler capture, roofline doctor verdicts.
+
+- **Cost helper**: the shared ``step_flops``/``step_cost`` extraction
+  returns real FLOPs for a live computation and a TYPED reason (never a
+  silent None) when XLA can't price it.
+- **Detectors**: the memory-leak and memory-pressure detectors fire
+  EXACTLY ONCE at the seeded index of a synthetic series and re-arm on
+  recovery (same contract as every ISSUE-4 detector).
+- **Capture**: a watchdog anomaly arms the profiler under
+  ``capture_on_anomaly``; the next round's choke point retains the profile
+  and links it with a ``capture:profile`` event; the budget bounds disk.
+- **Doctor**: golden roofline section from a canned trace; the MFU-floor
+  verdict against a ledger entry >10% above the measured run; a
+  well-formed report when the perf series are empty or missing entirely.
+- **Overhead**: the disabled-recorder bound extends to the perf-metric
+  path (record_step_perf / sample_device_memory guards).
+"""
+import json
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu.config.keys import Anomaly, Metric
+from coinstac_dinunet_tpu.telemetry import (
+    NULL_RECORDER,
+    Recorder,
+    Watchdog,
+    activate,
+    capture,
+    perf,
+)
+from coinstac_dinunet_tpu.telemetry.collect import chrome_trace, load_events
+from coinstac_dinunet_tpu.telemetry.doctor import (
+    build_report,
+    render_github,
+    render_markdown,
+)
+
+
+# ------------------------------------------------------------- cost helper
+def test_step_flops_prices_a_live_computation():
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.sum(x @ x)
+
+    flops, reason = perf.step_flops(f, jnp.ones((8, 8), jnp.float32))
+    assert reason is None
+    assert flops and flops > 0
+
+
+def test_step_flops_typed_reason_on_failure():
+    def broken(x):
+        raise RuntimeError("untraceable")
+
+    flops, reason = perf.step_flops(broken, np.ones(2))
+    assert flops is None
+    assert reason.startswith("lower_failed:")
+
+
+def test_step_cost_unavailable_is_typed(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    staged = jax.jit(lambda x: x + 1)
+    lowered = staged.lower(jnp.ones(2))
+    monkeypatch.setattr(
+        type(lowered), "cost_analysis", lambda self: None, raising=False
+    )
+    monkeypatch.setattr(type(staged), "lower",
+                        lambda self, *a, **k: lowered, raising=False)
+    cost, reason = perf.step_cost(staged, jnp.ones(2))
+    assert cost is None and reason == perf.COST_UNAVAILABLE
+
+
+def test_record_jit_cost_event_and_registry(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    cache = {"profile": True}
+    rec = Recorder("t", cache=cache, out_dir=str(tmp_path))
+    fn = jax.jit(lambda x: jnp.sum(x * x))
+    flops = perf.record_jit_cost(cache, "grads", fn, (jnp.ones(16),),
+                                 recorder=rec)
+    rec.flush()
+    assert flops and cache[perf.FLOPS_CACHE_KEY]["grads"] == flops
+    events = load_events(str(tmp_path))
+    jc = [e for e in events if e["name"] == "jit_cost"]
+    assert len(jc) == 1 and jc[0]["flops"] == flops
+    assert jc[0]["bytes_accessed"] > 0
+    # the one-time backend event rides along for the doctor's roofline
+    assert any(e["name"] == "perf:backend" for e in events)
+
+
+# ---------------------------------------------------------- per-round series
+def test_record_step_perf_series_and_health_rollup(tmp_path):
+    cache = {"profile": True, perf.FLOPS_CACHE_KEY: {"train": 2e9},
+             "peak_tflops": 100.0}
+    rec = Recorder("t", cache=cache, out_dir=str(tmp_path))
+    perf.record_step_perf(cache, "train", 0.01, 128, recorder=rec)
+    rec.flush()
+    by_name = {e["name"]: e for e in load_events(str(tmp_path))
+               if e.get("kind") == "metric"}
+    assert by_name["samples_per_sec"]["value"] == pytest.approx(12800.0)
+    assert by_name["achieved_tflops"]["value"] == pytest.approx(0.2)
+    assert by_name["mfu"]["value"] == pytest.approx(0.002)
+    roll = cache["health"]["perf"]
+    assert roll["mfu"] == pytest.approx(0.002)
+    assert roll["samples_per_sec"] == pytest.approx(12800.0)
+    # the rollup rides the HEALTH wire via the watchdog summary
+    assert Watchdog(cache, NULL_RECORDER).summary()["perf"]["mfu"] == roll["mfu"]
+
+
+def test_sample_device_memory_census_and_pressure(tmp_path):
+    import jax.numpy as jnp
+
+    keep = jnp.ones((256, 256), jnp.float32)  # keeps the census non-zero
+    cache = {"profile": True,
+             "memory_limit_bytes": float(keep.nbytes)}  # tiny budget
+    rec = Recorder("t", cache=cache, out_dir=str(tmp_path))
+    in_use = perf.sample_device_memory(cache, recorder=rec)
+    rec.flush()
+    assert in_use and in_use >= keep.nbytes
+    by_name = {e["name"]: e for e in load_events(str(tmp_path))
+               if e.get("kind") == "metric"}
+    assert by_name["hbm_in_use_bytes"]["value"] == in_use
+    assert by_name["hbm_utilization"]["value"] >= 1.0
+    # utilization over the 0.92 default threshold → pressure anomaly
+    assert cache["health"]["anomalies"][-1]["anomaly"] == Anomaly.MEMORY_PRESSURE
+    assert cache["health"]["perf"]["memory_source"] == "live_buffer_census"
+
+
+# ------------------------------------------------------------ detector units
+def _drive(values, metric, cache=None):
+    cache = cache if cache is not None else {}
+    fired = []
+    for i, v in enumerate(values):
+        cache["telemetry_round"] = i + 1
+        for a in Watchdog(cache, NULL_RECORDER).observe(metric, v):
+            fired.append((i, a))
+    return fired, cache
+
+
+def test_memory_leak_detector_fires_once_at_seeded_round():
+    cache = {"watchdog_leak_warmup": 0, "watchdog_leak_rounds": 3}
+    # growth >1% per round from index 3 on: streak hits 3 at index 5
+    series = [100.0, 100.0, 100.0, 110.0, 121.0, 133.0, 146.0, 161.0]
+    fired, _ = _drive(series, Metric.HBM_IN_USE, cache)
+    assert fired == [(5, Anomaly.MEMORY_LEAK)]
+
+
+def test_memory_leak_detector_rearms_after_plateau():
+    cache = {"watchdog_leak_warmup": 0, "watchdog_leak_rounds": 2}
+    series = [100.0, 110.0, 121.0,   # leak #1 fires at index 2
+              121.0,                 # plateau: streak resets, re-arms
+              133.0, 146.0]          # leak #2 fires at index 5
+    fired, _ = _drive(series, Metric.HBM_IN_USE, cache)
+    assert fired == [(2, Anomaly.MEMORY_LEAK), (5, Anomaly.MEMORY_LEAK)]
+
+
+def test_memory_leak_detector_warmup_suppresses_startup_growth():
+    cache = {"watchdog_leak_warmup": 8, "watchdog_leak_rounds": 3}
+    series = [100.0 * 1.1 ** i for i in range(8)]  # all inside warm-up
+    fired, _ = _drive(series, Metric.HBM_IN_USE, cache)
+    assert fired == []
+
+
+def test_memory_pressure_detector_fires_once_and_rearms():
+    series = [0.5, 0.7, 0.95, 0.97, 0.5, 0.93]
+    fired, _ = _drive(series, Metric.HBM_UTILIZATION)
+    assert fired == [(2, Anomaly.MEMORY_PRESSURE),
+                     (5, Anomaly.MEMORY_PRESSURE)]
+
+
+# ------------------------------------------------------------------- capture
+class _StubTrace:
+    """device_trace stand-in: records enter/exit, creates the dir + one
+    file (the retention contract) without touching the real profiler."""
+
+    calls = []
+
+    def __init__(self, path):
+        self.path = str(path)
+
+    def __enter__(self):
+        os.makedirs(self.path, exist_ok=True)
+        with open(os.path.join(self.path, "trace.stub"), "w") as f:
+            f.write("x")
+        type(self).calls.append(self.path)
+        return self.path
+
+    def __exit__(self, *exc):
+        return False
+
+
+def test_anomaly_arms_and_next_round_captures(tmp_path, monkeypatch):
+    from coinstac_dinunet_tpu.utils import profiling
+
+    monkeypatch.setattr(profiling, "device_trace", _StubTrace)
+    _StubTrace.calls = []
+    cache = {"profile": True, "capture_on_anomaly": True,
+             "telemetry_round": 4}
+    rec = Recorder("site_0", cache=cache, out_dir=str(tmp_path))
+    # the anomaly (via the watchdog) arms the capture...
+    Watchdog(cache, rec).observe(Metric.GRAD_NORM, float("nan"))
+    assert cache["health"]["capture_pending"]["anomaly"] == Anomaly.NONFINITE
+    # ...and the next round's choke point takes it
+    with capture.captured_round(cache, str(tmp_path), rec) as path:
+        assert path and _StubTrace.calls == [path]
+    rec.flush()
+    assert "capture_pending" not in cache["health"]
+    assert cache["health"]["captures_taken"] == 1
+    events = load_events(str(tmp_path))
+    cap = next(e for e in events if e["name"] == "capture:profile")
+    assert cap["anomaly"] == Anomaly.NONFINITE and os.path.isdir(cap["path"])
+    assert any(e["name"] == "capture:armed" for e in events)
+    # no pending capture → the shared no-op context, no profiler touch
+    _StubTrace.calls = []
+    with capture.captured_round(cache, str(tmp_path), rec):
+        pass
+    assert _StubTrace.calls == []
+
+
+def test_capture_budget_and_name_filter():
+    cache = {"capture_on_anomaly": "nonfinite", "capture_max_profiles": 1}
+    assert capture.maybe_arm(cache, "nonfinite", NULL_RECORDER)
+    cache["health"].pop("capture_pending")
+    cache["health"]["captures_taken"] = 1
+    # budget exhausted: no more arming
+    assert not capture.maybe_arm(cache, "nonfinite", NULL_RECORDER)
+    # un-named anomaly kinds never arm
+    cache2 = {"capture_on_anomaly": ["memory_leak"]}
+    assert not capture.maybe_arm(cache2, "nonfinite", NULL_RECORDER)
+    assert capture.maybe_arm(cache2, "memory_leak", NULL_RECORDER)
+    # off by default
+    assert not capture.maybe_arm({}, "nonfinite", NULL_RECORDER)
+
+
+def test_capture_without_out_dir_consumes_marker(tmp_path):
+    """A node with no outputDirectory must consume the pending marker (a
+    capture:failed event, not a silent wedge that blocks all future
+    arming)."""
+    cache = {"capture_on_anomaly": True,
+             "health": {"capture_pending": {"anomaly": "nonfinite"}}}
+    rec = Recorder("t", cache=cache, out_dir=str(tmp_path))
+    with capture.captured_round(cache, None, rec):
+        pass
+    rec.flush()
+    assert "capture_pending" not in cache["health"]
+    events = load_events(str(tmp_path))
+    fail = next(e for e in events if e["name"] == "capture:failed")
+    assert "no outputDirectory" in fail["error"]
+    # the wedge is gone: the next anomaly can arm again
+    assert capture.maybe_arm(cache, "nonfinite", NULL_RECORDER)
+
+
+def test_leak_watch_false_skips_leak_detector(tmp_path):
+    """Validation-phase samples (leak_watch=False) record the in-use
+    series but must not advance the leak detector's state — an eval
+    allocation spike would reset the growth streak and mask a real
+    training-loop leak."""
+    import jax.numpy as jnp
+
+    keep = jnp.ones((64, 64), jnp.float32)  # noqa: F841 — non-zero census
+    cache = {"profile": True}
+    rec = Recorder("t", cache=cache, out_dir=str(tmp_path))
+    perf.sample_device_memory(cache, recorder=rec, leak_watch=False)
+    rec.flush()
+    events = load_events(str(tmp_path))
+    assert any(e.get("kind") == "metric" and e["name"] == "hbm_in_use_bytes"
+               for e in events)
+    detectors = cache.get("health", {}).get("detectors", {})
+    assert "memory_leak" not in detectors  # detector state untouched
+    # the default (train-round) path does feed it
+    perf.sample_device_memory(cache, recorder=rec)
+    assert "memory_leak" in cache["health"]["detectors"]
+
+
+def test_capture_failure_is_an_event_not_a_crash(tmp_path, monkeypatch):
+    from coinstac_dinunet_tpu.utils import profiling
+
+    class _Boom:
+        def __init__(self, path):
+            pass
+
+        def __enter__(self):
+            raise RuntimeError("profiler already active")
+
+        def __exit__(self, *exc):
+            return False
+
+    monkeypatch.setattr(profiling, "device_trace", _Boom)
+    cache = {"health": {"capture_pending": {"anomaly": "nonfinite"}}}
+    rec = Recorder("t", cache=cache, out_dir=str(tmp_path))
+    with capture.captured_round(cache, str(tmp_path), rec):
+        pass  # the round itself must run unharmed
+    rec.flush()
+    events = load_events(str(tmp_path))
+    fail = next(e for e in events if e["name"] == "capture:failed")
+    assert "profiler already active" in fail["error"]
+    assert not any(e["name"] == "capture:profile" for e in events)
+
+
+# ----------------------------------------------------------- doctor roofline
+def _canned_perf_events():
+    ev = [{"kind": "event", "name": "perf:backend", "cat": "perf",
+           "node": "site_0", "t0": 100.0, "device_kind": "TPU v5e",
+           "devices": 1, "peak_tflops": 197.0, "peak_source": "table",
+           "ceiling_mfu": 0.25}]
+    for rnd in range(1, 5):
+        t = 100.0 + rnd
+        ev.extend([
+            {"kind": "metric", "name": "achieved_tflops", "node": "site_0",
+             "t0": t, "value": 40.0 + rnd, "round": rnd},
+            {"kind": "metric", "name": "mfu", "node": "site_0", "t0": t,
+             "value": (40.0 + rnd) / 197.0, "round": rnd},
+            {"kind": "metric", "name": "samples_per_sec", "node": "site_0",
+             "t0": t, "value": 14000.0 + 10 * rnd, "round": rnd},
+            {"kind": "metric", "name": "hbm_in_use_bytes", "node": "site_0",
+             "t0": t, "value": 9.0e9, "round": rnd},
+            {"kind": "metric", "name": "hbm_limit_bytes", "node": "site_0",
+             "t0": t, "value": 16.0e9, "round": rnd},
+            {"kind": "metric", "name": "hbm_utilization", "node": "site_0",
+             "t0": t, "value": 9.0 / 16.0, "round": rnd},
+        ])
+    return ev
+
+
+def test_doctor_golden_roofline_section():
+    report = build_report(_canned_perf_events())
+    roof = report["roofline"]
+    assert roof["backend"]["device_kind"] == "TPU v5e"
+    assert roof["backend"]["ceiling_mfu"] == 0.25
+    assert roof["achieved_tflops"]["max"] == 44.0
+    assert roof["mfu"]["last"] == pytest.approx(44.0 / 197.0)
+    assert roof["memory"]["utilization"]["max"] == pytest.approx(9 / 16)
+    md = render_markdown(report)
+    assert "## Roofline (perf flight recorder)" in md
+    assert "TPU v5e" in md and "structural ceiling 25% MFU" in md
+    assert "### Device memory" in md
+    # healthy utilization: no memory-headroom verdict
+    assert not any("memory headroom" in v["cause"]
+                   for v in report["verdicts"])
+
+
+def test_doctor_mfu_floor_verdict_against_ledger():
+    ledger = [{"value": 14200.0, "unit": "samples/sec/chip", "mfu": 0.30}]
+    report = build_report(_canned_perf_events(), bench_history=ledger)
+    floor = report["mfu_floor"]
+    assert floor["below_floor"] and floor["ledger_mfu"] == 0.30
+    v = next(v for v in report["verdicts"]
+             if "MFU below the benchmark ledger floor" in v["cause"])
+    assert v["severity"] == "warning"
+    assert "::warning" in render_github(report)
+    assert "BELOW FLOOR" in render_markdown(report)
+    # a ledger at/below the measured run stays verdict-free
+    report = build_report(
+        _canned_perf_events(),
+        bench_history=[{"value": 1.0, "mfu": 0.20}],
+    )
+    assert not report["mfu_floor"]["below_floor"]
+    assert not any("ledger floor" in v["cause"] for v in report["verdicts"])
+
+
+def test_doctor_memory_headroom_verdict():
+    events = _canned_perf_events()
+    events.append({"kind": "metric", "name": "hbm_utilization",
+                   "node": "site_0", "t0": 200.0, "value": 0.97})
+    report = build_report(events)
+    v = next(v for v in report["verdicts"]
+             if "memory headroom" in v["cause"])
+    assert v["severity"] == "warning" and "97.0%" in v["evidence"]
+
+
+def test_doctor_capture_links_in_report():
+    events = _canned_perf_events()
+    events.append({"kind": "event", "name": "capture:profile",
+                   "cat": "capture", "node": "site_1", "t0": 103.0,
+                   "round": 3, "anomaly": "nonfinite",
+                   "path": "/out/profile_capture/round3_nonfinite"})
+    report = build_report(events)
+    assert report["captures"] == [{
+        "anomaly": "nonfinite", "round": 3, "node": "site_1",
+        "path": "/out/profile_capture/round3_nonfinite",
+    }]
+    md = render_markdown(report)
+    assert "## Profiler captures" in md and "round3_nonfinite" in md
+    assert any("profiler capture(s) retained" in v["cause"]
+               for v in report["verdicts"])
+
+
+def test_doctor_well_formed_without_perf_series():
+    # no records at all
+    report = build_report([])
+    assert report["roofline"] is None and report["mfu_floor"] is None
+    md = render_markdown(report)
+    assert "## Roofline" not in md and "# Federation health postmortem" in md
+    # spans only — still no roofline, still renders
+    report = build_report([{"kind": "span", "name": "engine:round",
+                            "node": "engine", "t0": 1.0, "dur": 0.5}])
+    assert report["roofline"] is None
+    assert "## Round throughput" in render_markdown(report)
+    # backend event but zero metric samples: roofline renders with dashes
+    report = build_report([{"kind": "event", "name": "perf:backend",
+                            "node": "n", "t0": 1.0, "device_kind": "cpu"}])
+    md = render_markdown(report)
+    assert "## Roofline" in md and "peak unknown" in md
+    # an mfu ledger without a measured series produces no floor verdict
+    report = build_report([], bench_history=[{"value": 1.0, "mfu": 0.3}])
+    assert report["mfu_floor"] is None
+
+
+def test_chrome_trace_utilization_counter_tracks():
+    trace = chrome_trace(_canned_perf_events())
+    util = [e for e in trace["traceEvents"]
+            if e.get("ph") == "C" and e.get("cat") == "utilization"]
+    names = {e["name"] for e in util}
+    assert {"metric:mfu", "metric:achieved_tflops",
+            "metric:hbm_in_use_bytes"} <= names
+    # non-perf metrics keep the plain metric category
+    other = chrome_trace([{"kind": "metric", "name": "grad_norm",
+                           "node": "s", "t0": 1.0, "value": 1.0}])
+    gn = next(e for e in other["traceEvents"] if e.get("ph") == "C")
+    assert gn["cat"] == "metric"
+
+
+# --------------------------------------------------- degraded-bridge event
+def test_jax_listener_failure_emits_degraded_event(tmp_path, monkeypatch):
+    from coinstac_dinunet_tpu.telemetry import recorder as rec_mod
+
+    monkeypatch.setattr(rec_mod, "_JAX_LISTENER_ERROR",
+                        "AttributeError: no jax.monitoring")
+    monkeypatch.setattr(rec_mod, "_DEGRADED_EMITTED", False)
+    rec = Recorder("t", out_dir=str(tmp_path))
+    rec.flush()
+    events = load_events(str(tmp_path))
+    deg = [e for e in events if e["name"] == "telemetry:degraded"]
+    assert len(deg) == 1 and "no jax.monitoring" in deg[0]["error"]
+    # one-time per process: a second recorder stays quiet
+    Recorder("t2", out_dir=str(tmp_path)).flush()
+    events = load_events(str(tmp_path))
+    assert len([e for e in events if e["name"] == "telemetry:degraded"]) == 1
+
+
+# ------------------------------------------------- vectorized engine rounds
+def test_site_vectorized_engine_records_round_throughput(tmp_path):
+    from coinstac_dinunet_tpu.federation import SiteVectorizedEngine
+
+    eng = SiteVectorizedEngine(str(tmp_path), n_sites=3, profile=True)
+    for _ in range(3):
+        eng._round_hook([None, None, None])
+        time.sleep(0.01)
+    eng._recorder().flush()
+    events = load_events(str(tmp_path))
+    spans = [e for e in events if e.get("kind") == "span"
+             and e["name"] == "engine:round"]
+    rps = [e for e in events if e.get("kind") == "metric"
+           and e["name"] == "rounds_per_sec"]
+    sps = [e for e in events if e.get("kind") == "metric"
+           and e["name"] == "sites_per_sec"]
+    # hook N closes round N-1: 3 hooks → 2 completed rounds
+    assert len(spans) == 2 and len(rps) == 2 and len(sps) == 2
+    # sites/sec = alive sites × rounds/sec (same denominator)
+    for r, s in zip(rps, sps):
+        assert s["value"] == pytest.approx(3 * r["value"])
+        assert r["value"] > 0
+    # the doctor's throughput trend covers the mega-federation path
+    report = build_report(events)
+    assert report["rounds"]["count"] == 2
+
+
+# -------------------------------------------------------- disabled overhead
+def test_disabled_perf_path_overhead_is_bounded():
+    """The perf-metric choke points must stay on the null-recorder fast
+    path when telemetry is off: 200k disabled record_step_perf +
+    sample-memory guard evaluations well under a second."""
+    cache = {}
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        perf.record_step_perf(cache, "train", 0.01, 128,
+                              recorder=NULL_RECORDER)
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"disabled perf-metric path cost {dt:.3f}s for 200k"
+    assert cache == {}  # no state materialized
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        perf.sample_device_memory(cache, recorder=NULL_RECORDER)
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"disabled memory-sample path cost {dt:.3f}s for 200k"
+    assert cache == {}
+
+
+# ----------------------------------------------------------- trainer rounds
+def test_trainer_round_emits_perf_series(tmp_path):
+    """Enabled compute_grads rounds: jit_cost at the build, then the
+    samples/s + achieved-TFLOPS/MFU series from the WARM rounds only (the
+    build round's wall time is compile, not a step — recording it would
+    seed every series with a ~1000x-low sample), plus a device-memory
+    sample every round including the cold one."""
+    from test_trainer import XorTrainer
+
+    cache = {"profile": True, "input_shape": (2,), "num_classes": 2,
+             "seed": 0, "learning_rate": 1e-2, "peak_tflops": 1.0,
+             "local_data_parallel": False, "share_compiled": False}
+    trainer = XorTrainer(cache=cache, state={"outputDirectory": str(tmp_path)},
+                         data_handle=None)
+    trainer.init_nn()
+    batch = {"inputs": np.ones((4, 2), np.float32),
+             "labels": np.zeros(4, np.int32),
+             "_mask": np.ones(4, np.float32)}
+    rec = Recorder("site_0", cache=cache, out_dir=str(tmp_path))
+    with activate(rec):
+        stacked = trainer._stack_batches([batch])
+        trainer.compute_grads(trainer.train_state, stacked)  # cold: builds
+        trainer.compute_grads(trainer.train_state, stacked)  # warm
+        trainer.compute_grads(trainer.train_state, stacked)  # warm
+    rec.flush()
+    events = load_events(str(tmp_path))
+    enames = {e["name"] for e in events if e.get("kind") == "event"}
+    assert "jit_cost" in enames or "perf:cost_unavailable" in enames
+    by_metric = {}
+    for e in events:
+        if e.get("kind") == "metric":
+            by_metric.setdefault(e["name"], []).append(e)
+    assert {"samples_per_sec", "grad_norm", "hbm_in_use_bytes"} <= set(by_metric)
+    assert "achieved_tflops" in by_metric and "mfu" in by_metric
+    # the compile round is excluded from the throughput series...
+    assert len(by_metric["samples_per_sec"]) == 2
+    # ...but memory is sampled on every round, cold included
+    assert len(by_metric["hbm_in_use_bytes"]) == 3
+    roll = cache["health"]["perf"]
+    assert roll["samples_per_sec"] > 0 and "hbm_in_use_bytes" in roll
+
+
+def test_mfu_floor_demo_ledger_round_trips(tmp_path):
+    """The smoke's MFU-floor demo: a ledger entry 25% above the measured
+    series makes the doctor's floor verdict fire through the same
+    load_bench_history path CI uses."""
+    from coinstac_dinunet_tpu.telemetry.doctor import load_bench_history
+
+    ledger = tmp_path / "BENCH_HISTORY.jsonl"
+    ledger.write_text(json.dumps({"value": None, "mfu": 0.28}) + "\n")
+    report = build_report(
+        _canned_perf_events(), bench_history=load_bench_history(str(ledger))
+    )
+    assert report["mfu_floor"]["below_floor"]
+    assert math.isclose(report["mfu_floor"]["ledger_mfu"], 0.28)
