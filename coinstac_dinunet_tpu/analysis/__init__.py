@@ -18,15 +18,24 @@ Rule families (see ``docs/ANALYSIS.md``):
   ``jax.eval_shape`` traces of registered entry points on the 8-device
   virtual CPU platform (``analysis/deepcheck.py``; the only part of the
   analyzer that imports JAX, and only when asked).
+- ``perf-*`` / ``proto-flow-*`` / ``proto-cache-*`` (opt-in ``--tier3``)
+  — the jaxpr dataflow tier: registered entry points lowered via
+  ``jax.make_jaxpr``/``.lower()`` and audited for missing buffer
+  donation, dtype promotion, traced host syncs and captured constants
+  (``analysis/{dataflow,perf_rules}.py``), plus the AST phase-machine /
+  cache-lifecycle model of the round protocol
+  (``analysis/protocol_flow.py``).
 
-CLI::
+CLI (installed as the ``dinulint`` console script)::
 
-    python -m coinstac_dinunet_tpu.analysis [paths...] \
-        [--format text|json] [--baseline FILE] [--write-baseline] \
-        [--rules id,id] [--jax-version X.Y.Z] [--list-rules]
+    dinulint [paths...] \
+        [--format text|json|github] [--baseline FILE] [--write-baseline] \
+        [--rules id,id] [--jax-version X.Y.Z] [--list-rules] \
+        [--deep] [--tier3]
 
 Exit status: 0 when no *new* (non-baselined, non-suppressed) findings, 1
-otherwise, 2 on usage errors.  Pure stdlib ``ast`` — never imports JAX.
+otherwise, 2 on usage errors.  The default tiers are pure stdlib ``ast``
+— JAX is imported only under ``--deep``/``--tier3``.
 """
 from .core import (  # noqa: F401
     Finding,
@@ -40,8 +49,10 @@ from .core import (  # noqa: F401
     run_lint,
     write_baseline,
 )
+from .dataflow import TIER3_RULE_IDS  # noqa: F401  (JAX-free to import)
 from .jax_api import JaxApiDriftRule, SYMBOL_TABLE, symbol_status  # noqa: F401
 from .protocol import ProtocolConformanceRule, load_vocabulary  # noqa: F401
+from .protocol_flow import ProtocolFlowAnalyzer, run_protocol_flow  # noqa: F401
 from .sharding import (  # noqa: F401
     AxisLiteralRule,
     CollectiveScopeRule,
@@ -65,4 +76,5 @@ __all__ = [
     "ImpureCallRule", "PyControlFlowRule", "SetIterationRule",
     "UnknownAxisRule", "MeshArityRule", "SpecArityRule",
     "CollectiveScopeRule", "AxisLiteralRule", "load_mesh_axes",
+    "TIER3_RULE_IDS", "ProtocolFlowAnalyzer", "run_protocol_flow",
 ]

@@ -1,4 +1,6 @@
-"""``python -m coinstac_dinunet_tpu.analysis`` — the dinulint CLI."""
+"""The dinulint CLI — installed as the ``dinulint`` console script
+(pyproject ``[project.scripts]``); ``python -m
+coinstac_dinunet_tpu.analysis`` is the equivalent fallback spelling."""
 import argparse
 import json
 import os
@@ -18,7 +20,7 @@ DEFAULT_BASELINE = "dinulint_baseline.json"
 
 def build_parser():
     p = argparse.ArgumentParser(
-        prog="python -m coinstac_dinunet_tpu.analysis",
+        prog="dinulint",
         description="dinulint: JAX-hazard + federated-protocol static analysis",
     )
     p.add_argument("paths", nargs="*", default=["coinstac_dinunet_tpu"],
@@ -48,7 +50,23 @@ def build_parser():
                         "(default: all registered)")
     p.add_argument("--list-deep", action="store_true",
                    help="list the registered deep-check entry points")
+    p.add_argument("--tier3", action="store_true",
+                   help="also run the tier-3 jaxpr dataflow pass: lower "
+                        "every deep-check entry point and run the perf-* "
+                        "rules (donation, dtype promotion, host sync, "
+                        "constant capture) plus the proto-flow-*/"
+                        "proto-cache-* phase-machine model (imports JAX "
+                        "for the perf rules; composes with --deep, sharing "
+                        "entry builds; see docs/ANALYSIS.md)")
     return p
+
+
+#: rule-id prefixes owned by each opt-in tier — a --write-baseline refresh
+#: that did not run a tier carries its accepted entries over verbatim
+TIER_PREFIXES = {
+    "deep": ("deep-",),
+    "tier3": ("tier3-", "perf-", "proto-flow-", "proto-cache-"),
+}
 
 
 def _github_escape(text):
@@ -71,6 +89,10 @@ def main(argv=None):
     if args.list_rules:
         for r in sorted(rules, key=lambda r: r.id):
             print(f"{r.id}: {r.doc}")
+        from .dataflow import TIER3_RULE_IDS
+
+        for rid in TIER3_RULE_IDS:
+            print(f"{rid}: (tier-3, --tier3; see docs/ANALYSIS.md)")
         return 0
     if args.list_deep:
         from .deepcheck import list_entry_points
@@ -112,11 +134,22 @@ def main(argv=None):
 
     rule_ids = args.rules.split(",") if args.rules else None
     if rule_ids:
-        known = {r.id for r in rules}
+        from .dataflow import TIER3_RULE_IDS
+
+        # tier-3 ids are selectable too (their findings are filtered after
+        # the tier runs below)
+        known = {r.id for r in rules} | set(TIER3_RULE_IDS)
         unknown = sorted(set(rule_ids) - known)
         if unknown:
             print(f"unknown rule id(s): {', '.join(unknown)} "
                   f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+        tier3_selected = sorted(set(rule_ids) & set(TIER3_RULE_IDS))
+        if tier3_selected and not args.tier3:
+            # without the tier the selected rule would silently report
+            # nothing — a false clean for whoever is reproducing a finding
+            print(f"--rules {','.join(tier3_selected)} requires --tier3 "
+                  "(tier-3 rules only run under --tier3)", file=sys.stderr)
             return 2
     if args.write_baseline and rule_ids:
         print("--write-baseline with --rules would drop every other rule's "
@@ -130,12 +163,47 @@ def main(argv=None):
 
     findings, errors = run_lint(args.paths, rules=rules, rule_ids=rule_ids)
 
+    if args.tier3:
+        from .dataflow import TIER3_RULE_IDS
+
+        # tier-3 FIRST: its entry builds are cached and handed to --deep
+        # below, so a combined run constructs each entry once
+        wanted = set(rule_ids) if rule_ids else None
+        if wanted is not None and wanted.isdisjoint(TIER3_RULE_IDS):
+            # --rules selected no tier-3 rule at all: nothing this tier
+            # could produce would survive the filter — skip it entirely
+            tier3_findings = []
+        elif wanted is not None and not any(
+            r.startswith(("perf-", "tier3-")) for r in wanted
+        ):
+            # only the pure-AST proto-* family selected: skip the JAX
+            # import and the per-entry lowering entirely
+            from .protocol_flow import run_protocol_flow
+
+            tier3_findings = list(run_protocol_flow(paths=args.paths))
+        else:
+            from .dataflow import run_tier3
+
+            tier3_findings = run_tier3(paths=args.paths)
+        if wanted is not None:
+            # the tier's own error channel must survive any filter:
+            # dropping tier3-config/tier3-lower would turn "the tier never
+            # actually ran" into a false-clean exit 0
+            keep = wanted | {"tier3-config", "tier3-lower"}
+            tier3_findings = [f for f in tier3_findings if f.rule in keep]
+        findings = findings + tier3_findings
     if args.deep:
         # lazy import: only --deep pays the JAX import (and it sets up the
         # 8-device virtual CPU platform itself when the backend is fresh)
         from .deepcheck import run_deepcheck
 
-        findings = findings + run_deepcheck(deep_names)
+        builds = None
+        if args.tier3:
+            from .dataflow import tier3_builds
+
+            builds = tier3_builds()
+        findings = findings + run_deepcheck(deep_names, builds=builds)
+    if args.deep or args.tier3:
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     baseline_path = args.baseline
@@ -144,24 +212,32 @@ def main(argv=None):
 
     if args.write_baseline:
         out = baseline_path or DEFAULT_BASELINE
-        if args.deep and any(f.rule == "deep-config" for f in findings):
-            # the deep tier never actually ran — writing now would drop its
-            # accepted entries AND baseline the platform misconfiguration
-            print("--write-baseline refused: the deep tier could not run "
-                  "(deep-config: virtual device platform unavailable) — fix "
-                  "XLA_FLAGS or refresh without --deep", file=sys.stderr)
+        broken = [f.rule for f in findings
+                  if f.rule in ("deep-config", "tier3-config")]
+        if broken:
+            # an opt-in tier never actually ran — writing now would drop
+            # its accepted entries AND baseline the platform misconfig
+            print(f"--write-baseline refused: {broken[0]}: the virtual "
+                  "device platform is unavailable so the tier could not "
+                  "run — fix XLA_FLAGS or refresh without that tier",
+                  file=sys.stderr)
             return 2
-        extra = ()
-        if not args.deep and os.path.exists(out):
-            # the deep tier didn't run, so this refresh knows nothing about
-            # its findings — carry the accepted deep-* entries over instead
-            # of silently dropping them from the rewritten file
+        extra = []
+        missing = [t for t, ran in (("deep", args.deep),
+                                    ("tier3", args.tier3)) if not ran]
+        if missing and os.path.exists(out):
+            # a tier that didn't run contributes nothing to this refresh —
+            # carry its accepted entries over instead of silently dropping
+            # them from the rewritten file
+            prefixes = tuple(p for t in missing for p in TIER_PREFIXES[t])
             with open(out, "r", encoding="utf-8") as f:
                 old = json.load(f)
             extra = [e for e in old.get("findings", [])
-                     if e.get("rule", "").startswith("deep-")]
+                     if e.get("rule", "").startswith(prefixes)]
         write_baseline(out, findings, extra_entries=extra)
-        kept = f" (+{len(extra)} deep-* entr{'y' if len(extra) == 1 else 'ies'} kept)" if extra else ""
+        kept = (f" (+{len(extra)} entr{'y' if len(extra) == 1 else 'ies'} "
+                f"kept from tiers not run: {', '.join(missing)})"
+                if extra else "")
         print(f"wrote {len(findings)} finding(s) to {out}{kept}")
         return 0
 

@@ -253,7 +253,7 @@ def write_baseline(path, findings, extra_entries=()):
     payload = {
         "comment": (
             "dinulint baseline: legacy findings that do not fail CI.  "
-            "Refresh with: python -m coinstac_dinunet_tpu.analysis <paths> "
+            "Refresh with: dinulint <paths> --tier3 --deep "
             "--write-baseline --baseline " + os.path.basename(path)
         ),
         "findings": entries,

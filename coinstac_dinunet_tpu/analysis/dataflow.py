@@ -1,0 +1,297 @@
+"""dinulint tier-3: the jaxpr dataflow tier (``--tier3``).
+
+Tier 1 reads source text; tier 2 (``--deep``) proves the compiled surfaces
+*trace*.  Neither can see what the traced program actually **does**: whether
+the train step donates the state buffers it replaces, whether a bf16 step
+quietly computes a matmul in f32, whether a host callback rides inside the
+hot loop, whether a closure baked a half-gigabyte constant into the
+executable.  Those are exactly the levers docs/PERF.md names and the ones
+one-jit designs (Podracer, arXiv:2104.06272) live or die by — so this tier
+lowers every registered entry point of :mod:`.deepcheck` to its jaxpr
+(``jax.make_jaxpr``) and staged lowering (``fn.lower(*args)``) and runs the
+dataflow rule families over the result:
+
+- :mod:`.perf_rules` — ``perf-donation`` / ``perf-dtype-promotion`` /
+  ``perf-host-sync`` / ``perf-constant-capture`` over the lowered entries;
+- :mod:`.protocol_flow` — the static phase-machine + cache-lifecycle model
+  of the invocation-per-round protocol (pure AST; runs even when the JAX
+  platform is unavailable).
+
+Findings are ordinary :class:`~.core.Finding` objects and flow through the
+same baseline/suppression machinery as every other tier.
+
+Build/lowering results are cached process-wide per entry name, and
+``run_deepcheck`` accepts the same cache — so a CLI invocation combining
+``--tier3 --deep`` builds each entry's trainer/mesh/federation exactly once
+(the builders, not the traces, dominate the wall time).  Builders run under
+:func:`~..utils.jax_compat.force_donation` so donation intent is resolved
+as production (accelerator) builds would resolve it, not as the CPU
+analysis platform would.
+"""
+import ast
+import dataclasses
+import functools
+import os
+
+from .core import Finding
+
+#: every rule id this tier can emit (docs + ``--list-rules``)
+TIER3_RULE_IDS = (
+    "tier3-config",
+    "tier3-lower",
+    "perf-donation",
+    "perf-dtype-promotion",
+    "perf-host-sync",
+    "perf-constant-capture",
+    "proto-flow-phase",
+    "proto-flow-unmatched",
+    "proto-cache-read-before-write",
+    "proto-cache-never-read",
+    "proto-cache-volatile",
+)
+
+
+@dataclasses.dataclass
+class LoweredEntry:
+    """One deep-registry entry, lowered for dataflow analysis.
+
+    ``closed_jaxpr`` is the ``jax.make_jaxpr`` result (None when lowering
+    failed — ``error`` carries the reason).  ``args_info``/``out_info`` are
+    the staged ``Lowered.args_info`` / ``Lowered.out_info`` pytrees for
+    jit-wrapped entries (None for plain callables: only actual jit
+    surfaces carry donation metadata)."""
+
+    name: str
+    path: str
+    fn: object = None
+    args: tuple = ()
+    arg_names: tuple = ()
+    closed_jaxpr: object = None
+    args_info: object = None
+    out_info: object = None
+    error: str = None
+
+
+# ------------------------------------------------------- shared build cache
+# entry name -> ("ok", fn, args) | ("error", first-line message).  Shared
+# with run_deepcheck (the ``builds=`` parameter) so --tier3 --deep builds
+# each entry once per process.
+_BUILD_CACHE = {}
+
+
+def clear_build_cache():
+    """Test hook: entries built under monkeypatched registries must not
+    leak into later runs."""
+    _BUILD_CACHE.clear()
+
+
+def build_entry(name):
+    """Build (or fetch the cached build of) one registry entry, under the
+    production donation resolution.  Never raises."""
+    from ..utils.jax_compat import force_donation
+    from .deepcheck import DEEP_REGISTRY, _first_line
+
+    if name not in _BUILD_CACHE:
+        try:
+            with force_donation():
+                fn, args = DEEP_REGISTRY[name].build()
+            _BUILD_CACHE[name] = ("ok", fn, args)
+        except Exception as exc:  # noqa: BLE001 — failures become findings
+            _BUILD_CACHE[name] = ("error", _first_line(exc))
+    return _BUILD_CACHE[name]
+
+
+@functools.lru_cache(maxsize=256)
+def _jit_call_lines(entry_path):
+    """Best-effort source anchor: the ``jax.jit(...)`` call lines in the
+    entry's anchored module (so a donation finding points at the build
+    site, e.g. ``federation/vector.py:230``), or [] when unresolvable.
+    Memoized: every perf rule asks per entry, and re-parsing the same
+    module ~50 times per run would dominate the rules' own cost."""
+    root = os.path.normpath(
+        os.path.join(os.path.dirname(__file__), "..", "..")
+    )
+    path = os.path.join(root, entry_path)
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (SyntaxError, OSError, UnicodeDecodeError, ValueError):
+        return []
+    lines = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name == "jit":
+                lines.append(node.lineno)
+    return sorted(lines)
+
+
+def entry_anchor_line(entry_path):
+    """Line findings against a lowered entry anchor to: the module's first
+    jit build site, else line 1."""
+    lines = _jit_call_lines(entry_path)
+    return lines[0] if lines else 1
+
+
+def lower_entry(name):
+    """Build + lower one registry entry.  Returns a :class:`LoweredEntry`
+    whose ``error`` field (if set) is the typed failure; never raises."""
+    import jax
+
+    from .deepcheck import DEEP_REGISTRY, _first_line
+
+    ep = DEEP_REGISTRY[name]
+    arg_names = tuple(getattr(ep, "arg_names", ()) or ())
+    status = build_entry(name)
+    if status[0] == "error":
+        return LoweredEntry(name=name, path=ep.path, arg_names=arg_names,
+                            error=f"builder raised {status[1]}")
+    fn, args = status[1], status[2]
+    out = LoweredEntry(name=name, path=ep.path, fn=fn, args=args,
+                       arg_names=arg_names)
+    try:
+        out.closed_jaxpr = jax.make_jaxpr(lambda *a: fn(*a))(*args)
+    except Exception as exc:  # noqa: BLE001
+        out.error = f"make_jaxpr failed with {_first_line(exc)}"
+        return out
+    if hasattr(fn, "lower"):
+        try:
+            lowered = fn.lower(*args)
+            out.args_info = lowered.args_info
+            out.out_info = lowered.out_info
+        except Exception as exc:  # noqa: BLE001 — jaxpr rules still run
+            out.error = f"lower failed with {_first_line(exc)}"
+    return out
+
+
+# ------------------------------------------------------- jaxpr walk helpers
+def _jaxpr_types():
+    try:
+        from jax.extend import core as jex_core
+
+        return jex_core.Jaxpr, jex_core.ClosedJaxpr
+    except Exception:  # noqa: BLE001 — pre-extend versions
+        from jax import core as jcore
+
+        return jcore.Jaxpr, jcore.ClosedJaxpr
+
+
+def sub_jaxprs(eqn):
+    """Inner (Jaxpr, consts) pairs referenced by one equation's params —
+    pjit bodies, scan/while/cond branches, custom_vjp jaxprs, shard_map
+    bodies all live here."""
+    Jaxpr, ClosedJaxpr = _jaxpr_types()
+    out = []
+    for value in eqn.params.values():
+        items = value if isinstance(value, (list, tuple)) else (value,)
+        for item in items:
+            if isinstance(item, ClosedJaxpr):
+                out.append((item.jaxpr, list(item.consts)))
+            elif isinstance(item, Jaxpr):
+                out.append((item, []))
+    return out
+
+
+#: call-like primitives whose eqn invars map positionally onto the inner
+#: jaxpr's invars — safe to propagate "this var is a top-level argument"
+#: through.  scan/while/cond re-pack their operands and are excluded
+#: (conservative: arguments lose their identity there).
+TRANSPARENT_CALL_PRIMS = frozenset((
+    "pjit", "jit", "xla_call", "closed_call", "core_call", "custom_jvp_call",
+    "custom_vjp_call", "custom_vjp_call_jaxpr", "remat", "remat2",
+    "checkpoint", "shard_map",
+))
+
+
+def is_var(atom):
+    """True for jaxpr Vars (hashable, def-use trackable); False for
+    embedded Literals (which carry ``.val``)."""
+    return not hasattr(atom, "val")
+
+
+def walk_jaxprs(closed_jaxpr):
+    """Yield ``(jaxpr, consts, arg_vars)`` for the top jaxpr and every
+    nested jaxpr, depth-first.  ``arg_vars`` is the set of vars in that
+    jaxpr known to alias a TOP-LEVEL entry argument (propagated through
+    :data:`TRANSPARENT_CALL_PRIMS` only)."""
+    top = closed_jaxpr.jaxpr
+    stack = [(top, list(closed_jaxpr.consts), set(top.invars))]
+    while stack:
+        jaxpr, consts, arg_vars = stack.pop()
+        yield jaxpr, consts, arg_vars
+        for eqn in jaxpr.eqns:
+            subs = sub_jaxprs(eqn)
+            transparent = (
+                eqn.primitive.name in TRANSPARENT_CALL_PRIMS
+                and len(subs) == 1
+            )
+            for inner, inner_consts, in subs:
+                inner_args = set()
+                if transparent and len(inner.invars) == len(eqn.invars):
+                    for outer_v, inner_v in zip(eqn.invars, inner.invars):
+                        if (is_var(outer_v) and outer_v in arg_vars
+                                and is_var(inner_v)):
+                            inner_args.add(inner_v)
+                stack.append((inner, inner_consts, inner_args))
+
+
+# ----------------------------------------------------------------- tier run
+def run_tier3(names=None, paths=None, perf_rules=None):
+    """The ``--tier3`` pass: lower the deep registry and run the dataflow
+    rule families.  ``names`` filters the registry; ``paths`` scopes the
+    protocol-flow never-read scan (default: the installed package).
+    Returns findings; never raises.
+    """
+    from . import deepcheck
+    from .protocol_flow import run_protocol_flow
+
+    findings = list(run_protocol_flow(paths=paths))
+
+    deepcheck._register_builtin_entries()
+    have = deepcheck.ensure_virtual_devices()
+    if have < deepcheck.REQUIRED_DEVICES:
+        findings.append(Finding(
+            rule="tier3-config",
+            path="coinstac_dinunet_tpu/analysis/dataflow.py", line=1, col=0,
+            message=f"tier-3 lowering needs {deepcheck.REQUIRED_DEVICES} "
+                    f"virtual devices but the initialized JAX backend has "
+                    f"{have} — set XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=8 before "
+                    "anything imports jax",
+        ))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return findings
+
+    if perf_rules is None:
+        from .perf_rules import default_perf_rules
+
+        perf_rules = default_perf_rules()
+
+    wanted = set(names) if names else None
+    for name in sorted(deepcheck.DEEP_REGISTRY):
+        if wanted is not None and name not in wanted:
+            continue
+        entry = lower_entry(name)
+        if entry.error is not None:
+            findings.append(Finding(
+                rule="tier3-lower", path=entry.path, line=1, col=0,
+                message=f"entry '{name}': {entry.error}",
+            ))
+        if entry.closed_jaxpr is None:
+            continue
+        for rule in perf_rules:
+            findings.extend(rule.check(entry))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def tier3_builds():
+    """The build cache in run_deepcheck's ``builds=`` shape — pass this to
+    ``run_deepcheck`` after :func:`run_tier3` so ``--deep`` reuses the
+    tier-3 builders instead of reconstructing every trainer/mesh."""
+    return dict(_BUILD_CACHE)

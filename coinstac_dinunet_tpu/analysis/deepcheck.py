@@ -57,17 +57,20 @@ class EntryPoint:
     name: str
     path: str      # repo-relative source path findings anchor to
     build: object  # () -> (fn, args) with args abstract ShapeDtypeStructs
+    #: positional-argument labels for tier-3 findings ("argument 1
+    #: (site_state)") — optional, display only
+    arg_names: tuple = ()
 
 
 DEEP_REGISTRY = {}
 
 
-def register_entry_point(name, path):
+def register_entry_point(name, path, arg_names=()):
     """Decorator registering ``build`` under ``name``; findings anchor to
     ``path`` (the module whose compiled artifact the entry exercises)."""
 
     def deco(build):
-        DEEP_REGISTRY[name] = EntryPoint(name, path, build)
+        DEEP_REGISTRY[name] = EntryPoint(name, path, build, tuple(arg_names))
         return build
 
     return deco
@@ -115,11 +118,15 @@ def _structure_signature(tree):
     )
 
 
-def run_deepcheck(names=None):
+def run_deepcheck(names=None, builds=None):
     """eval_shape-trace the registered entry points; returns findings.
 
     ``names`` filters the registry (None = all).  Every failure mode is a
-    finding — the runner itself never raises.
+    finding — the runner itself never raises.  ``builds`` (optional) is a
+    prebuilt ``{name: ("ok", fn, args) | ("error", msg)}`` cache — the
+    tier-3 pass hands its own over (``dataflow.tier3_builds()``) so a
+    combined ``--tier3 --deep`` run constructs each entry's
+    trainer/mesh/federation exactly once.
     """
     _register_builtin_entries()
     findings = []
@@ -156,14 +163,24 @@ def run_deepcheck(names=None):
         if wanted is not None and name not in wanted:
             continue
         ep = DEEP_REGISTRY[name]
-        try:
-            fn, args = ep.build()
-        except Exception as exc:  # noqa: BLE001 — any build failure is a finding
-            findings.append(Finding(
-                rule="deep-entry-build", path=ep.path, line=1, col=0,
-                message=f"entry '{name}': builder raised {_first_line(exc)}",
-            ))
-            continue
+        prebuilt = builds.get(name) if builds else None
+        if prebuilt is not None:
+            if prebuilt[0] == "error":
+                findings.append(Finding(
+                    rule="deep-entry-build", path=ep.path, line=1, col=0,
+                    message=f"entry '{name}': builder raised {prebuilt[1]}",
+                ))
+                continue
+            fn, args = prebuilt[1], prebuilt[2]
+        else:
+            try:
+                fn, args = ep.build()
+            except Exception as exc:  # noqa: BLE001 — any build failure is a finding
+                findings.append(Finding(
+                    rule="deep-entry-build", path=ep.path, line=1, col=0,
+                    message=f"entry '{name}': builder raised {_first_line(exc)}",
+                ))
+                continue
         fn = unjit(fn)
         # each trace goes through a FRESH wrapper: eval_shape rides the jit
         # trace cache (keyed on function identity), so tracing the same fn
@@ -256,7 +273,11 @@ def _make_deep_trainer():
 
     trainer = _DeepTrainer(cache={
         "input_shape": (4,), "learning_rate": 1e-2, "seed": 0,
-        "donate_buffers": False, "local_data_parallel": False,
+        # donation ON: the registry models the production (accelerator)
+        # configuration — tier-3 lowers these entries under
+        # jax_compat.force_donation, so the perf-donation rule audits the
+        # real donate_argnums intent (eval_shape is unaffected either way)
+        "donate_buffers": True, "local_data_parallel": False,
     })
     trainer.init_nn()
     return trainer
@@ -308,12 +329,32 @@ def _register_builtin_entries():
         return ev, (ts, batch)
 
     @register_entry_point(
-        "trainer-dp-train-step", "coinstac_dinunet_tpu/nn/basetrainer.py"
+        "trainer-train-jit", "coinstac_dinunet_tpu/nn/basetrainer.py",
+        arg_names=("train_state", "stacked"),
+    )
+    def _entry_trainer_train_jit():
+        # the REAL single-device hot-path jit (donation as production
+        # resolves it) — the tier-3 donation/dtype audit target
+        trainer = _make_deep_trainer()
+        step = trainer._build_train_step()
+        ts = _abstract_tree(trainer.train_state)
+        stacked = {
+            "inputs": _sds((2, 4, 4), "float32"),
+            "labels": _sds((2, 4), "int32"),
+        }
+        return step, (ts, stacked)
+
+    @register_entry_point(
+        "trainer-dp-train-step", "coinstac_dinunet_tpu/nn/basetrainer.py",
+        arg_names=("train_state", "stacked"),
     )
     def _entry_trainer_dp():
+        from ..utils.jax_compat import resolve_donate_argnums
+
         trainer = _make_deep_trainer()
         step = trainer._build_dp_step(
-            REQUIRED_DEVICES, apply_updates=True, donate=()
+            REQUIRED_DEVICES, apply_updates=True,
+            donate=resolve_donate_argnums(trainer.cache, (0,)),
         )
         ts = _abstract_tree(trainer.train_state)
         stacked = {  # batch dim shards over the 8-device axis
@@ -323,7 +364,8 @@ def _register_builtin_entries():
         return step, (ts, stacked)
 
     @register_entry_point(
-        "mesh-federation-dsgd-step", "coinstac_dinunet_tpu/parallel/mesh.py"
+        "mesh-federation-dsgd-step", "coinstac_dinunet_tpu/parallel/mesh.py",
+        arg_names=("train_state", "stacked", "comm_state"),
     )
     def _entry_mesh_dsgd():
         import jax
@@ -342,6 +384,43 @@ def _register_builtin_entries():
             "labels": _sds((8, 1, 4), "int32"),
         }
         return step, (ts, stacked, {})
+
+    def _fed_vector_entry(n_sites):
+        import jax
+
+        from ..federation.vector import SiteVectorizedFederation
+
+        trainer = _make_deep_trainer()
+        fed = SiteVectorizedFederation(
+            trainer, n_sites=n_sites,
+            devices=jax.devices()[:REQUIRED_DEVICES],
+        )
+        step = fed._build_step()
+        params = _abstract_tree(trainer.train_state.params)
+        site_state = _abstract_tree(fed._stacked_site_state())
+        site_ix = _sds((n_sites,), "int32")
+        stacked = {  # (site, k, B, F)
+            "inputs": _sds((n_sites, 1, 4, 4), "float32"),
+            "labels": _sds((n_sites, 1, 4), "int32"),
+        }
+        return step, (params, site_state, site_ix, stacked)
+
+    @register_entry_point(
+        "fed-vector-step", "coinstac_dinunet_tpu/federation/vector.py",
+        arg_names=("params", "site_state", "site_ix", "stacked"),
+    )
+    def _entry_fed_vector():
+        # the mega-federation one-jit round, SITE axis sharded over the 8
+        # virtual devices (shard_map path) — the ISSUE-8 donation target
+        return _fed_vector_entry(REQUIRED_DEVICES)
+
+    @register_entry_point(
+        "fed-vector-step-vmap", "coinstac_dinunet_tpu/federation/vector.py",
+        arg_names=("params", "site_state", "site_ix", "stacked"),
+    )
+    def _entry_fed_vector_vmap():
+        # indivisible site count -> shards=1: the pure-vmap jit build
+        return _fed_vector_entry(REQUIRED_DEVICES - 3)
 
     @register_entry_point(
         "powersgd-reducer", "coinstac_dinunet_tpu/parallel/powersgd.py"

@@ -1,0 +1,321 @@
+"""perf-*: jaxpr-level performance-hazard rules (dinulint tier-3).
+
+Each rule consumes one :class:`~.dataflow.LoweredEntry` and reports the
+compiled-surface hazards docs/PERF.md measures by hand:
+
+- ``perf-donation`` — a jit argument that is a multi-leaf state tree
+  (params / opt-state shaped) whose exact successor comes back as an
+  output, but which is not in ``donate_argnums``: every round keeps two
+  generations of the tree live, doubling its HBM footprint (cross-replica
+  sharded updates, arXiv:2004.13336, only pay off when donation actually
+  frees the old buffers).
+- ``perf-dtype-promotion`` — dtype traffic inside a reduced-precision
+  step: a float32 *argument* cast down inside the jaxpr (the cast belongs
+  at batch staging — the measured ~0.9 ms flagship lever), a large
+  reduced-precision tensor upcast to f32 and fed straight into a
+  matmul/conv (accidental f32 compute in a bf16 step), or the same tensor
+  converted to the same dtype twice (pure bandwidth waste).
+- ``perf-host-sync`` — callback primitives (``pure_callback`` /
+  ``io_callback`` / ``debug_callback``) traced into the step: each one is
+  a host round-trip inside the hot loop.  The AST tier flags host-sync
+  *calls* it can see; this proves what actually reached the jaxpr.
+- ``perf-constant-capture`` — a large closure-captured constant baked
+  into the jaxpr: it is re-staged with every executable that closes over
+  it and invisible to donation; pass it as an argument instead.
+
+Thresholds are constructor parameters (fixture tests shrink them); the
+defaults keep the rules quiet on the registry's miniature stand-in models
+except where structure (not size) is the signal — donation is structural,
+so it has no byte floor.
+"""
+import numpy as np
+
+from .core import Finding
+from .dataflow import entry_anchor_line, is_var, walk_jaxprs
+
+#: dtype-promotion findings need the tensor to be worth a memory pass
+DEFAULT_DTYPE_MIN_BYTES = 64 * 1024
+#: constants below this are the normal embedded-iota/mask noise
+DEFAULT_CONST_MIN_BYTES = 1024 * 1024
+
+_REDUCED_FLOATS = ("bfloat16", "float16")
+_CALLBACK_PRIMS = frozenset((
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "host_callback_call",
+))
+_COMPUTE_PRIMS = frozenset(("dot_general", "conv_general_dilated"))
+
+
+def _nbytes(shape, dtype):
+    return int(np.prod(shape, dtype=np.int64) * np.dtype(dtype).itemsize) \
+        if len(shape) else int(np.dtype(dtype).itemsize)
+
+
+def _human(nbytes):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if nbytes < 1024 or unit == "GiB":
+            return (f"{nbytes:.1f} {unit}" if unit != "B"
+                    else f"{int(nbytes)} B")
+        nbytes /= 1024
+
+
+def default_perf_rules():
+    return [
+        DonationRule(),
+        DtypePromotionRule(),
+        HostSyncRule(),
+        ConstantCaptureRule(),
+    ]
+
+
+# ---------------------------------------------------------------- donation
+class DonationRule:
+    """perf-donation: state-tree jit arguments returned updated but not
+    donated.
+
+    An argument is "state-tree shaped" when it is a container of
+    ``min_leaves``+ array leaves (params and optimizer trees; bare q/k/v
+    arrays are excluded — matching a lone output array by shape would be
+    noise).  It is "returned updated" when some output — the whole output,
+    or one element of a tuple output — has the identical treedef and the
+    identical leaf (shape, dtype) sequence: the step hands back a
+    successor of the argument, so the old buffers are dead the moment the
+    call returns and donation would reuse them in place."""
+
+    id = "perf-donation"
+    doc = ("Large params/opt-state-shaped jit arguments returned updated "
+           "by the step but missing from donate_argnums.")
+
+    def __init__(self, min_leaves=2, min_bytes=0):
+        self.min_leaves = int(min_leaves)
+        self.min_bytes = int(min_bytes)
+
+    @staticmethod
+    def _signature(tree):
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        sig = []
+        for leaf in leaves:
+            shape = tuple(getattr(leaf, "shape", ()))
+            dtype = str(getattr(leaf, "dtype", ""))
+            sig.append((shape, dtype))
+        return treedef, tuple(sig), leaves
+
+    def check(self, entry):
+        if entry.args_info is None or entry.out_info is None:
+            return []  # not a jit surface: no donation metadata to audit
+        pos_args = entry.args_info[0] if isinstance(entry.args_info, tuple) \
+            else entry.args_info
+        # candidate successors: the whole output, or one tuple element
+        out_candidates = [entry.out_info]
+        if isinstance(entry.out_info, (tuple, list)):
+            out_candidates.extend(entry.out_info)
+        out_sigs = []
+        for cand in out_candidates:
+            treedef, sig, _ = self._signature(cand)
+            out_sigs.append((treedef, sig))
+
+        findings = []
+        line = entry_anchor_line(entry.path)
+        for i, arg in enumerate(pos_args):
+            treedef, sig, leaves = self._signature(arg)
+            if len(leaves) < self.min_leaves:
+                continue
+            donated = [bool(getattr(l, "donated", False)) for l in leaves]
+            if all(donated):
+                continue
+            if (treedef, sig) not in out_sigs:
+                continue
+            total = sum(_nbytes(s, d) for s, d in sig if d)
+            if total < self.min_bytes:
+                continue
+            label = (f" ({entry.arg_names[i]})"
+                     if i < len(entry.arg_names) else "")
+            findings.append(Finding(
+                rule=self.id, path=entry.path, line=line, col=0,
+                message=(
+                    f"entry '{entry.name}': jit argument {i}{label} is a "
+                    f"{len(leaves)}-leaf state tree ({_human(total)}) whose "
+                    "exact successor is returned by the step, but it is not "
+                    "in donate_argnums — both generations stay live every "
+                    "round (HBM footprint doubles); donate it (see "
+                    "utils/jax_compat.py::resolve_donate_argnums and "
+                    "cache['donate_buffers'])"
+                ),
+            ))
+        return findings
+
+
+# --------------------------------------------------------- dtype promotion
+class DtypePromotionRule:
+    """perf-dtype-promotion: f32 upcasts / hoistable downcasts / repeated
+    casts inside a reduced-precision step (see module docstring)."""
+
+    id = "perf-dtype-promotion"
+    doc = ("f32 upcasts feeding matmuls, staging-hoistable argument casts, "
+           "and repeated casts inside a reduced-precision compiled step.")
+
+    def __init__(self, min_bytes=DEFAULT_DTYPE_MIN_BYTES):
+        self.min_bytes = int(min_bytes)
+
+    @staticmethod
+    def _is_reduced(dtype):
+        return str(dtype) in _REDUCED_FLOATS
+
+    def check(self, entry):
+        closed = entry.closed_jaxpr
+        if closed is None:
+            return []
+        levels = list(walk_jaxprs(closed))
+        # "a reduced-precision step": some matmul/conv touches bf16/f16
+        reduced_compute = any(
+            any(self._is_reduced(v.aval.dtype)
+                for v in eqn.invars if hasattr(v, "aval"))
+            for jaxpr, _, _ in levels
+            for eqn in jaxpr.eqns if eqn.primitive.name in _COMPUTE_PRIMS
+        )
+        findings = []
+        line = entry_anchor_line(entry.path)
+
+        def report(msg):
+            findings.append(Finding(
+                rule=self.id, path=entry.path, line=line, col=0,
+                message=f"entry '{entry.name}': {msg}",
+            ))
+
+        for jaxpr, _, arg_vars in levels:
+            compute_operands = set()
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name in _COMPUTE_PRIMS:
+                    compute_operands.update(
+                        v for v in eqn.invars if is_var(v)
+                    )
+            seen_casts = {}
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name != "convert_element_type":
+                    continue
+                src = eqn.invars[0]
+                if not is_var(src):  # literal operand
+                    continue
+                src_dt = str(src.aval.dtype)
+                dst_dt = str(eqn.params.get("new_dtype"))
+                nbytes = _nbytes(tuple(src.aval.shape), src.aval.dtype)
+                if nbytes < self.min_bytes:
+                    continue
+                shape = "x".join(map(str, src.aval.shape))
+                # (a) a top-level argument cast down inside the step: the
+                # cast belongs at batch staging (halves the argument's HBM
+                # traffic AND the host->device transfer)
+                if (src in arg_vars and src_dt in ("float32", "float64")
+                        and self._is_reduced(dst_dt)):
+                    report(
+                        f"step argument ({shape} {src_dt}, {_human(nbytes)})"
+                        f" is cast to {dst_dt} inside the compiled step — "
+                        "hoist the cast to batch staging "
+                        "(nn/basetrainer.py::_input_cast_dtype) so the step "
+                        "consumes the compute dtype directly"
+                    )
+                # (b) reduced->f32 upcast feeding a matmul/conv in a step
+                # that otherwise computes reduced: accidental f32 compute
+                if (reduced_compute and self._is_reduced(src_dt)
+                        and dst_dt == "float32"
+                        and any(ov in compute_operands
+                                for ov in eqn.outvars)):
+                    report(
+                        f"{shape} {src_dt} tensor ({_human(nbytes)}) is "
+                        "upcast to float32 and fed into a matmul/conv — "
+                        "accidental f32 compute inside a reduced-precision "
+                        "step (2x the MXU time and bytes of the intended "
+                        f"{src_dt} path)"
+                    )
+                # (c) the same tensor converted to the same dtype twice
+                key = (id(src), dst_dt)
+                if key in seen_casts:
+                    report(
+                        f"{shape} {src_dt} tensor ({_human(nbytes)}) is "
+                        f"converted to {dst_dt} more than once in the same "
+                        "scope — cast once and reuse (each repeat is a full "
+                        "memory pass)"
+                    )
+                else:
+                    seen_casts[key] = eqn
+        return findings
+
+
+# -------------------------------------------------------------- host sync
+class HostSyncRule:
+    """perf-host-sync: callback primitives traced into the compiled step."""
+
+    id = "perf-host-sync"
+    doc = ("Host callback primitives (pure_callback/io_callback/"
+           "debug_callback) reachable inside a compiled step.")
+
+    def check(self, entry):
+        closed = entry.closed_jaxpr
+        if closed is None:
+            return []
+        counts = {}
+        for jaxpr, _, _ in walk_jaxprs(closed):
+            for eqn in jaxpr.eqns:
+                name = eqn.primitive.name
+                if name in _CALLBACK_PRIMS:
+                    counts[name] = counts.get(name, 0) + 1
+        if not counts:
+            return []
+        detail = ", ".join(f"{n}x {p}" for p, n in sorted(counts.items()))
+        return [Finding(
+            rule=self.id, path=entry.path,
+            line=entry_anchor_line(entry.path), col=0,
+            message=(
+                f"entry '{entry.name}': host callback(s) traced into the "
+                f"compiled step ({detail}) — every execution pays a "
+                "device->host round-trip inside the hot loop; move the "
+                "callback outside the jit (telemetry is host-side by "
+                "contract, see the trace-telemetry rule)"
+            ),
+        )]
+
+
+# ------------------------------------------------------- constant capture
+class ConstantCaptureRule:
+    """perf-constant-capture: large closure-captured constants baked into
+    the jaxpr."""
+
+    id = "perf-constant-capture"
+    doc = ("Large closure-captured constants embedded in the compiled "
+           "step's jaxpr instead of passed as arguments.")
+
+    def __init__(self, min_bytes=DEFAULT_CONST_MIN_BYTES):
+        self.min_bytes = int(min_bytes)
+
+    def check(self, entry):
+        closed = entry.closed_jaxpr
+        if closed is None:
+            return []
+        findings, seen = [], set()
+        line = entry_anchor_line(entry.path)
+        for _, consts, _ in walk_jaxprs(closed):
+            for const in consts:
+                if id(const) in seen:
+                    continue
+                seen.add(id(const))
+                try:
+                    arr = np.asarray(const)
+                except Exception:  # noqa: BLE001 — non-array const
+                    continue
+                nbytes = int(arr.nbytes)
+                if nbytes < self.min_bytes:
+                    continue
+                shape = "x".join(map(str, arr.shape)) or "scalar"
+                findings.append(Finding(
+                    rule=self.id, path=entry.path, line=line, col=0,
+                    message=(
+                        f"entry '{entry.name}': a {shape} {arr.dtype} "
+                        f"constant ({_human(nbytes)}) is closure-captured "
+                        "into the jaxpr — it is re-embedded in every "
+                        "executable that closes over it and can never be "
+                        "donated; pass it as an explicit argument"
+                    ),
+                ))
+        return findings

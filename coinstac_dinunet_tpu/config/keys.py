@@ -360,6 +360,25 @@ class Capture:
 ENGINE_PROVIDED_KEYS = ("task_id", "data_conf")
 
 
+#: The canonical invocation-per-round phase machine: which :class:`Phase`
+#: values may follow which across engine invocations.  This is the contract
+#: ``nodes/local.py``/``nodes/remote.py`` implement, and the single source
+#: of truth dinulint tier-3's ``proto-flow-*``/``proto-cache-*`` rules
+#: (``analysis/protocol_flow.py``) parse — phase-ordering checks
+#: (read-before-write across phases, payloads arriving in rounds that skip
+#: their consumer) are judged against this reachability, never against a
+#: hard-coded order.  COMPUTATION self-loops (one entry per federated
+#: round); NEXT_RUN_WAITING forks into the next fold or run-level SUCCESS.
+PHASE_TRANSITIONS = {
+    Phase.INIT_RUNS: (Phase.NEXT_RUN,),
+    Phase.NEXT_RUN: (Phase.COMPUTATION, Phase.PRE_COMPUTATION),
+    Phase.PRE_COMPUTATION: (Phase.COMPUTATION,),
+    Phase.COMPUTATION: (Phase.COMPUTATION, Phase.NEXT_RUN_WAITING),
+    Phase.NEXT_RUN_WAITING: (Phase.NEXT_RUN, Phase.SUCCESS),
+    Phase.SUCCESS: (),
+}
+
+
 class AggEngine(_StrEnum):
     """Built-in gradient-aggregation engines (≙ AGG_Engine dSGD/powerSGD/rankDAD)."""
     DSGD = "dSGD"

@@ -39,7 +39,7 @@ from ..telemetry import get_active as _telemetry
 from ..telemetry import health as _health
 from ..telemetry import perf as _perf
 from ..utils import atomic_write, logger
-from ..utils.jax_compat import shard_map
+from ..utils.jax_compat import resolve_donate_argnums, shard_map
 from ..utils.utils import performance_improved_, stop_training_
 
 CHECKPOINT_SOURCE = "coinstac-dinunet-tpu"
@@ -78,6 +78,11 @@ _VOLATILE_CACHE_KEYS = frozenset((
     # wire retry pressure counters (resilience/retry.py) mutate per load —
     # host-side bookkeeping, never trace-relevant
     "wire_retry_stats",
+    # quorum roster bookkeeping (nodes/remote.py): grows the round a site
+    # dies — host-side policy state, never traced.  Leaving it keyed would
+    # churn the aggregator trainer's shared-bucket key (one recompile per
+    # drop event); the proto-cache-volatile tier-3 rule guards this list.
+    "dropped_sites",
     # Key.* bookkeeping the nodes append per round/fold (metrics rollups,
     # serialized score blobs, one-shot flags) — all host-side, never traced
     Key.TEST_METRICS.value, Key.TRAIN_SERIALIZABLE.value,
@@ -823,24 +828,7 @@ class NNTrainer:
                 if fn is None:
                     built = True
                     self._note_jit_build("train")
-                    metrics_shell, averages_shell = self._metrics_shell()
-
-                    def _full(ts, stacked):
-                        grads, aux = self._grads_uncompiled(ts, stacked, metrics_shell, averages_shell)
-                        ts = self._apply_updates(ts, grads)
-                        ts = ts.replace(rng=aux["rng"])
-                        return ts, aux
-
-                    # donate the incoming train state: params/opt buffers update in
-                    # place on the accelerator instead of doubling HBM footprint
-                    # (no-op on CPU, where donation only emits warnings)
-                    donate = (
-                        (0,)
-                        if jax.default_backend() != "cpu"
-                        and self.cache.get("donate_buffers", True)
-                        else ()
-                    )
-                    fn = self._compiled["train"] = jax.jit(_full, donate_argnums=donate)
+                    fn = self._compiled["train"] = self._build_train_step()
                     self._note_jit_cost("train", fn, (ts, stacked_batches))
                 out = fn(ts, stacked_batches)
             if timer is not None:
@@ -851,18 +839,34 @@ class NNTrainer:
             self._perf_round_end(timer, key, stacked_batches, rec, built=built)
         return out
 
+    def _build_train_step(self):
+        """The fused grad+update jit — the single-device production hot
+        path.  The incoming train state is DONATED on accelerator backends
+        (params/opt buffers update in place instead of doubling HBM; see
+        :func:`~..utils.jax_compat.resolve_donate_argnums` — the decision
+        dinulint tier-3's ``perf-donation`` rule audits via the
+        'trainer-train-jit' entry)."""
+        metrics_shell, averages_shell = self._metrics_shell()
+
+        def _full(ts, stacked):
+            grads, aux = self._grads_uncompiled(
+                ts, stacked, metrics_shell, averages_shell
+            )
+            ts = self._apply_updates(ts, grads)
+            ts = ts.replace(rng=aux["rng"])
+            return ts, aux
+
+        return jax.jit(
+            _full, donate_argnums=resolve_donate_argnums(self.cache, (0,))
+        )
+
     def _train_step_dp(self, ts, stacked_batches, n):
         fn = self._compiled.get(("train_dp", n))
         if fn is None:
             self._note_jit_build(f"train_dp:{n}")
-            donate = (
-                (0,)
-                if jax.default_backend() != "cpu"
-                and self.cache.get("donate_buffers", True)
-                else ()
-            )
             fn = self._compiled[("train_dp", n)] = self._build_dp_step(
-                n, apply_updates=True, donate=donate
+                n, apply_updates=True,
+                donate=resolve_donate_argnums(self.cache, (0,)),
             )
             self._note_jit_cost(f"train_dp:{n}", fn, (ts, stacked_batches))
         return fn(ts, stacked_batches)

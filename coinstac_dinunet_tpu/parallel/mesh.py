@@ -28,7 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config.keys import MeshAxis
 from ..ops import orthogonalize
-from ..utils.jax_compat import shard_map
+from ..utils.jax_compat import resolve_donate_argnums, shard_map
 
 
 from .powersgd import _aslist  # msgpack list/dict normalization (shared)
@@ -347,12 +347,7 @@ class MeshFederation:
 
         batch_spec = P(MeshAxis.SITE, None, MeshAxis.DEVICE)
         mesh = self.mesh
-        donate = (
-            (0,)
-            if jax.default_backend() != "cpu"
-            and self.trainer.cache.get("donate_buffers", True)
-            else ()
-        )
+        donate = resolve_donate_argnums(self.trainer.cache, (0,))
 
         @functools.partial(jax.jit, donate_argnums=donate)
         def step(ts, stacked, comm):
@@ -501,14 +496,8 @@ class MeshFederation:
         batch_spec = self._train_batch_specs()
         mesh = self.mesh
 
-        # donate train state + engine comm state (both replaced every round);
-        # CPU donation is a warning-only no-op, so gate it
-        donate = (
-            (0, 2)
-            if jax.default_backend() != "cpu"
-            and self.trainer.cache.get("donate_buffers", True)
-            else ()
-        )
+        # donate train state + engine comm state (both replaced every round)
+        donate = resolve_donate_argnums(self.trainer.cache, (0, 2))
 
         @functools.partial(jax.jit, donate_argnums=donate)
         def step(ts, stacked, comm):
@@ -630,6 +619,12 @@ class MeshFederation:
         for jit-safe metrics (host_scores None), or host_scores (gathered
         score/true/mask arrays) for host-accumulated metrics like AUC."""
         if isinstance(site_batches, (list, tuple)):
+            # staging-time input cast (nn/basetrainer.py::_input_cast_dtype):
+            # the host→device transfer ships the compute dtype and the
+            # compiled eval consumes it directly — no in-step re-cast
+            site_batches = [
+                self.trainer._cast_batch_inputs(b) for b in site_batches
+            ]
             self._sample_batch_keys = tuple(site_batches[0].keys())
             glob = {
                 k: jnp.stack([jnp.asarray(b[k]) for b in site_batches])

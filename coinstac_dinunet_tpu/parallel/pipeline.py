@@ -44,9 +44,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from functools import partial
+
 from ..config.keys import MeshAxis
 from ..ops import flash_attention
-from ..utils.jax_compat import shard_map
+from ..utils.jax_compat import resolve_donate_argnums, shard_map
 from .sequence import _layernorm, transformer_block
 
 __all__ = ["build_pp_mesh", "stack_layers", "make_pp_train_step",
@@ -102,11 +104,15 @@ def _block(h, lp, cfg):
     return h
 
 
-def make_pp_train_step(cfg, mesh, lr=1e-3, num_microbatches=None):
+def make_pp_train_step(cfg, mesh, lr=1e-3, num_microbatches=None,
+                       donate=True):
     """Jit-compiled SGD step with GPipe pipelining over ``pp``.
 
     ``num_microbatches`` defaults to the pp size (minimum that fills the
-    pipe; raise it to shrink the bubble)."""
+    pipe; raise it to shrink the bubble).  The incoming params are DONATED
+    on accelerator backends (the step returns their successor — the
+    tier-3 perf-donation contract; a no-op on CPU); callers re-reading
+    the pre-step params after the call must pass ``donate=False``."""
     pp = mesh.shape[MeshAxis.PP]
     M = int(num_microbatches or pp)
     assert cfg.num_experts == 0, "pipeline path uses the dense-FFN layers"
@@ -183,7 +189,9 @@ def make_pp_train_step(cfg, mesh, lr=1e-3, num_microbatches=None):
 
     p_specs = _pp_specs  # resolved per-call against the actual pytree
 
-    @jax.jit
+    donate_argnums = resolve_donate_argnums(None, (0,)) if donate else ()
+
+    @partial(jax.jit, donate_argnums=donate_argnums)
     def step(params, x, y):
         specs = p_specs(params)
         return shard_map(

@@ -28,7 +28,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config.keys import MeshAxis
-from ..utils.jax_compat import shard_map
+from ..utils.jax_compat import resolve_donate_argnums, shard_map
 from .ring_attention import ring_attention
 
 __all__ = ["TSPConfig", "build_tsp_mesh", "init_tsp_params", "shard_tsp_params",
@@ -264,11 +264,17 @@ def tsp_forward(params, x, cfg, mesh):
     return pooled @ params["head"], moe_aux
 
 
-def make_tsp_train_step(cfg, mesh, lr=1e-3):
+def make_tsp_train_step(cfg, mesh, lr=1e-3, donate=True):
     """Jit-compiled SGD step over the dp×tp×sp mesh.
 
     Gradient collectives (dp/sp reductions, tp-sharded layouts) all come from
     GSPMD transposing the forward shardings — returns ``(params, loss)``.
+
+    The incoming params are DONATED on accelerator backends (the step
+    returns their successor, so the old tree's buffers are reused in place
+    — the tier-3 perf-donation contract; a no-op on CPU).  Callers that
+    re-read the pre-step params after the call (old-vs-new comparisons)
+    must pass ``donate=False``.
     """
 
     def loss_fn(params, x, y):
@@ -277,7 +283,9 @@ def make_tsp_train_step(cfg, mesh, lr=1e-3):
         ce = -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
         return ce + cfg.moe_aux_weight * moe_aux
 
-    @jax.jit
+    donate_argnums = resolve_donate_argnums(None, (0,)) if donate else ()
+
+    @partial(jax.jit, donate_argnums=donate_argnums)
     def step(params, x, y):
         loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
         params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)

@@ -16,10 +16,12 @@ top-level-API releases.  ``tests/test_jax_floor.py`` asserts the installed
 JAX satisfies the declared floor, so the two can't silently drift apart
 again.
 """
+import contextlib
+
 import jax
 from jax import lax
 
-__all__ = ["shard_map", "axis_size"]
+__all__ = ["shard_map", "axis_size", "resolve_donate_argnums", "force_donation"]
 
 if hasattr(jax, "shard_map"):
     shard_map = jax.shard_map
@@ -42,3 +44,46 @@ else:
         axis constant-folds to the axis size as a static int — the pre-
         ``lax.axis_size`` idiom, so shape arithmetic stays trace-static."""
         return lax.psum(1, axis_name)
+
+
+# --------------------------------------------------------- buffer donation
+# True while dinulint tier-3 (analysis/dataflow.py) is lowering the
+# registered compiled surfaces: donation decisions are resolved as they
+# would be on an accelerator backend, so the CPU analysis platform sees the
+# production ``donate_argnums`` (the ``perf-donation`` rule audits intent,
+# not the CPU no-op).  Never set at runtime.
+_FORCE_DONATION = False
+
+
+def resolve_donate_argnums(cache, argnums):
+    """The package-wide buffer-donation decision, in one place.
+
+    Every train-step-shaped jit (state in → successor state out) donates
+    its state arguments so the old params/opt-state buffers are reused
+    in place instead of doubling HBM — gated by ``cache['donate_buffers']``
+    (default True) and disabled on the CPU backend, where donation buys
+    nothing and historically only emitted warnings.  ``cache=None`` means
+    "no opt-out knob": donate whenever the backend pays.
+
+    dinulint tier-3 lowers the compiled surfaces under
+    :func:`force_donation`, which overrides the CPU suppression so the
+    ``perf-donation`` rule audits the production donation intent from the
+    CPU analysis platform.
+    """
+    if cache is not None and not cache.get("donate_buffers", True):
+        return ()
+    if jax.default_backend() == "cpu" and not _FORCE_DONATION:
+        return ()
+    return tuple(argnums)
+
+
+@contextlib.contextmanager
+def force_donation():
+    """Resolve donation as an accelerator backend would (analysis only)."""
+    global _FORCE_DONATION
+    prev = _FORCE_DONATION
+    _FORCE_DONATION = True
+    try:
+        yield
+    finally:
+        _FORCE_DONATION = prev

@@ -102,17 +102,46 @@ _CACHE = dict(
 
 
 # -------------------------------------------------------------- vectorized
-def _bench_vectorized(n_sites, rounds, batch=8):
-    """rounds/sec of the one-jit site-vectorized plane at ``n_sites``."""
+def _sample_hbm():
+    """One flight-recorder device-memory sample
+    (``telemetry/perf.py::sample_device_memory``) routed through a
+    throwaway enabled recorder; returns the perf rollup dict (in-use/
+    peak/limit bytes where the backend reports them, live-buffer census
+    elsewhere — the donation A/B's before/after evidence) or None."""
+    from coinstac_dinunet_tpu.telemetry import Recorder
+    from coinstac_dinunet_tpu.telemetry import perf as tperf
+
+    probe_cache = {}
+    rec = Recorder("bench", cache=probe_cache)
+    in_use = tperf.sample_device_memory(probe_cache, recorder=rec)
+    if in_use is None:
+        return None
+    return dict(probe_cache.get("health", {}).get("perf", {}))
+
+
+def _bench_vectorized(n_sites, rounds, batch=8, donate=True):
+    """rounds/sec of the one-jit site-vectorized plane at ``n_sites``,
+    with HBM samples bracketing the timed rounds (the
+    ``cache['donate_buffers']`` A/B: donation should hold the stacked
+    opt-state at ONE generation — compare ``hbm.peak_bytes`` between a
+    default run and ``--no-donation``)."""
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from coinstac_dinunet_tpu.config.keys import MeshAxis
     from coinstac_dinunet_tpu.federation import SiteVectorizedFederation
 
-    trainer = _make_trainer_cls()(cache=dict(_CACHE), state={},
-                                  data_handle=None)
+    from coinstac_dinunet_tpu.utils.jax_compat import resolve_donate_argnums
+
+    trainer = _make_trainer_cls()(
+        cache=dict(_CACHE, donate_buffers=bool(donate)), state={},
+        data_handle=None,
+    )
     trainer.init_nn()
+    # what the build will ACTUALLY do: on CPU donation resolves to a no-op
+    # regardless of the knob, and reporting the knob alone would present
+    # two identical executables as a donation A/B
+    donate_effective = bool(resolve_donate_argnums(trainer.cache, (0, 1)))
     fed = SiteVectorizedFederation(trainer, n_sites)
     rng = np.random.default_rng(0)
     bits = rng.integers(0, 2, size=(n_sites, 1, batch, 2))
@@ -126,14 +155,21 @@ def _bench_vectorized(n_sites, rounds, batch=8):
     stacked = fed._place(stacked, P(MeshAxis.SITE))
     aux = fed.train_step(stacked)  # warm-up: compile + first dispatch
     float(np.asarray(aux["loss"]))
+    hbm_before = _sample_hbm()
     t0 = time.perf_counter()
     for _ in range(rounds):
         aux = fed.train_step(stacked)
     float(np.asarray(aux["loss"]))  # fence
     dt = time.perf_counter() - t0
-    return {"rounds_per_sec": round(rounds / dt, 3),
-            "round_ms": round(1e3 * dt / rounds, 3),
-            "shards": fed.shards}
+    hbm_after = _sample_hbm()
+    out = {"rounds_per_sec": round(rounds / dt, 3),
+           "round_ms": round(1e3 * dt / rounds, 3),
+           "shards": fed.shards,
+           "donate_buffers": bool(donate),
+           "donate_effective": donate_effective}
+    if hbm_after:
+        out["hbm"] = {"before": hbm_before, "after": hbm_after}
+    return out
 
 
 # ------------------------------------------------------------------ serial
@@ -178,6 +214,11 @@ def main(argv=None):
                    help="serial-engine + telemetry workdir (default: a "
                         "temp dir); `telemetry doctor <workdir>` consumes "
                         "its event lanes")
+    p.add_argument("--no-donation", action="store_true",
+                   help="build the vectorized step WITHOUT donate_argnums "
+                        "(cache['donate_buffers']=False) — the before/"
+                        "after HBM-peak A/B against a default run shows "
+                        "what donation of the stacked site state saves")
     args = p.parse_args(argv)
     rounds = args.rounds or (3 if args.smoke else 10)
     serial_cap = args.serial_cap or (16 if args.smoke else 100)
@@ -220,7 +261,9 @@ def main(argv=None):
 
     vectorized, serial = {}, {}
     for s in vec_points:
-        vectorized[str(s)] = _bench_vectorized(s, rounds)
+        vectorized[str(s)] = _bench_vectorized(
+            s, rounds, donate=not args.no_donation
+        )
         print(f"# vectorized {s:>5} sites: "
               f"{vectorized[str(s)]['rounds_per_sec']:g} rounds/s "
               f"({vectorized[str(s)]['shards']} shard(s))", file=sys.stderr)

@@ -3,6 +3,10 @@
 # + dinulint (JAX-hazard and wire-protocol analysis, always) against the
 # checked-in baseline.  Mirrors tests/test_analysis_selfcheck.py so the
 # same check runs pre-commit and inside tier-1.
+#
+# DINULINT_TIER3=1 additionally runs the opt-in JAX tiers in ONE
+# invocation (--tier3 --deep share entry builds — the CI lint job uses
+# this); the default stays the millisecond pure-AST pass.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -16,14 +20,32 @@ else
     echo "== ruff not installed; skipping (pip install ruff to enable) =="
 fi
 
-echo "== dinulint (python -m coinstac_dinunet_tpu.analysis) =="
+# the console entry point (pyproject [project.scripts]) when installed,
+# else the module spelling — identical CLI either way
+if command -v dinulint >/dev/null 2>&1; then
+    DINULINT=(dinulint)
+else
+    DINULINT=(python -m coinstac_dinunet_tpu.analysis)
+fi
+
+extra=()
+if [ "${DINULINT_TIER3:-}" = "1" ]; then
+    # one invocation for both JAX tiers: tier-3's entry builds are cached
+    # and reused by --deep (see analysis/dataflow.py), keeping the job
+    # inside the static gate's wall-clock budget
+    extra+=(--tier3 --deep)
+fi
+
+echo "== dinulint (${DINULINT[*]} ${extra[*]-}) =="
 # Under GitHub Actions, emit ::error workflow annotations so findings land
 # inline on the PR diff; plain text everywhere else.
 fmt="text"
 if [ "${GITHUB_ACTIONS:-}" = "true" ]; then
     fmt="github"
 fi
-python -m coinstac_dinunet_tpu.analysis coinstac_dinunet_tpu \
-    --baseline dinulint_baseline.json --format "$fmt" || status=1
+"${DINULINT[@]}" coinstac_dinunet_tpu \
+    --baseline dinulint_baseline.json --format "$fmt" \
+    ${extra[@]+"${extra[@]}"} \
+    || status=1
 
 exit "$status"

@@ -163,7 +163,9 @@ def test_builtin_registry_covers_the_compiled_surfaces():
     entries = list_entry_points()
     for expected in (
         "trainer-train-step", "trainer-eval-step", "trainer-dp-train-step",
-        "mesh-federation-dsgd-step", "powersgd-reducer", "rankdad-reducer",
+        "trainer-train-jit", "mesh-federation-dsgd-step",
+        "fed-vector-step", "fed-vector-step-vmap",
+        "powersgd-reducer", "rankdad-reducer",
         "ring-attention", "ulysses-attention", "pipeline-train-step",
         "tsp-train-step", "tsp-moe-train-step",
     ):
@@ -228,7 +230,8 @@ def test_cli_write_baseline_refused_when_deep_tier_cannot_run(
     rc = main([str(src), "--deep", "--write-baseline",
                "--baseline", str(baseline)])
     assert rc == 2
-    assert "deep tier could not run" in capsys.readouterr().err
+    err = capsys.readouterr().err
+    assert "deep-config" in err and "could not run" in err
     assert not baseline.exists()
 
 

@@ -75,6 +75,100 @@ def test_protocol_rule_reports_zero_unmatched_wire_keys():
     assert unmatched == [], "\n".join(f.render() for f in unmatched)
 
 
+# --------------------------------------------------------------- ratchet
+# The baseline is a one-way valve: it may shrink (findings fixed), never
+# grow or go stale without a conscious decision recorded HERE.  Bump only
+# when accepting a new legacy finding on purpose, in the same commit that
+# refreshes the file.
+MAX_BASELINE_FINDINGS = 0
+
+REFRESH_CMD = (
+    "dinulint coinstac_dinunet_tpu --tier3 --deep --write-baseline "
+    "--baseline dinulint_baseline.json"
+)
+
+
+def _baseline_entries():
+    import json
+
+    with open(BASELINE, "r", encoding="utf-8") as f:
+        return json.load(f).get("findings", [])
+
+
+def _stale_suppressions(entries, findings):
+    """Baseline entries (or partial absorption slots — counts matter) no
+    finding matches anymore — dead weight that would silently mask a
+    future regression with the same fingerprint."""
+    import collections
+
+    fired = collections.Counter(f.fingerprint() for f in findings)
+    return [
+        e for e in entries
+        if fired[(e["rule"], e["path"], e["message"])]
+        < int(e.get("count", 1))
+    ]
+
+
+def test_baseline_ratchet_has_not_grown():
+    total = sum(int(e.get("count", 1)) for e in _baseline_entries())
+    assert total <= MAX_BASELINE_FINDINGS, (
+        f"dinulint_baseline.json grew to {total} finding(s) "
+        f"(ratchet: {MAX_BASELINE_FINDINGS}).  Fix the findings instead of "
+        "baselining them; if a new legacy finding is genuinely accepted, "
+        "bump MAX_BASELINE_FINDINGS here in the same commit and refresh "
+        f"with:\n    {REFRESH_CMD}"
+    )
+
+
+def test_baseline_ratchet_has_no_stale_suppressions():
+    """Every baseline entry must still fire in the tier that owns it —
+    a suppression whose finding was fixed must be dropped, or it will
+    silently swallow the next regression with the same fingerprint."""
+    entries = _baseline_entries()
+    if not entries:
+        return  # empty baseline: nothing can be stale
+    from coinstac_dinunet_tpu.analysis import default_rules
+
+    static_ids = {r.id for r in default_rules()}
+    findings = []
+    if any(e["rule"] in static_ids for e in entries):
+        findings += run_lint([PACKAGE])[0]
+    if any(e["rule"].startswith("deep-") for e in entries):
+        from coinstac_dinunet_tpu.analysis.deepcheck import run_deepcheck
+
+        findings += run_deepcheck()
+    if any(e["rule"].startswith(("perf-", "proto-", "tier3-"))
+           for e in entries):
+        from coinstac_dinunet_tpu.analysis.dataflow import run_tier3
+
+        findings += run_tier3()
+    stale = _stale_suppressions(entries, findings)
+    assert stale == [], (
+        "stale dinulint_baseline.json suppression(s) — these entries no "
+        f"longer fire and must be dropped (refresh with:\n    {REFRESH_CMD}"
+        f"\n): {stale}"
+    )
+
+
+def test_baseline_ratchet_machinery_detects_staleness():
+    """The stale-suppression detector itself (exercised with synthetic
+    data so the check stays honest while the real baseline is empty)."""
+    from coinstac_dinunet_tpu.analysis import Finding
+
+    live = Finding(rule="r", path="p.py", line=3, col=0, message="m")
+    entries = [
+        {"rule": "r", "path": "p.py", "message": "m", "count": 1},
+        {"rule": "r", "path": "p.py", "message": "gone", "count": 1},
+    ]
+    stale = _stale_suppressions(entries, [live])
+    assert stale == [entries[1]]
+    # a partially-stale multi-count entry (2 absorbed, 1 still firing) is
+    # stale too: the unused slot would swallow the next regression
+    multi = [{"rule": "r", "path": "p.py", "message": "m", "count": 2}]
+    assert _stale_suppressions(multi, [live]) == multi
+    assert _stale_suppressions(multi, [live, live]) == []
+
+
 def test_trace_rules_cover_the_package_without_noise():
     """The trace-hazard families run over the real package: everything they
     report (if anything) must be baselined — no unreviewed hazards ride in."""

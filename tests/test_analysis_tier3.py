@@ -1,0 +1,573 @@
+"""dinulint tier-3: jaxpr dataflow rules + the phase-machine model.
+
+Acceptance (ISSUE 8): every tier-3 rule fires on a seeded bug — a
+non-donated params jit, an in-step f32 upcast, a traced host sync, a large
+captured constant, a produced-but-never-consumed wire key, a
+read-before-write cache key — the pre-fix ``federation/vector.py``
+donation gap reproduces as a fixture, and the live repo runs clean.
+
+Fixture entries register into a snapshot/restored ``DEEP_REGISTRY`` (and
+a cleared build cache) so the built-in registry is untouched.
+"""
+import ast
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from coinstac_dinunet_tpu.analysis import deepcheck
+from coinstac_dinunet_tpu.analysis import protocol_flow as pflow
+from coinstac_dinunet_tpu.analysis.core import Module
+from coinstac_dinunet_tpu.analysis.dataflow import (
+    clear_build_cache,
+    lower_entry,
+    run_tier3,
+    tier3_builds,
+)
+from coinstac_dinunet_tpu.analysis.deepcheck import (
+    REQUIRED_DEVICES,
+    register_entry_point,
+    run_deepcheck,
+)
+from coinstac_dinunet_tpu.analysis.perf_rules import (
+    ConstantCaptureRule,
+    DonationRule,
+    DtypePromotionRule,
+    HostSyncRule,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "coinstac_dinunet_tpu")
+BASELINE = os.path.join(REPO, "dinulint_baseline.json")
+
+
+@pytest.fixture
+def registry():
+    deepcheck._register_builtin_entries()
+    saved = dict(deepcheck.DEEP_REGISTRY)
+    clear_build_cache()
+    yield deepcheck.DEEP_REGISTRY
+    deepcheck.DEEP_REGISTRY.clear()
+    deepcheck.DEEP_REGISTRY.update(saved)
+    clear_build_cache()
+
+
+def _sds(shape, dtype="float32"):
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+
+def _rules_for(entry_name, rule):
+    entry = lower_entry(entry_name)
+    assert entry.error is None, entry.error
+    return rule.check(entry)
+
+
+# ------------------------------------------------------------ perf-donation
+def test_donation_fires_on_non_donated_params_jit(registry):
+    """Seeded bug: a train-step-shaped jit (params in -> successor params
+    out) without donate_argnums."""
+
+    @register_entry_point("fixture-no-donate", "pkg/fx.py",
+                          arg_names=("params", "batch"))
+    def _fx():
+        def step(params, x):
+            return (
+                {k: v - 0.1 * v for k, v in params.items()},
+                (x @ params["w"]).sum(),
+            )
+
+        return jax.jit(step), (
+            {"w": _sds((64, 64)), "b": _sds((64,))}, _sds((8, 64)),
+        )
+
+    findings = _rules_for("fixture-no-donate", DonationRule())
+    assert [f.rule for f in findings] == ["perf-donation"]
+    assert "argument 0 (params)" in findings[0].message
+
+
+def test_donation_quiet_when_donated(registry):
+    @register_entry_point("fixture-donated", "pkg/fx.py")
+    def _fx():
+        def step(params, x):
+            return (
+                {k: v - 0.1 * v for k, v in params.items()},
+                (x @ params["w"]).sum(),
+            )
+
+        return jax.jit(step, donate_argnums=(0,)), (
+            {"w": _sds((64, 64)), "b": _sds((64,))}, _sds((8, 64)),
+        )
+
+    assert _rules_for("fixture-donated", DonationRule()) == []
+
+
+def test_donation_ignores_bare_array_shape_coincidences(registry):
+    """q/k/v-style single-array args that happen to match an output shape
+    are not state trees — no finding."""
+
+    @register_entry_point("fixture-attention-like", "pkg/fx.py")
+    def _fx():
+        def step(q, k):
+            return q + k
+
+        return jax.jit(step), (_sds((4, 16)), _sds((4, 16)))
+
+    assert _rules_for("fixture-attention-like", DonationRule()) == []
+
+
+def test_prefix_federation_vector_donation_gap_reproduces(registry):
+    """THE motivating gap: the PR-6 `jax.jit(block)` / `jax.jit(shard_map)`
+    builds in federation/vector.py shipped without donation.  Building the
+    step with cache['donate_buffers']=False reproduces the pre-fix
+    executable; both the shared params and the stacked site state must be
+    flagged, anchored to federation/vector.py's jit build site."""
+    from coinstac_dinunet_tpu.federation.vector import SiteVectorizedFederation
+
+    @register_entry_point(
+        "fixture-vector-prefix", "coinstac_dinunet_tpu/federation/vector.py",
+        arg_names=("params", "site_state", "site_ix", "stacked"),
+    )
+    def _fx():
+        trainer = deepcheck._make_deep_trainer()
+        trainer.cache["donate_buffers"] = False  # the pre-fix build
+        fed = SiteVectorizedFederation(
+            trainer, n_sites=REQUIRED_DEVICES,
+            devices=jax.devices()[:REQUIRED_DEVICES],
+        )
+        step = fed._build_step()
+        params = deepcheck._abstract_tree(trainer.train_state.params)
+        site_state = deepcheck._abstract_tree(fed._stacked_site_state())
+        stacked = {
+            "inputs": _sds((REQUIRED_DEVICES, 1, 4, 4)),
+            "labels": _sds((REQUIRED_DEVICES, 1, 4), "int32"),
+        }
+        return step, (
+            params, site_state, _sds((REQUIRED_DEVICES,), "int32"), stacked,
+        )
+
+    findings = _rules_for("fixture-vector-prefix", DonationRule())
+    assert sorted(f.rule for f in findings) == ["perf-donation"] * 2
+    assert all(
+        f.path == "coinstac_dinunet_tpu/federation/vector.py"
+        and f.line > 1 for f in findings
+    ), [f.render() for f in findings]
+    assert any("site_state" in f.message for f in findings)
+
+
+def test_fixed_federation_vector_step_is_clean(registry):
+    """Post-fix: the production build (donate_buffers on, resolved as an
+    accelerator would under force_donation) donates both state args."""
+    findings = _rules_for("fed-vector-step", DonationRule())
+    assert findings == [], [f.render() for f in findings]
+    findings = _rules_for("fed-vector-step-vmap", DonationRule())
+    assert findings == [], [f.render() for f in findings]
+
+
+# --------------------------------------------------- perf-dtype-promotion
+def test_dtype_rule_flags_in_step_staging_cast(registry):
+    """Seeded bug: the step consumes f32 inputs and downcasts inside —
+    the cast belongs at batch staging (the docs/PERF.md 0.9 ms lever)."""
+
+    @register_entry_point("fixture-staging-cast", "pkg/fx.py")
+    def _fx():
+        def step(x, w):
+            return x.astype(jnp.bfloat16) @ w
+
+        return jax.jit(step), (
+            _sds((256, 256)), _sds((256, 256), "bfloat16"),
+        )
+
+    findings = _rules_for(
+        "fixture-staging-cast", DtypePromotionRule(min_bytes=1024)
+    )
+    assert [f.rule for f in findings] == ["perf-dtype-promotion"]
+    assert "hoist the cast to batch staging" in findings[0].message
+
+
+def test_dtype_rule_flags_f32_upcast_feeding_matmul(registry):
+    """Seeded bug: an in-step f32 upcast whose result feeds a matmul in
+    an otherwise-bf16 step (accidental f32 compute)."""
+
+    @register_entry_point("fixture-upcast", "pkg/fx.py")
+    def _fx():
+        def step(x, w):
+            h = (x @ w).astype(jnp.float32)
+            return h @ h.T
+
+        return jax.jit(step), (
+            _sds((256, 256), "bfloat16"), _sds((256, 256), "bfloat16"),
+        )
+
+    findings = _rules_for(
+        "fixture-upcast", DtypePromotionRule(min_bytes=1024)
+    )
+    assert [f.rule for f in findings] == ["perf-dtype-promotion"]
+    assert "upcast to float32" in findings[0].message
+
+
+def test_dtype_rule_quiet_on_clean_bf16_step(registry):
+    @register_entry_point("fixture-clean-bf16", "pkg/fx.py")
+    def _fx():
+        def step(x, w):
+            return x @ w
+
+        return jax.jit(step), (
+            _sds((256, 256), "bfloat16"), _sds((256, 256), "bfloat16"),
+        )
+
+    assert _rules_for(
+        "fixture-clean-bf16", DtypePromotionRule(min_bytes=1024)
+    ) == []
+
+
+# --------------------------------------------------------- perf-host-sync
+def test_host_sync_rule_flags_traced_callback(registry):
+    """Seeded bug: a debug print (and a pure_callback) traced into the
+    step — host round-trips in the hot loop."""
+
+    @register_entry_point("fixture-host-sync", "pkg/fx.py")
+    def _fx():
+        def step(x):
+            jax.debug.print("loss {}", x.sum())
+            return x * 2
+
+        return jax.jit(step), (_sds((8,)),)
+
+    findings = _rules_for("fixture-host-sync", HostSyncRule())
+    assert [f.rule for f in findings] == ["perf-host-sync"]
+    assert "debug_callback" in findings[0].message
+
+
+# -------------------------------------------------- perf-constant-capture
+def test_constant_capture_rule_flags_closure_constant(registry):
+    """Seeded bug: a 4 MiB closure-captured matrix baked into the jaxpr."""
+    big = jnp.ones((1024, 1024))
+
+    @register_entry_point("fixture-const", "pkg/fx.py")
+    def _fx():
+        def step(x):
+            return x @ big
+
+        return jax.jit(step), (_sds((8, 1024)),)
+
+    findings = _rules_for("fixture-const", ConstantCaptureRule())
+    assert [f.rule for f in findings] == ["perf-constant-capture"]
+    assert "closure-captured" in findings[0].message
+
+
+# ----------------------------------------------------------- protocol flow
+def _mod(name, source):
+    return Module(name, source, ast.parse(source))
+
+
+_FIXTURE_REMOTE = textwrap.dedent(
+    """
+    class FixtureRemote:
+        def compute(self):
+            if check(all, "phase", "init_runs", self.input):
+                self.out["phase"] = "next_run"
+            if check(all, "phase", "computation", self.input):
+                self.out["phase"] = "computation"
+            return self.out
+    """
+)
+
+
+def _analyze(local_src, remote_src=_FIXTURE_REMOTE, **kw):
+    analyzer = pflow.ProtocolFlowAnalyzer(
+        _mod("fx/local.py", textwrap.dedent(local_src)),
+        _mod("fx/remote.py", textwrap.dedent(remote_src)), **kw,
+    )
+    return analyzer.run()
+
+
+def test_proto_flow_unconsumed_wire_key_fires():
+    """Seeded bug: a site writes a wire key the aggregator never reads."""
+    findings = _analyze(
+        """
+        class FixtureLocal:
+            def compute(self):
+                if self.out["phase"] == "init_runs":
+                    self.out["orphan_key"] = 1
+                    self.out["phase"] = "next_run"
+                return self.out
+        """
+    )
+    unmatched = [f for f in findings if f.rule == "proto-flow-unmatched"]
+    assert len(unmatched) == 1 and "orphan_key" in unmatched[0].message
+
+
+def test_proto_flow_phase_mismatch_consumer_never_reachable():
+    """Seeded bug: the payload always arrives with a phase the consumer's
+    guard excludes."""
+    findings = _analyze(
+        """
+        class FixtureLocal:
+            def compute(self):
+                if self.out["phase"] == "init_runs":
+                    self.out["stranded"] = 1
+                    self.out["phase"] = "next_run"
+                return self.out
+        """,
+        """
+        class FixtureRemote:
+            def compute(self):
+                if check(all, "phase", "init_runs", self.input):
+                    self.out["phase"] = "next_run"
+                if check(all, "phase", "computation", self.input):
+                    use(self.input.get("stranded"))
+                return self.out
+        """,
+    )
+    mismatched = [f for f in findings if f.rule == "proto-flow-unmatched"]
+    assert len(mismatched) == 1
+    assert "can never see the payload" in mismatched[0].message
+
+
+def test_proto_flow_unhandled_phase_value():
+    """Seeded bug: local transitions to a phase remote never dispatches
+    on."""
+    findings = _analyze(
+        """
+        class FixtureLocal:
+            def compute(self):
+                if self.out["phase"] == "init_runs":
+                    self.out["phase"] = "pre_computation"
+                if self.out["phase"] == "next_run":
+                    pass
+                if self.out["phase"] == "computation":
+                    pass
+                return self.out
+        """
+    )
+    phase = [f for f in findings if f.rule == "proto-flow-phase"
+             and "site->aggregator" in f.message]
+    assert len(phase) == 1 and "pre_computation" in phase[0].message
+
+
+def test_proto_cache_read_before_write_fires():
+    """Seeded bug: INIT_RUNS hard-reads a key first written in
+    COMPUTATION — no PHASE_TRANSITIONS ordering runs the write first."""
+    findings = _analyze(
+        """
+        class FixtureLocal:
+            def compute(self):
+                if self.out["phase"] == "init_runs":
+                    roster = self.cache["roster"]
+                    self.out["phase"] = "next_run"
+                if self.out["phase"] == "computation":
+                    self.cache["roster"] = [1]
+                return self.out
+        """,
+        volatile_keys={"roster"},
+    )
+    rbw = [f for f in findings if f.rule == "proto-cache-read-before-write"]
+    assert len(rbw) == 1 and "roster" in rbw[0].message
+
+
+def test_proto_cache_read_after_earlier_phase_write_is_clean():
+    findings = _analyze(
+        """
+        class FixtureLocal:
+            def compute(self):
+                if self.out["phase"] == "init_runs":
+                    self.cache["roster"] = [1]
+                    self.out["phase"] = "next_run"
+                if self.out["phase"] == "computation":
+                    roster = self.cache["roster"]
+                return self.out
+        """,
+        volatile_keys={"roster"},
+    )
+    assert [f for f in findings
+            if f.rule == "proto-cache-read-before-write"] == []
+
+
+def test_proto_cache_never_read_and_volatile_fire():
+    findings = _analyze(
+        """
+        class FixtureLocal:
+            def compute(self):
+                if self.out["phase"] == "computation":
+                    self.cache["scratch_blob"] = 2
+                return self.out
+        """,
+        volatile_keys=set(),
+    )
+    rules = sorted(
+        f.rule for f in findings if f.rule.startswith("proto-cache-")
+    )
+    assert rules == ["proto-cache-never-read", "proto-cache-volatile"]
+
+
+def test_proto_cache_volatile_regression_dropped_sites():
+    """The real finding this rule surfaced: nodes/remote.py writes
+    cache['dropped_sites'] on the unguarded (every-invocation) path — it
+    must stay in _VOLATILE_CACHE_KEYS or the aggregator recompiles after
+    every site drop."""
+    local = Module.parse(
+        os.path.join(PACKAGE, "nodes", "local.py"), "nodes/local.py"
+    )
+    remote = Module.parse(
+        os.path.join(PACKAGE, "nodes", "remote.py"), "nodes/remote.py"
+    )
+    # with the volatile list as checked in: clean
+    clean = pflow.ProtocolFlowAnalyzer(local, remote).run()
+    assert [f for f in clean if f.rule == "proto-cache-volatile"] == []
+    # without dropped_sites (the pre-PR-8 list): the finding fires
+    pre_fix = pflow.ProtocolFlowAnalyzer(
+        local, remote,
+        volatile_keys=pflow.load_volatile_keys() - {"dropped_sites"},
+    ).run()
+    vol = [f for f in pre_fix if f.rule == "proto-cache-volatile"]
+    assert len(vol) == 1 and "dropped_sites" in vol[0].message
+
+
+def test_phase_transitions_contract_parses():
+    transitions = pflow.load_phase_transitions()
+    assert transitions["init_runs"] == ("next_run",)
+    assert "computation" in transitions["computation"]  # self-loop
+    assert transitions["success"] == ()
+
+
+# ------------------------------------------------------------ repo + CLI
+def test_repo_runs_tier3_clean_against_baseline():
+    """The ISSUE-8 gate: after the satellite fixes (donation on the
+    federation jits, staging casts, dropped_sites volatility) the whole
+    registry + phase model lints clean."""
+    from coinstac_dinunet_tpu.analysis import filter_baselined, load_baseline
+
+    findings = run_tier3()
+    new, _ = filter_baselined(findings, load_baseline(BASELINE))
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+def test_tier3_shares_entry_builds_with_deep(registry):
+    """--tier3 --deep must build each entry once: the tier-3 build cache
+    feeds run_deepcheck verbatim."""
+    calls = {"n": 0}
+
+    @register_entry_point("fixture-shared-build", "pkg/fx.py")
+    def _fx():
+        calls["n"] += 1
+
+        def step(x):
+            return x * 2
+
+        return jax.jit(step), (_sds((4,)),)
+
+    assert run_tier3(names=["fixture-shared-build"]) == []
+    builds = tier3_builds()
+    assert "fixture-shared-build" in builds and calls["n"] == 1
+    assert run_deepcheck(["fixture-shared-build"], builds=builds) == []
+    assert calls["n"] == 1  # reused, not rebuilt
+
+
+def test_tier3_build_failure_is_a_finding_not_a_crash(registry):
+    @register_entry_point("fixture-tier3-boom", "pkg/fx.py")
+    def _fx():
+        raise RuntimeError("constructor exploded")
+
+    findings = run_tier3(names=["fixture-tier3-boom"])
+    assert [f.rule for f in findings] == ["tier3-lower"]
+    assert "constructor exploded" in findings[0].message
+
+
+def test_cli_tier3_composes_with_github_format(registry, capsys, tmp_path):
+    """`dinulint --tier3 --format github` on a seeded donation bug emits a
+    ::error annotation and exits 1; the clean path exits 0."""
+    from coinstac_dinunet_tpu.analysis.__main__ import main
+
+    @register_entry_point("fixture-cli-donate", "pkg/fx.py")
+    def _fx():
+        def step(params, x):
+            return {k: v + 1 for k, v in params.items()}, x.sum()
+
+        return jax.jit(step), (
+            {"w": _sds((8, 8)), "b": _sds((8,))}, _sds((4,)),
+        )
+
+    src = tmp_path / "empty.py"
+    src.write_text("x = 1\n")
+    rc = main([str(src), "--tier3", "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "::error" in out and "perf-donation" in out
+
+
+def test_cli_tier3_rule_ids_require_the_tier(capsys, tmp_path):
+    """Selecting a tier-3 rule without --tier3 would silently report
+    nothing — it is a usage error instead (mirrors --deep-entries)."""
+    from coinstac_dinunet_tpu.analysis.__main__ import main
+
+    src = tmp_path / "empty.py"
+    src.write_text("x = 1\n")
+    rc = main([str(src), "--rules", "perf-donation"])
+    assert rc == 2
+    assert "requires --tier3" in capsys.readouterr().err
+
+
+def test_cli_rules_filter_keeps_tier3_error_channel(capsys, tmp_path,
+                                                    monkeypatch):
+    """--tier3 --rules must never filter out tier3-config/tier3-lower:
+    'the tier could not run' must not read as a clean exit 0."""
+    from coinstac_dinunet_tpu.analysis.__main__ import main
+
+    monkeypatch.setattr(deepcheck, "REQUIRED_DEVICES", 10_000)
+    src = tmp_path / "empty.py"
+    src.write_text("x = 1\n")
+    rc = main([str(src), "--tier3", "--rules", "perf-donation"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "tier3-config" in out
+
+
+def test_cli_proto_only_rules_skip_lowering(registry, capsys, tmp_path):
+    """--tier3 --rules proto-*: the pure-AST half runs without building or
+    lowering any registry entry."""
+    from coinstac_dinunet_tpu.analysis.__main__ import main
+
+    calls = {"n": 0}
+
+    @register_entry_point("fixture-should-not-build", "pkg/fx.py")
+    def _fx():
+        calls["n"] += 1
+
+        def step(x):
+            return x
+
+        return jax.jit(step), (_sds((4,)),)
+
+    src = tmp_path / "empty.py"
+    src.write_text("x = 1\n")
+    rc = main([str(src), "--tier3", "--rules", "proto-cache-volatile"])
+    capsys.readouterr()
+    assert rc == 0
+    assert calls["n"] == 0  # no entry was built
+
+
+def test_cli_write_baseline_without_tier3_keeps_tier3_entries(tmp_path,
+                                                             capsys):
+    """A static-only --write-baseline must carry accepted tier-3 entries
+    over instead of silently dropping them (mirrors the --deep guard)."""
+    import json
+
+    from coinstac_dinunet_tpu.analysis.__main__ import main
+
+    baseline = tmp_path / "bl.json"
+    baseline.write_text(json.dumps({"findings": [
+        {"rule": "perf-donation", "path": "pkg/fx.py",
+         "message": "accepted legacy finding", "count": 1},
+        {"rule": "proto-cache-volatile", "path": "pkg/fx.py",
+         "message": "accepted legacy finding", "count": 1},
+    ]}))
+    src = tmp_path / "empty.py"
+    src.write_text("x = 1\n")
+    rc = main([str(src), "--write-baseline", "--baseline", str(baseline)])
+    assert rc == 0
+    kept = json.loads(baseline.read_text())["findings"]
+    assert {e["rule"] for e in kept} == {
+        "perf-donation", "proto-cache-volatile",
+    }
