@@ -58,6 +58,28 @@ def build_parser():
                         "proto-cache-* phase-machine model (imports JAX "
                         "for the perf rules; composes with --deep, sharing "
                         "entry builds; see docs/ANALYSIS.md)")
+    p.add_argument("--model", action="store_true",
+                   help="also run the tier-4 federation protocol model "
+                        "checker: exhaustively explore N site machines + "
+                        "the aggregator + the relay channel under the "
+                        "chaos fault alphabet and check the ModelCheck "
+                        "global invariants; every proto-model-* violation "
+                        "ships a replayable chaos fault plan (pure "
+                        "Python, no JAX; see docs/ANALYSIS.md 'Tier 4')")
+    p.add_argument("--model-sites", type=int, default=None,
+                   help="site count of the explored model (default: "
+                        "ModelCheck.DEFAULT_SITES)")
+    p.add_argument("--model-rounds", type=int, default=None,
+                   help="federated rounds inside the exploration bound "
+                        "(default: ModelCheck.DEFAULT_ROUNDS)")
+    p.add_argument("--model-faults", type=int, default=None,
+                   help="fault budget per explored run — the "
+                        "simultaneous-fault tolerance level verified "
+                        "(default: ModelCheck.DEFAULT_FAULT_BUDGET)")
+    p.add_argument("--model-plans", default=None, metavar="DIR",
+                   help="write each proto-model-* counterexample as an "
+                        "executable resilience/chaos.py fault plan JSON "
+                        "into DIR (the CI model-check job uploads these)")
     return p
 
 
@@ -66,6 +88,7 @@ def build_parser():
 TIER_PREFIXES = {
     "deep": ("deep-",),
     "tier3": ("tier3-", "perf-", "proto-flow-", "proto-cache-"),
+    "model": ("proto-model-",),
 }
 
 
@@ -90,9 +113,13 @@ def main(argv=None):
         for r in sorted(rules, key=lambda r: r.id):
             print(f"{r.id}: {r.doc}")
         from .dataflow import TIER3_RULE_IDS
+        from .model_check import MODEL_RULE_IDS
 
         for rid in TIER3_RULE_IDS:
             print(f"{rid}: (tier-3, --tier3; see docs/ANALYSIS.md)")
+        for rid in MODEL_RULE_IDS:
+            print(f"{rid}: (tier-4 model checker, --model; "
+                  "see docs/ANALYSIS.md)")
         return 0
     if args.list_deep:
         from .deepcheck import list_entry_points
@@ -132,13 +159,37 @@ def main(argv=None):
               file=sys.stderr)
         return 2
 
+    if not args.model and any(
+        v is not None for v in (args.model_sites, args.model_rounds,
+                                args.model_faults, args.model_plans)
+    ):
+        print("--model-sites/--model-rounds/--model-faults/--model-plans "
+              "require --model", file=sys.stderr)
+        return 2
+    if args.model_sites is not None and args.model_sites < 1:
+        print(f"--model-sites {args.model_sites}: need at least 1 site",
+              file=sys.stderr)
+        return 2
+    if args.model_rounds is not None and args.model_rounds < 1:
+        print(f"--model-rounds {args.model_rounds}: need at least 1 "
+              "federated round (0/negative bounds make every invariant "
+              "vacuous or falsely violated)", file=sys.stderr)
+        return 2
+    if args.model_faults is not None and args.model_faults < 0:
+        print(f"--model-faults {args.model_faults}: the fault budget "
+              "cannot be negative (0 = fault-free runs only)",
+              file=sys.stderr)
+        return 2
     rule_ids = args.rules.split(",") if args.rules else None
     if rule_ids:
         from .dataflow import TIER3_RULE_IDS
+        from .model_check import MODEL_RULE_IDS
 
-        # tier-3 ids are selectable too (their findings are filtered after
-        # the tier runs below)
-        known = {r.id for r in rules} | set(TIER3_RULE_IDS)
+        # tier-3/tier-4 ids are selectable too (their findings are filtered
+        # after the tier runs below)
+        known = {r.id for r in rules} | set(TIER3_RULE_IDS) | set(
+            MODEL_RULE_IDS
+        )
         unknown = sorted(set(rule_ids) - known)
         if unknown:
             print(f"unknown rule id(s): {', '.join(unknown)} "
@@ -150,6 +201,11 @@ def main(argv=None):
             # nothing — a false clean for whoever is reproducing a finding
             print(f"--rules {','.join(tier3_selected)} requires --tier3 "
                   "(tier-3 rules only run under --tier3)", file=sys.stderr)
+            return 2
+        model_selected = sorted(set(rule_ids) & set(MODEL_RULE_IDS))
+        if model_selected and not args.model:
+            print(f"--rules {','.join(model_selected)} requires --model "
+                  "(tier-4 rules only run under --model)", file=sys.stderr)
             return 2
     if args.write_baseline and rule_ids:
         print("--write-baseline with --rules would drop every other rule's "
@@ -203,7 +259,54 @@ def main(argv=None):
 
             builds = tier3_builds()
         findings = findings + run_deepcheck(deep_names, builds=builds)
-    if args.deep or args.tier3:
+    if args.model:
+        # tier-4: pure-Python bounded exploration (no JAX import)
+        from .model_check import MODEL_RULE_IDS, ModelConfig, run_model_check
+
+        defaults = ModelConfig()
+        cfg = ModelConfig(
+            sites=(args.model_sites if args.model_sites is not None
+                   else defaults.sites),
+            rounds=(args.model_rounds if args.model_rounds is not None
+                    else defaults.rounds),
+            max_faults=(args.model_faults if args.model_faults is not None
+                        else defaults.max_faults),
+        )
+        result = run_model_check(config=cfg, plans_dir=args.model_plans)
+        model_findings = result.findings
+        wanted_model = set(rule_ids) if rule_ids else None
+        if wanted_model is not None:
+            # the tier's own error channel must survive any filter
+            keep = wanted_model | {"proto-model-config"}
+            model_findings = [f for f in model_findings if f.rule in keep]
+        findings = findings + model_findings
+        if args.tier3:
+            # path-sensitive promotion: a syntactic read-before-write whose
+            # read site the exhaustive exploration EXERCISED without ever
+            # realizing a violation is a reachability false positive —
+            # retire it.  Reads the bound never exercised are left alone
+            # (coverage-based retirement would be unsound for them).
+            confirmed = set(
+                tuple(c) for c in result.report.get("confirmed_cache", [])
+            )
+            exercised = set(
+                tuple(c) for c in result.report.get("exercised_reads", [])
+            )
+            retired = [
+                f for f in findings
+                if f.rule == "proto-cache-read-before-write"
+                and (f.path, f.line) in exercised
+                and (f.path, f.line) not in confirmed
+            ]
+            if retired:
+                findings = [f for f in findings if f not in retired]
+                print(
+                    f"dinulint --model: retired {len(retired)} "
+                    "proto-cache-read-before-write finding(s) whose read "
+                    "site the explored model exercised without ever "
+                    "violating", file=sys.stderr,
+                )
+    if args.deep or args.tier3 or args.model:
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     baseline_path = args.baseline
@@ -224,7 +327,8 @@ def main(argv=None):
             return 2
         extra = []
         missing = [t for t, ran in (("deep", args.deep),
-                                    ("tier3", args.tier3)) if not ran]
+                                    ("tier3", args.tier3),
+                                    ("model", args.model)) if not ran]
         if missing and os.path.exists(out):
             # a tier that didn't run contributes nothing to this refresh —
             # carry its accepted entries over instead of silently dropping

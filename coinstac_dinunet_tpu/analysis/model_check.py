@@ -1,0 +1,815 @@
+"""proto-model-*: the tier-4 federation protocol model checker.
+
+Tier-3 audits each node's phase machine in isolation and syntactically.
+This pass composes the whole federation — N site machines + the aggregator
++ the engine's relay channel, all lifted from the AST by
+:mod:`~.proto_ir` — and **exhaustively explores** the bounded execution
+space under the :mod:`~..resilience.chaos` fault vocabulary:
+
+- per-site invoke faults: ``crash``, ``hang`` (retry exhaustion),
+  ``stale`` (a delayed duplicate of the previous site message delivered in
+  place of the fresh one), ``reappear`` (death now, stale redelivery one
+  round later), ``truncate_payload`` / ``corrupt_payload`` (detectable
+  payload damage on the site→aggregator leg);
+- per-site relay faults on the broadcast leg: ``drop_relay`` /
+  ``duplicate_delivery``, each targeting the payload file or its
+  ``.wire_manifest.json`` sidecar (the only witness that an intact,
+  self-validating payload is STALE).
+
+Exploration is BFS over hashed global states with a configurable bound
+(default: :class:`~..config.keys.ModelCheck` — 2 sites × 3 federated
+rounds × the full alphabet at fault budget 1), across both quorum
+configurations (all-site lockstep and ``site_quorum=1``) and both
+NEXT_RUN dispatch branches (pretrain on/off).  The checked invariants are
+the :class:`~..config.keys.ModelCheck` vocabulary: deadlock-freedom,
+no lifecycle reset, quorum soundness, exactly-once gradient contributions
+and broadcast updates, single-transient-fault recoverability, and the
+path-sensitive promotions of the tier-3 cache rules.
+
+Every violation is emitted as a ``proto-model-*`` finding through the
+same baseline/ratchet machinery as tiers 1–3 AND as an executable chaos
+fault plan (``--model-plans``) whose replay through a real
+:class:`~..engine.InProcessEngine` reproduces the counterexample
+(``tests/test_model_check.py``).
+
+Deterministic and pure-Python: no JAX, no clocks, no randomness — the
+same tree, bound and findings on every run.
+"""
+import collections
+import dataclasses
+import itertools
+import json
+import os
+
+from ..config.keys import ModelCheck
+from .core import Finding
+from .proto_ir import build_protocol_ir
+
+#: the fault alphabet the explorer schedules (ISSUE 9 bound; ``slow`` is
+#: protocol-invisible in a lockstep engine and is deliberately absent)
+FAULT_ALPHABET = (
+    "crash", "hang", "stale", "reappear",
+    "truncate_payload", "corrupt_payload",
+    "drop_relay", "duplicate_delivery",
+)
+
+#: broadcast-channel components a relay fault can target
+_COMPONENTS = ("payload", "manifest")
+
+MODEL_RULE_IDS = (
+    ModelCheck.CACHE, ModelCheck.CONFIG, ModelCheck.DEADLOCK,
+    ModelCheck.LOST_CONTRIBUTION, ModelCheck.LOST_UPDATE,
+    ModelCheck.PHASE_RESET, ModelCheck.QUORUM,
+    ModelCheck.STALE_CONTRIBUTION, ModelCheck.UNRECOVERABLE,
+    ModelCheck.VOLATILE, ModelCheck.WIRE,
+)
+
+#: hard ceiling on explored states per scenario — a runaway bound must
+#: degrade to a typed finding, never a hung CI job
+MAX_STATES = 250_000
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Exploration bound (defaults = the CI gate's contract)."""
+
+    sites: int = ModelCheck.DEFAULT_SITES
+    rounds: int = ModelCheck.DEFAULT_ROUNDS
+    max_faults: int = ModelCheck.DEFAULT_FAULT_BUDGET
+    kinds: tuple = FAULT_ALPHABET
+    quorums: tuple = (None, 1)
+    pretrain: tuple = (False, True)
+
+    @property
+    def engine_rounds(self):
+        """Engine invocation bound: INIT round + the federated rounds."""
+        return int(self.rounds) + 1
+
+
+@dataclasses.dataclass
+class ModelResult:
+    findings: list
+    plans: list          # one plan dict per finding, same order
+    report: dict
+
+
+# --------------------------------------------------------------- state model
+# All state is plain hashable tuples.
+#
+# site:   (alive, redeliver_rnd, applied_tag, cache_keys, any_write,
+#          had_comp, last_out)      last_out = (phase, keys, contrib, echo_ok)
+# chan:   (payload_tag, manifest_tag, repairs)   repairs ⊆ {components}
+# remote: (cache_keys, any_write, dropped)
+# bcast:  (phase, keys, update_tag)
+# state:  (rnd, budget, sites, chans, remote, bcast, reduces)
+
+_FRESH_SITE = (True, 0, 0, frozenset(), False, False, None)
+_FRESH_CHAN = (0, 0, frozenset())
+
+
+def _initial_state(config):
+    n = int(config.sites)
+    return (
+        1, int(config.max_faults),
+        tuple(_FRESH_SITE for _ in range(n)),
+        tuple(_FRESH_CHAN for _ in range(n)),
+        (frozenset(), False, frozenset()),
+        None,
+        0,
+    )
+
+
+class _Trace:
+    """Immutable fault-schedule trace riding alongside a state."""
+
+    __slots__ = ("entries",)
+
+    def __init__(self, entries=()):
+        self.entries = tuple(entries)
+
+    def extend(self, rnd, actions):
+        return _Trace(
+            self.entries + tuple((rnd,) + a for a in actions)
+        )
+
+    def describe(self):
+        if not self.entries:
+            return "no faults"
+        parts = []
+        for e in self.entries:
+            rnd, kind, site = e[0], e[1], e[2]
+            comp = e[3] if len(e) > 3 else None
+            suffix = f"/{comp}" if comp else ""
+            parts.append(f"{kind}@r{rnd}/site_{site}{suffix}")
+        return ", ".join(parts)
+
+
+def _plan_faults(trace, avg_file, manifest_file):
+    """Trace → resilience/chaos.py fault-plan entries."""
+    faults = []
+    for e in trace.entries:
+        rnd, kind, site = e[0], e[1], e[2]
+        comp = e[3] if len(e) > 3 else None
+        entry = {"kind": kind, "round": int(rnd), "site": f"site_{site}"}
+        if kind in ("truncate_payload", "corrupt_payload"):
+            entry["file"] = "grads.npy"
+        elif comp is not None:
+            entry["file"] = manifest_file if comp == "manifest" else avg_file
+        faults.append(entry)
+    return faults
+
+
+# ---------------------------------------------------------------- IR helpers
+def _block_events(node_ir, phases):
+    """Ordered executed blocks for an invocation: the unguarded block plus
+    each phase in ``phases`` that has a block."""
+    blocks = []
+    if None in node_ir.blocks:
+        blocks.append(node_ir.blocks[None])
+    for p in phases:
+        b = node_ir.blocks.get(p)
+        if b is not None:
+            blocks.append(b)
+    return blocks
+
+
+#: within-invocation dispatch rewrites the model follows (phase → the
+#: successors reachable without a mode barrier; NEXT_RUN_WAITING/TEST are
+#: gated on the test mode, which a bounded steady-state run never reaches)
+_CHAIN_GATE = {
+    "next_run": ("computation", "pre_computation"),
+    "pre_computation": ("computation",),
+}
+
+
+def _local_dispatch(node_ir, incoming, pretrain):
+    """(executed phases, out_phase) of a site invocation."""
+    if incoming not in node_ir.tested_phases:
+        # unhandled broadcast phase: only unguarded code runs; phase echoes
+        return [], incoming
+    executed, cur = [], incoming
+    for _ in range(4):
+        block = node_ir.blocks.get(cur)
+        if cur in executed or block is None:
+            break
+        executed.append(cur)
+        allowed = [
+            p for p in block.outgoing if p in _CHAIN_GATE.get(cur, ())
+        ]
+        if not allowed:
+            break
+        if cur == "next_run":
+            nxt = ("pre_computation"
+                   if pretrain and "pre_computation" in allowed
+                   else "computation")
+            if nxt not in allowed and allowed:
+                nxt = allowed[0]
+        else:
+            nxt = allowed[0]
+        # PRE_COMPUTATION is an *output* phase (the next round dispatches
+        # it); COMPUTATION chains into its block within this invocation
+        if nxt == "pre_computation":
+            return executed, "pre_computation"
+        cur = nxt
+    return executed, cur
+
+
+# ------------------------------------------------------------------ explorer
+class _Explorer:
+    def __init__(self, ir, config):
+        self.ir = ir
+        self.config = config
+        self.findings = {}       # (rule, path, line) -> (Finding, plan)
+        self.report = {
+            "produced": set(), "consumed": set(),
+            "states": 0, "runs": 0,
+            "confirmed_cache": set(),
+            "exercised_reads": set(),
+            "phases_covered": set(),
+            "terminal_loud": 0, "terminal_success": 0,
+        }
+
+    # ------------------------------------------------------------- findings
+    def _emit(self, rule, anchor, message, scenario, trace, invariant):
+        path, line = anchor
+        key = (rule, path, line)
+        if key in self.findings:
+            return
+        plan = {
+            "comment": (
+                "dinulint tier-4 counterexample — replay with "
+                "InProcessEngine(..., fault_plan=<this file>) "
+                "(docs/ANALYSIS.md 'Tier 4')"
+            ),
+            "rule": rule,
+            "invariant": invariant,
+            "scenario": {
+                "n_sites": self.config.sites,
+                "site_quorum": scenario[0],
+                "pretrain": bool(scenario[1]),
+                "engine_rounds": self.config.engine_rounds,
+            },
+            "faults": _plan_faults(trace, "avg_grads.npy",
+                                   ".wire_manifest.json"),
+        }
+        quorum = scenario[0]
+        msg = (
+            f"{message} — counterexample: site_quorum={quorum}, "
+            f"pretrain={bool(scenario[1])}, faults=[{trace.describe()}] "
+            f"(bound: {self.config.sites} sites x {self.config.rounds} "
+            f"rounds, budget {self.config.max_faults}); replayable chaos "
+            "plan via --model-plans"
+        )
+        self.findings[key] = (
+            Finding(rule=rule, path=path, line=line, col=0, message=msg),
+            plan,
+        )
+
+    def _anchor(self, name, default_side=None):
+        a = self.ir.facts.anchors.get(name)
+        if a:
+            return a
+        side = default_side or self.ir.remote
+        return (side.path, 1)
+
+    def _remote_phase_anchor(self):
+        b = self.ir.remote.blocks.get(None)
+        if b:
+            for e in b.produces:
+                if e.key == "phase":
+                    return (self.ir.remote.path, e.line)
+        return (self.ir.remote.path, 1)
+
+    # ------------------------------------------------------------ execution
+    def _exec_events(self, node_ir, state_site, executed_phases, incoming,
+                     msg_keys, steady, scenario, trace):
+        """Run a node invocation's IR events: cache lifecycle checks, wire
+        bookkeeping.  Returns (produced keys, new cache, new any_write)."""
+        alive, redeliver, applied, cache, any_w, had_comp, last = state_site
+        produced = set()
+        writers = node_ir.static_cache_writers()
+        cache = set(cache)
+        blocks = _block_events(node_ir, executed_phases)
+        for block in blocks:
+            self.report["phases_covered"].add((node_ir.role, block.phase))
+            block_writes = {
+                e.key for e in block.cache_writes if e.key != "*"
+            }
+            block_wild = any(e.key == "*" for e in block.cache_writes)
+            for e in block.cache_reads:
+                if e.kind != "hard" or e.key.startswith("_"):
+                    continue
+                if e.key not in writers:
+                    continue  # written outside this node: origin unknown
+                self.report["exercised_reads"].add((node_ir.path, e.line))
+                if any_w or block_wild or e.key in cache or (
+                    e.key in block_writes
+                ):
+                    continue
+                self.report["confirmed_cache"].add((node_ir.path, e.line))
+                self._emit(
+                    ModelCheck.CACHE, (node_ir.path, e.line),
+                    f"cache['{e.key}'] is read in the "
+                    f"{block.phase or 'unguarded'} block before any write "
+                    "of it has executed on this explored path "
+                    "(path-sensitive promotion of "
+                    "proto-cache-read-before-write)",
+                    scenario, trace, "cache write-before-read",
+                )
+            for e in block.cache_writes:
+                if e.key == "*":
+                    any_w = True
+                    continue
+                cache.add(e.key)
+                if steady and not e.key.startswith("_") and (
+                    e.key not in self.ir.volatile
+                ):
+                    self._emit(
+                        ModelCheck.VOLATILE, (node_ir.path, e.line),
+                        f"cache['{e.key}'] is written on an executed "
+                        "steady-state round (a COMPUTATION re-invocation) "
+                        "but is not in _VOLATILE_CACHE_KEYS — the shared "
+                        "compiled-step bucket key churns and the round "
+                        "recompiles",
+                        scenario, trace, "volatile-key hygiene",
+                    )
+            for e in block.produces:
+                produced.add(e.key)
+                self.report["produced"].add((node_ir.role, e.key, e.line))
+            for e in block.consumes:
+                if e.key in msg_keys:
+                    self.report["consumed"].add((node_ir.role, e.key))
+        return produced, frozenset(cache), any_w
+
+    def _site_round(self, i, site, chan, bcast, faults, scenario, trace,
+                    rnd, quorum):
+        """One site's turn.  Returns (site', chan', out or None,
+        loud or None, violations already emitted)."""
+        alive, redeliver, applied, cache, any_w, had_comp, last = site
+        my_faults = {a[0] for a in faults if a[1] == i}
+        if not alive:
+            return site, chan, None, None
+        if my_faults & {"crash", "hang", "reappear"}:
+            if quorum is None:
+                return site, chan, None, "site failure without quorum"
+            redeliver_rnd = rnd + 1 if "reappear" in my_faults else 0
+            return ((False, redeliver_rnd, applied, cache, any_w, had_comp,
+                     last), chan, None, None)
+        if "stale" in my_faults and last is not None:
+            # delayed duplicate: previous output redelivered, cache frozen
+            phase, keys, contrib, _ = last
+            return site, chan, (phase, keys, contrib, False), None
+
+        incoming = bcast[0] if bcast else "init_runs"
+        executed, out_phase = _local_dispatch(
+            self.ir.local, incoming, scenario[1]
+        )
+        msg_keys = bcast[1] if bcast else frozenset()
+        steady = had_comp and incoming == "computation"
+        produced, cache, any_w = self._exec_events(
+            self.ir.local, site, executed, incoming, msg_keys, steady,
+            scenario, trace,
+        )
+
+        # broadcast update application (learner.step semantics)
+        update_tag = bcast[2] if bcast else 0
+        if update_tag and "update" in msg_keys and (
+            "update" in {
+                e.key for b in _block_events(self.ir.local, executed)
+                for e in b.consumes
+            }
+        ):
+            payload, manifest, repairs = chan
+            detected = (payload != manifest) or payload == 0
+            if detected:
+                healed = set()
+                for comp in repairs:
+                    if comp == "payload" or (
+                        comp == "manifest"
+                        and self.ir.facts.heal_bridges_manifest
+                    ):
+                        healed.add(comp)
+                if "payload" in healed:
+                    payload = update_tag
+                if "manifest" in healed:
+                    manifest = update_tag
+                repairs = frozenset(repairs - healed)
+                chan = (payload, manifest, repairs)
+                if (payload != manifest) or payload == 0:
+                    # retries exhaust: the transient killed the node
+                    self._emit(
+                        ModelCheck.UNRECOVERABLE, self._anchor("heal"),
+                        "a single transient relay fault on the broadcast "
+                        "leg exhausts the wire retries and kills the "
+                        "reading site: the chaos repair is registered on "
+                        "the damaged file but the failing load is on the "
+                        "payload whose manifest it is — the heal never "
+                        "fires (the engine relay clobber window)",
+                        scenario, trace, "single-fault recoverability",
+                    )
+                    if quorum is None:
+                        return site, chan, None, "wire failure without quorum"
+                    return ((False, 0, applied, cache, any_w, had_comp,
+                             last), chan, None, None)
+            if payload < update_tag:
+                self._emit(
+                    ModelCheck.LOST_UPDATE, self._anchor(
+                        "relay_duplicate", self.ir.local
+                    ),
+                    f"a stale broadcast payload (round {payload}) is "
+                    f"applied in place of the fresh round-{update_tag} "
+                    "update and passes every integrity check (payload and "
+                    "manifest are both stale and mutually consistent) — "
+                    "the update is silently lost on this site",
+                    scenario, trace, "exactly-once update application",
+                )
+            applied = payload
+            chan = (payload, manifest, chan[2])
+
+        had_comp = had_comp or "computation" in executed
+        contrib = rnd if "reduce" in produced else 0
+        out = (out_phase, frozenset(produced), contrib, True)
+        site = (alive, redeliver, applied, cache, any_w, had_comp, out)
+        return site, chan, out, None
+
+    def _remote_round(self, state, site_outs, stale_flags, scenario, trace):
+        """The aggregator's turn: quorum, lockstep guards, dispatch,
+        reduce bookkeeping.  Returns (remote', bcast or None, loud,
+        reduced)."""
+        rnd, budget, sites, chans, remote, bcast, reduces = state
+        quorum = scenario[0]
+        r_cache, r_any, dropped = remote
+        facts = self.ir.facts
+        roster = set(range(self.config.sites))
+
+        filtered = dict(site_outs)
+        if facts.quorum_checked:
+            returned = dropped & set(site_outs)
+            if returned and facts.quorum_filters_reappeared:
+                for i in returned:
+                    filtered.pop(i, None)
+            missing = (roster - set(site_outs)) | dropped
+            new_drops = missing - dropped
+            if new_drops:
+                if not quorum:
+                    return remote, None, "sites dropped without policy", False
+                if len(filtered) < max(int(quorum), 1):
+                    return remote, None, "quorum unmet", False
+                dropped = frozenset(dropped | new_drops)
+        reducer_input = (
+            filtered if (facts.quorum_checked
+                         and facts.quorum_before_reduce_input)
+            else dict(site_outs)
+        )
+
+        phases = {out[0] for out in filtered.values()}
+        if len(phases) > 1:
+            if facts.lockstep_phase_guard:
+                return remote, None, "mixed phases refused", False
+            self._emit(
+                ModelCheck.PHASE_RESET, self._remote_phase_anchor(),
+                "a mixed-phase round (a stale site message next to fresh "
+                "ones) falls through every dispatch branch and the "
+                "aggregator echoes the INIT_RUNS default — every site "
+                "re-initializes and the run silently resets mid-training",
+                scenario, trace, "no lifecycle reset",
+            )
+            return remote, None, None, False
+        phase = next(iter(phases)) if phases else "init_runs"
+        # stale same-phase message: only the echoed round stamp catches it
+        stale_in = {i for i in filtered if stale_flags.get(i)}
+        if stale_in and facts.round_lockstep_guard:
+            return remote, None, "stale round echo refused", False
+
+        if phase not in self.ir.remote.tested_phases:
+            fallthrough = self.ir.remote.phase_fallthrough
+            if rnd > 1 and fallthrough == "init_runs":
+                self._emit(
+                    ModelCheck.PHASE_RESET, self._remote_phase_anchor(),
+                    f"phase '{phase}' reaches the aggregator but its "
+                    "dispatch never tests it: the round falls through and "
+                    "the echoed INIT_RUNS default silently resets the run",
+                    scenario, trace, "no lifecycle reset",
+                )
+                return remote, None, None, False
+            executed = []
+        else:
+            executed = [phase]
+
+        msg_keys = set()
+        for out in filtered.values():
+            msg_keys |= out[1]
+        shell = (True, 0, 0, r_cache, r_any, False, None)
+        steady = phase == "computation" and reduces > 0
+        produced, r_cache, r_any = self._exec_events(
+            self.ir.remote, shell, executed, phase, msg_keys, steady,
+            scenario, trace,
+        )
+
+        reduced = False
+        if phase == "computation" and filtered and all(
+            "reduce" in out[1] for out in filtered.values()
+        ):
+            reduced = True
+            if facts.quorum_checked and quorum:
+                pass  # unmet quorum already aborted above
+            elif not facts.quorum_checked and len(site_outs) < len(roster):
+                self._emit(
+                    ModelCheck.QUORUM, self._anchor("reduce_input"),
+                    f"the reduce proceeds with {len(site_outs)} of "
+                    f"{len(roster)} sites and no quorum policy was ever "
+                    "evaluated (the quorum check is missing from the "
+                    "aggregator's round path)",
+                    scenario, trace, "quorum soundness",
+                )
+            for i, out in sorted(reducer_input.items()):
+                contrib = out[2]
+                if "reduce" not in out[1]:
+                    continue
+                if contrib and contrib < rnd:
+                    if i in dropped:
+                        anchor, why = self._anchor("reduce_input"), (
+                            "the reducer's input snapshot is taken before "
+                            "the quorum filtering runs, so a dropped "
+                            "site's redelivered output is silently "
+                            "double-counted into the global average"
+                        )
+                    else:
+                        anchor, why = self._anchor(
+                            "lockstep", self.ir.remote
+                        ), (
+                            "a delayed duplicate of an earlier message "
+                            "from a live site carries the same phase as a "
+                            "fresh one — only a round stamp echoed on the "
+                            "wire (LocalWire.ROUND) can reject it"
+                        )
+                    self._emit(
+                        ModelCheck.STALE_CONTRIBUTION, anchor,
+                        f"the reduce consumes a stale round-{contrib} "
+                        f"gradient payload from site_{i} in round {rnd}: "
+                        + why,
+                        scenario, trace, "exactly-once contributions",
+                    )
+            for i, out in sorted(filtered.items()):
+                if out[2] == rnd and "reduce" in out[1] and (
+                    i not in reducer_input
+                ):
+                    self._emit(
+                        ModelCheck.LOST_CONTRIBUTION,
+                        self._anchor("reduce_input"),
+                        f"site_{i}'s fresh round-{rnd} gradient "
+                        "contribution is dropped from the reduce while "
+                        "the site is alive and participating",
+                        scenario, trace, "exactly-once contributions",
+                    )
+
+        # broadcast phase per the executed dispatch
+        if executed:
+            block = self.ir.remote.blocks.get(phase)
+            outgoing = [p for p in (block.outgoing if block else ())]
+            out_phase = outgoing[0] if len(outgoing) >= 1 else phase
+            if phase == "computation":
+                out_phase = "computation"
+        else:
+            out_phase = phase  # covered: non-reset fallthrough (round 1)
+        update_tag = rnd if reduced else (bcast[2] if bcast else 0)
+        keys = frozenset(produced)
+        remote = (r_cache, r_any, dropped)
+        return remote, (out_phase, keys, update_tag, reduced), None, reduced
+
+    # ---------------------------------------------------------------- rounds
+    def _round_actions(self, state):
+        """Every single-fault action available this round, sorted."""
+        rnd, budget, sites, chans, remote, bcast, reduces = state
+        if budget <= 0:
+            return []
+        actions = []
+        for i, site in enumerate(sites):
+            if not site[0]:
+                continue
+            for kind in self.config.kinds:
+                if kind in ("drop_relay", "duplicate_delivery"):
+                    for comp in _COMPONENTS:
+                        actions.append((kind, i, comp))
+                elif kind == "stale":
+                    if site[6] is not None:
+                        actions.append((kind, i))
+                else:
+                    actions.append((kind, i))
+        return sorted(actions)
+
+    def _step(self, state, actions, scenario, trace):
+        """Execute one engine round under ``actions``.  Returns the new
+        state, or None when the trace terminated (loudly or at bound)."""
+        rnd, budget, sites, chans, remote, bcast, reduces = state
+        quorum = scenario[0]
+        trace = trace.extend(rnd, actions)
+        budget -= len(actions)
+
+        site_outs, stale_flags = {}, {}
+        new_sites, new_chans = list(sites), list(chans)
+        for i in range(len(sites)):
+            site, chan, out, loud = self._site_round(
+                i, sites[i], chans[i], bcast, actions, scenario, trace,
+                rnd, quorum,
+            )
+            new_sites[i], new_chans[i] = site, chan
+            if loud:
+                self.report["terminal_loud"] += 1
+                return None
+            if out is not None:
+                site_outs[i] = out
+                stale_flags[i] = not out[3]
+
+        # reappear redeliveries (death fired one round earlier)
+        for i, site in enumerate(new_sites):
+            if not site[0] and site[1] == rnd and site[6] is not None:
+                phase, keys, contrib, _ = site[6]
+                site_outs[i] = (phase, keys, contrib, False)
+                stale_flags[i] = True
+                new_sites[i] = site[:1] + (0,) + site[2:]
+
+        if not site_outs:
+            self.report["terminal_loud"] += 1
+            return None
+
+        remote, new_bcast, loud, reduced = self._remote_round(
+            (rnd, budget, tuple(new_sites), tuple(new_chans), remote,
+             bcast, reduces),
+            site_outs, stale_flags, scenario, trace,
+        )
+        if loud:
+            self.report["terminal_loud"] += 1
+            return None
+        if new_bcast is None:
+            # a violating fall-through already emitted; stop the trace
+            return None
+        out_phase, keys, update_tag, reduced = new_bcast
+        if out_phase == "success":
+            self.report["terminal_success"] += 1
+            return None
+
+        # relay the broadcast files (the avg payload + its manifest)
+        for i, site in enumerate(new_sites):
+            if not site[0]:
+                continue
+            payload, manifest, repairs = new_chans[i]
+            if reduced:
+                # a fresh relay recopies EVERY file, so damage from earlier
+                # rounds that nothing loaded in between heals naturally;
+                # only faults fired THIS round leave stale components
+                fresh = rnd
+                repairs = {
+                    a[2] for a in actions
+                    if a[0] in ("drop_relay", "duplicate_delivery")
+                    and a[1] == i
+                }
+                if "payload" not in repairs:
+                    payload = fresh
+                if "manifest" not in repairs:
+                    manifest = fresh
+                new_chans[i] = (payload, manifest, frozenset(repairs))
+        return (
+            rnd + 1, budget, tuple(new_sites), tuple(new_chans), remote,
+            (out_phase, keys, update_tag), reduces + (1 if reduced else 0),
+        )
+
+    # ------------------------------------------------------------ exploration
+    def explore(self):
+        for quorum in self.config.quorums:
+            for pretrain in self.config.pretrain:
+                self._explore_scenario((quorum, pretrain))
+        findings = [f for f, _ in self.findings.values()]
+        plans = [p for _, p in self.findings.values()]
+        order = sorted(
+            range(len(findings)),
+            key=lambda ix: (findings[ix].path, findings[ix].line,
+                            findings[ix].rule),
+        )
+        return [findings[ix] for ix in order], [plans[ix] for ix in order]
+
+    def _explore_scenario(self, scenario):
+        frontier = collections.deque([(_initial_state(self.config), _Trace())])
+        visited = set()
+        bound = self.config.engine_rounds
+        while frontier:
+            state, trace = frontier.popleft()
+            if state in visited:
+                continue
+            visited.add(state)
+            self.report["states"] += 1
+            if self.report["states"] > MAX_STATES:
+                self._emit(
+                    ModelCheck.CONFIG, (self.ir.remote.path, 1),
+                    f"state-space ceiling ({MAX_STATES}) exceeded — shrink "
+                    "--model-sites/--model-rounds/--model-faults",
+                    scenario, trace, "bounded exploration",
+                )
+                return
+            rnd = state[0]
+            if rnd > bound:
+                self.report["runs"] += 1
+                if state[6] == 0:
+                    self._emit(
+                        ModelCheck.DEADLOCK, self._remote_phase_anchor(),
+                        f"the bounded run finishes {bound} engine rounds "
+                        "with ZERO reduces and no loud failure — the "
+                        "federation is silently wedged (a dispatch "
+                        "transition is missing or a barrier never "
+                        "releases)",
+                        scenario, trace, "deadlock freedom",
+                    )
+                continue
+            singles = self._round_actions(state)
+            subsets = [()]
+            # the whole remaining budget may be spent in ONE round: the
+            # --model-faults contract is the simultaneous-fault tolerance
+            # level verified, so no silent per-round cap
+            for k in range(1, state[1] + 1):
+                subsets.extend(itertools.combinations(singles, k))
+            for actions in subsets:
+                nxt = self._step(state, actions, scenario, trace)
+                if nxt is not None:
+                    frontier.append(
+                        (nxt, trace.extend(state[0], actions))
+                    )
+
+
+# ------------------------------------------------------------- wire property
+def _wire_findings(ir, explorer, config):
+    """Every wire key produced on an explored path must be consumed (with
+    the payload actually present) on some explored path of the peer."""
+    findings, plans = [], []
+    consumed = {(role, key) for role, key in explorer.report["consumed"]}
+    peers = {"local": "remote", "remote": "local"}
+    seen = set()
+    for role, key, line in sorted(explorer.report["produced"]):
+        if key in ("phase",) or (role, key) in seen:
+            continue
+        seen.add((role, key))
+        if (peers[role], key) not in consumed:
+            node = ir.local if role == "local" else ir.remote
+            findings.append(Finding(
+                rule=ModelCheck.WIRE, path=node.path, line=line, col=0,
+                message=(
+                    f"wire key '{key}' is produced on an explored path "
+                    f"({role} side) but the peer never consumes it on ANY "
+                    f"reachable execution of the bounded model "
+                    f"({config.sites} sites x {config.rounds} rounds) — "
+                    "the payload is computed and shipped into a round "
+                    "that cannot see it"
+                ),
+            ))
+            plans.append({
+                "rule": ModelCheck.WIRE, "invariant": "wire reachability",
+                "scenario": {"n_sites": config.sites,
+                             "engine_rounds": config.engine_rounds},
+                "faults": [],
+            })
+    return findings, plans
+
+
+# --------------------------------------------------------------- entry point
+def run_model_check(config=None, ir=None, plans_dir=None):
+    """Explore the bounded model; returns a :class:`ModelResult` whose
+    findings flow through the same baseline machinery as tiers 1–3."""
+    config = config or ModelConfig()
+    try:
+        if ir is None:
+            ir = build_protocol_ir()
+    except (OSError, SyntaxError, ValueError) as exc:
+        f = Finding(
+            rule=ModelCheck.CONFIG, path="coinstac_dinunet_tpu", line=1,
+            col=0, message=f"protocol IR extraction failed: {exc}",
+        )
+        return ModelResult([f], [None], {})
+    explorer = _Explorer(ir, config)
+    findings, plans = explorer.explore()
+    wf, wp = _wire_findings(ir, explorer, config)
+    findings, plans = findings + wf, plans + wp
+    order = sorted(
+        range(len(findings)),
+        key=lambda ix: (findings[ix].path, findings[ix].line,
+                        findings[ix].rule),
+    )
+    findings = [findings[ix] for ix in order]
+    plans = [plans[ix] for ix in order]
+    if plans_dir:
+        os.makedirs(plans_dir, exist_ok=True)
+        for n, (f, plan) in enumerate(zip(findings, plans)):
+            if not plan:
+                continue
+            name = f"{f.rule}-{n:02d}.json"
+            with open(os.path.join(plans_dir, name), "w",
+                      encoding="utf-8") as fh:
+                json.dump(plan, fh, indent=2, sort_keys=True)
+                fh.write("\n")
+    report = dict(explorer.report)
+    report["produced"] = sorted(report["produced"])
+    report["consumed"] = sorted(report["consumed"])
+    report["confirmed_cache"] = sorted(report["confirmed_cache"])
+    report["exercised_reads"] = sorted(report["exercised_reads"])
+    report["phases_covered"] = sorted(
+        (r, p or "<unguarded>") for r, p in report["phases_covered"]
+    )
+    return ModelResult(findings, plans, report)

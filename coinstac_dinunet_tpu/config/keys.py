@@ -86,6 +86,12 @@ class LocalWire(_StrEnum):
     DAD_REST_FILE = "dad_rest_file"
     # per-site health summary (watchdog anomalies) — see telemetry/watchdog.py
     HEALTH = "health"
+    # the aggregator's round counter echoed back verbatim: a delayed
+    # duplicate of an earlier site message echoes a STALE counter, which is
+    # the only way the aggregator can tell it from a fresh same-phase
+    # message (``COINNRemote._check_lockstep_phases``; the
+    # ``proto-model-stale-contribution`` invariant of ``dinulint --model``)
+    ROUND = "wire_round"
 
 
 class RemoteWire(_StrEnum):
@@ -108,6 +114,10 @@ class RemoteWire(_StrEnum):
     DAD_REST_FILE = "dad_rest_file"
     # federation-wide health rollup (aggregator → sites)
     HEALTH = "health"
+    # monotonic aggregator round counter (see :attr:`LocalWire.ROUND`):
+    # incremented every aggregator invocation, broadcast to every site,
+    # and required to come back uniform — lockstep-at-most-once delivery
+    ROUND = "wire_round"
 
 
 class MeshAxis:
@@ -377,6 +387,56 @@ PHASE_TRANSITIONS = {
     Phase.NEXT_RUN_WAITING: (Phase.NEXT_RUN, Phase.SUCCESS),
     Phase.SUCCESS: (),
 }
+
+
+class ModelCheck:
+    """Tier-4 model-checker contract (``dinulint --model``,
+    :mod:`coinstac_dinunet_tpu.analysis.model_check`).
+
+    Plain constants, mirroring :class:`Retry`: the default exploration
+    bound (exhaustive within it, deterministic, CI-budgeted) and the
+    global-invariant vocabulary the composed N-site × aggregator × relay
+    model is checked against.  Each invariant id is one ``proto-model-*``
+    rule; every violation ships a replayable
+    :mod:`~coinstac_dinunet_tpu.resilience.chaos` fault plan
+    (docs/ANALYSIS.md "Tier 4").
+
+    - ``DEADLOCK`` — some node can always progress, or the run has
+      terminated (no silent wedge: a bounded run with zero reduces and no
+      loud failure is a livelock).
+    - ``PHASE_RESET`` — the lifecycle never regresses: a round whose
+      dispatch falls through every branch must fail loudly, not echo the
+      INIT default and silently restart the run.
+    - ``QUORUM`` — a reduce never proceeds below the configured (or
+      default all-site) quorum.
+    - ``STALE_CONTRIBUTION`` / ``LOST_CONTRIBUTION`` — every gradient
+      contribution is counted exactly once: no stale/redelivered payload
+      enters a reduce, no fresh survivor payload is dropped from one.
+    - ``LOST_UPDATE`` — every broadcast update is applied by every alive
+      site exactly once (never silently replaced by a stale delivery).
+    - ``UNRECOVERABLE`` — a single transient relay fault never kills a
+      site or the run while wire retries + chaos heal are in play.
+    - ``CACHE`` / ``VOLATILE`` — path-sensitive cache write-before-read
+      and volatile-key hygiene over the explored executions.
+    - ``WIRE`` — every wire key produced on an explored path is consumed
+      on some reachable path.
+    """
+
+    DEFAULT_SITES = 2
+    DEFAULT_ROUNDS = 3      # federated reduce rounds inside the bound
+    DEFAULT_FAULT_BUDGET = 1  # simultaneous-fault tolerance level verified
+
+    DEADLOCK = "proto-model-deadlock"
+    PHASE_RESET = "proto-model-phase-reset"
+    QUORUM = "proto-model-quorum"
+    STALE_CONTRIBUTION = "proto-model-stale-contribution"
+    LOST_CONTRIBUTION = "proto-model-lost-contribution"
+    LOST_UPDATE = "proto-model-lost-update"
+    UNRECOVERABLE = "proto-model-unrecoverable"
+    CACHE = "proto-model-cache"
+    VOLATILE = "proto-model-volatile"
+    WIRE = "proto-model-wire"
+    CONFIG = "proto-model-config"
 
 
 class AggEngine(_StrEnum):

@@ -159,6 +159,10 @@ class InProcessEngine:
         self.last_remote_out = {}
         self.dead_sites = set()
         self.site_failures = {}
+        # per-site last round output, kept for the chaos replay faults
+        # (``stale`` replays it in place of a fresh invocation; ``reappear``
+        # redelivers a dead site's last message one round after its death)
+        self._last_site_outs = {}
         # seed the quorum roster with the FULL consortium: a site dying in
         # round 0 must be judged (and recorded) against the original
         # n_sites, not silently absorbed into a shrunken roster
@@ -293,13 +297,58 @@ class InProcessEngine:
         os.makedirs(d, exist_ok=True)
         return d
 
+    # ----------------------------------------------------- chaos replay faults
+    def _stale_replay(self, rnd, s, rec):
+        """A matching ``stale`` fault replays the site's previous round
+        output in place of a fresh invocation (its payload files in the
+        transfer directory are the untouched previous round's — exactly a
+        delayed duplicate of the site→aggregator message).  Returns the
+        replayed output dict, or None to invoke normally."""
+        if not self.chaos.enabled:
+            return None
+        prev = self._last_site_outs.get(s)
+        if prev is None:
+            return None
+        if self.chaos.stale_fault(rnd, s, rec) is None:
+            return None
+        return dict(prev)
+
+    def _finish_site_outputs(self, rnd, site_outs, rec):
+        """Round barrier after the site loop, shared by both engines (the
+        ordering is load-bearing and must not diverge between them):
+        record every fresh output for future replay faults FIRST, then
+        deliver the stale last output of sites whose ``reappear`` fault
+        died one round earlier — the dropped-site-reappears scenario the
+        aggregator's roster filtering must reject
+        (``COINNRemote._check_quorum``)."""
+        self._last_site_outs.update(
+            {s: dict(o) for s, o in site_outs.items()}
+        )
+        if not self.chaos.enabled:
+            return
+        for s in self.chaos.reappear_deliveries(rnd, rec):
+            prev = self._last_site_outs.get(s)
+            if prev is not None:
+                site_outs[s] = dict(prev)
+
     # ------------------------------------------------------------- one round
     def _relay_broadcast(self, rnd, rec):
         """Relay aggregator transfer files into every surviving site's inbox
         — atomically (a reader can never observe a partial copy), with the
-        chaos relay faults (drop/duplicate) applied per destination."""
+        chaos relay faults (drop/duplicate) applied per destination.
+
+        Files relay in sorted order with ``.wire_manifest.json`` LAST: the
+        destination's manifest must never describe payloads that have not
+        been delivered yet (``os.listdir`` order is OS-arbitrary, so the
+        old order could relay the manifest first and leave a window where a
+        faulted payload is indistinguishable from one still mid-relay —
+        the clobber-ordering window the tier-4 model checker audits)."""
         xfer = self.remote_state["transferDirectory"]
-        for f in os.listdir(xfer):
+        names = sorted(
+            os.listdir(xfer),
+            key=lambda f: (f == wire_transport.MANIFEST_NAME, f),
+        )
+        for f in names:
             src = os.path.join(xfer, f)
             for s in self._alive_site_ids():
                 dst = os.path.join(self.site_states[s]["baseDirectory"], f)
@@ -325,6 +374,10 @@ class InProcessEngine:
         site_outs = {}
         with self.chaos.activate(rec), rec.span("engine:round", cat="engine"):
             for s in self._alive_site_ids():
+                replay = self._stale_replay(rnd, s, rec)
+                if replay is not None:
+                    site_outs[s] = replay
+                    continue
                 policy = self._invoke_policy(s)
 
                 def attempt(s=s):
@@ -356,6 +409,7 @@ class InProcessEngine:
                     rnd, s, self.site_states[s]["transferDirectory"], rec
                 )
 
+            self._finish_site_outputs(rnd, site_outs, rec)
             if not site_outs:
                 raise RuntimeError(
                     "every site died; nothing to aggregate — failures: "
@@ -477,6 +531,10 @@ class SubprocessEngine(InProcessEngine):
         site_outs = {}
         with self.chaos.activate(rec), rec.span("engine:round", cat="engine"):
             for s in self._alive_site_ids():
+                replay = self._stale_replay(rnd, s, rec)
+                if replay is not None:
+                    site_outs[s] = replay
+                    continue
                 policy = self._invoke_policy(s)
                 inp = dict(self.site_inputs[s])
                 if s not in self._first_done:
@@ -504,6 +562,7 @@ class SubprocessEngine(InProcessEngine):
                     rnd, s, self.site_states[s]["transferDirectory"], rec
                 )
 
+            self._finish_site_outputs(rnd, site_outs, rec)
             if not site_outs:
                 raise RuntimeError(
                     "every site died; nothing to aggregate — failures: "

@@ -78,6 +78,10 @@ _VOLATILE_CACHE_KEYS = frozenset((
     # wire retry pressure counters (resilience/retry.py) mutate per load —
     # host-side bookkeeping, never trace-relevant
     "wire_retry_stats",
+    # the lockstep round stamp (nodes/remote.py broadcast, echoed by every
+    # site): increments every aggregator invocation by design — host-side
+    # protocol bookkeeping, never traced
+    "wire_round",
     # quorum roster bookkeeping (nodes/remote.py): grows the round a site
     # dies — host-side policy state, never traced.  Leaving it keyed would
     # churn the aggregator trainer's shared-bucket key (one recompile per

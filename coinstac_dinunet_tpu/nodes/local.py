@@ -422,6 +422,12 @@ class COINNLocal:
         client_id = self.state.get("clientId", "site")
         global_modes = self.input.get(RemoteWire.GLOBAL_MODES.value, {})
         self.out[LocalWire.MODE.value] = global_modes.get(client_id, self.cache.get("mode"))
+        # echo the aggregator's round stamp verbatim (idempotent under
+        # invocation retries): a delayed duplicate of an earlier message
+        # echoes a stale counter, which is how the aggregator rejects it
+        # (COINNRemote._check_lockstep_phases / proto-model-stale-contribution)
+        if self.input.get(RemoteWire.ROUND.value) is not None:
+            self.out[LocalWire.ROUND.value] = self.input[RemoteWire.ROUND.value]
 
         rec = telemetry.get_active()
         if self.out[LocalWire.PHASE.value] == Phase.COMPUTATION.value:

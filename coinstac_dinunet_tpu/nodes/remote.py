@@ -357,9 +357,76 @@ class COINNRemote:
         }
         return builtin.get(engine, reducer_cls or COINNReducer)
 
+    def _check_lockstep_phases(self):
+        """Refuse a round whose sites report heterogeneous phases.
+
+        The protocol is all-site lockstep: every surviving site advances
+        through the SAME phase each round, so a mixed-phase input can only
+        mean a stale or duplicated round message (a delayed site→aggregator
+        delivery standing in for the fresh one).  Pre-fix, such a round
+        fell through every ``check(all, ...)`` dispatch block and the
+        echoed default phase (INIT_RUNS) silently RESET the whole run —
+        the ``proto-model-phase-reset`` counterexample the tier-4 model
+        checker surfaced (``dinulint --model``, docs/ANALYSIS.md).  Loud is
+        the only safe answer: mid-round state cannot be rebuilt from a
+        stale message."""
+        phases = {
+            site_vars.get(LocalWire.PHASE.value)
+            for site_vars in self.input.values()
+        }
+        if len(phases) > 1:
+            per_site = {
+                site: site_vars.get(LocalWire.PHASE.value)
+                for site, site_vars in self.input.items()
+            }
+            telemetry.get_active().event(
+                "quorum:fail", cat="quorum", reason="mixed phases",
+                phases=per_site,
+            )
+            raise RuntimeError(
+                f"lockstep phase violation: sites report mixed phases "
+                f"{per_site} — a stale or duplicated round message; "
+                "refusing to aggregate (a silent fall-through would reset "
+                "the run to INIT_RUNS)"
+            )
+        # a stale message in the COMPUTATION steady state carries the SAME
+        # phase as a fresh one — only the echoed round counter
+        # (:attr:`RemoteWire.ROUND`, broadcast below, echoed verbatim by
+        # every site) tells them apart.  A site echoing an older counter is
+        # reporting from a previous round; aggregating its payload would
+        # silently double-count a stale gradient contribution.  ``None``
+        # echoes are tolerated (first round; pre-ROUND peers).
+        expected = self.cache.get("wire_round")
+        if expected is not None:
+            behind = {
+                site: site_vars.get(LocalWire.ROUND.value)
+                for site, site_vars in self.input.items()
+                if site_vars.get(LocalWire.ROUND.value) is not None
+                and int(site_vars.get(LocalWire.ROUND.value)) != int(expected)
+            }
+            if behind:
+                telemetry.get_active().event(
+                    "quorum:fail", cat="quorum", reason="stale round echo",
+                    expected=int(expected), behind=behind,
+                )
+                raise RuntimeError(
+                    f"lockstep round violation: expected every site to echo "
+                    f"round {int(expected)} but got {behind} — a stale or "
+                    "duplicated site message; refusing to aggregate its "
+                    "payload into this round's reduce"
+                )
+
     # -------------------------------------------------------------- main loop
     def compute(self, mp_pool=None, trainer_cls=None, reducer_cls=None, **kw):
         utils.maybe_enable_compilation_cache(self.cache)
+        # quorum filtering MUST precede the trainer/reducer construction:
+        # both snapshot ``self.input``, so a reappeared dropped site filtered
+        # only afterwards would still reach the reduce and its stale payload
+        # would be silently double-counted into the global average — the
+        # ``proto-model-stale-contribution`` counterexample the tier-4 model
+        # checker surfaced (dinulint --model, docs/ANALYSIS.md "Tier 4")
+        self._check_quorum()
+        self._check_lockstep_phases()
         trainer = trainer_cls(
             cache=self.cache, input=self.input, state=self.state,
             data_handle=EmptyDataHandle(
@@ -367,7 +434,6 @@ class COINNRemote:
             ),
         )
         self.out[RemoteWire.PHASE.value] = self.input.get(LocalWire.PHASE.value, Phase.INIT_RUNS.value)
-        self._check_quorum()
 
         if check(all, LocalWire.PHASE.value, Phase.INIT_RUNS.value, self.input):
             self._init_runs()
@@ -377,6 +443,17 @@ class COINNRemote:
         if check(all, LocalWire.PHASE.value, Phase.PRE_COMPUTATION.value, self.input):
             self.out.update(**self._pre_compute())
             self.out[RemoteWire.PHASE.value] = Phase.PRE_COMPUTATION.value
+
+        # the lockstep round stamp (checked above): monotonic per
+        # SUCCESSFUL aggregator invocation, echoed back verbatim by every
+        # site next round.  The stamp rides the output here but commits to
+        # the cache only at the END of compute — a failed invocation never
+        # broadcast, so an invoke RETRY re-entering compute must still
+        # expect the previous value or every retry would trip the lockstep
+        # check it can never satisfy.
+        self.out[RemoteWire.ROUND.value] = (
+            int(self.cache.get("wire_round") or 0) + 1
+        )
 
         rec = telemetry.get_active()
         self.out[RemoteWire.GLOBAL_MODES.value] = self._set_mode()
@@ -439,6 +516,10 @@ class COINNRemote:
         # async wire commits must land — or fail loudly — before the output
         # JSON naming the committed broadcast files leaves this node
         wire_transport.flush_async()
+        # commit the round stamp LAST: everything above could still fail,
+        # and only an invocation that actually returns its output has
+        # issued the stamp (mid-round cache write — _VOLATILE_CACHE_KEYS)
+        self.cache["wire_round"] = self.out[RemoteWire.ROUND.value]
         return self.out
 
     def __call__(self, *a, **kw):

@@ -20,8 +20,17 @@ Fault plan schema (a dict, or a path to a JSON file)::
         {"kind": "drop_relay",        "round": 4, "site": "site_0",
          "file": "avg_grads.npy"},
         {"kind": "duplicate_delivery","round": 4, "site": "site_1",
-         "file": "avg_grads.npy"}
+         "file": "avg_grads.npy"},
+        {"kind": "stale",    "round": 2, "site": "site_1"},
+        {"kind": "reappear", "round": 3, "site": "site_2"}
     ]}
+
+``stale`` replays the site's previous round output in place of a fresh
+invocation (a delayed duplicate of the site→aggregator message);
+``reappear`` kills the site permanently at the pinned round and redelivers
+its stale last output ONE round later — the dropped-site-reappears
+scenario.  Both are the tier-4 model checker's counterexample vocabulary
+(``dinulint --model``, docs/ANALYSIS.md "Tier 4").
 
 Optional per-fault keys: ``times`` (how many firings before the fault heals;
 default 1 for payload/relay faults, *permanent* for crash/hang — a hung
@@ -55,10 +64,22 @@ FAULT_KINDS = (
     "crash", "hang", "slow",
     "truncate_payload", "corrupt_payload",
     "drop_relay", "duplicate_delivery",
+    "stale", "reappear",
 )
 _INVOKE_KINDS = ("crash", "hang", "slow")
 _PAYLOAD_KINDS = ("truncate_payload", "corrupt_payload")
 _RELAY_KINDS = ("drop_relay", "duplicate_delivery")
+#: site-message replay faults (the tier-4 model checker's counterexample
+#: vocabulary, docs/ANALYSIS.md "Tier 4"):
+#: - ``stale``: the site is not invoked this round; its PREVIOUS round's
+#:   output JSON (and the untouched previous payload files in its transfer
+#:   directory) stand in — a delayed duplicate of the site→aggregator
+#:   message arriving in place of the fresh one.
+#: - ``reappear``: the site dies at the pinned round (a permanent crash,
+#:   quorum-dropped like any other), and ONE round later its last committed
+#:   output is redelivered — the dropped-site-reappears scenario whose
+#:   stale payload only the aggregator's roster filtering can reject.
+_REPLAY_KINDS = ("stale", "reappear")
 #: bytes XOR-flipped at the payload tail by corrupt_payload (data section —
 #: past any header/manifest bytes, so the CRC check is what catches it)
 _CORRUPT_TAIL = 8
@@ -80,6 +101,14 @@ class ChaosHang(ChaosFault):
     the observable behavior (no output, no cache advance)."""
 
     kind = "hang"
+
+
+class ChaosReappear(ChaosFault):
+    """The death half of a ``reappear`` fault: the site's process dies at
+    the pinned round (permanently — the engine quorum-drops it), and its
+    stale last output is redelivered one round later."""
+
+    kind = "reappear"
 
 
 class Fault:
@@ -105,7 +134,9 @@ class Fault:
         self.round = int(spec["round"])
         self.site = str(spec["site"]) if spec.get("site") is not None else None
         self.file = str(spec["file"]) if spec.get("file") is not None else None
-        if self.site is None and self.kind in _INVOKE_KINDS + _PAYLOAD_KINDS:
+        if self.site is None and self.kind in (
+            _INVOKE_KINDS + _PAYLOAD_KINDS + _REPLAY_KINDS
+        ):
             raise ValueError(
                 f"fault[{index}] ({self.kind}): 'site' is required"
             )
@@ -113,9 +144,12 @@ class Fault:
             raise ValueError(
                 f"fault[{index}] ({self.kind}): 'file' is required"
             )
-        # crash/hang default to PERMANENT (a dead process stays dead, so the
-        # invocation retries exhaust); everything else fires once
-        default_times = None if self.kind in ("crash", "hang") else 1
+        # crash/hang/reappear default to PERMANENT (a dead process stays
+        # dead, so the invocation retries exhaust); everything else fires
+        # once (a reappear's single stale REDELIVERY is tracked separately)
+        default_times = (
+            None if self.kind in ("crash", "hang", "reappear") else 1
+        )
         self.times = (
             int(spec["times"]) if spec.get("times") is not None
             else default_times
@@ -204,6 +238,12 @@ class _NullChaos:
     def relay_fault(self, rnd, fname, site, rec):
         return None
 
+    def stale_fault(self, rnd, site, rec):
+        return None
+
+    def reappear_deliveries(self, rnd, rec):
+        return ()
+
     def heal_for_retry(self, rec=None, target=None):
         return 0
 
@@ -228,6 +268,9 @@ class ChaosSession:
         # damaged path, so an invocation retry only heals damage blocking
         # ITS OWN reads — co-scheduled faults must not cancel each other.
         self._repairs = {}
+        # site -> engine round at which a reappear fault's stale output is
+        # redelivered (the round after the injected death)
+        self._reappear_due = {}
         self._rec = None
 
     @classmethod
@@ -274,7 +317,51 @@ class ChaosSession:
                 f"injected {fault.kind} ({fault.describe()}, "
                 f"firing {fault.fired})"
             )
+        # the death half of a reappear: the process dies permanently here;
+        # the stale last output redelivers one round later (the engine
+        # queries reappear_deliveries)
+        for fault in self.faults:
+            if fault.kind != "reappear":
+                continue
+            if not (fault.matches(rnd, site) and fault.can_fire()):
+                continue
+            self._fire(fault, rec, attempt=fault.fired + 1)
+            self._reappear_due.setdefault(str(site), int(rnd) + 1)
+            raise ChaosReappear(
+                f"injected reappear death ({fault.describe()}, stale "
+                f"output redelivers at round {int(rnd) + 1})"
+            )
         return None
+
+    # ---------------------------------------------------------- replay faults
+    def stale_fault(self, rnd, site, rec):
+        """A matching ``stale`` fault for this (round, site), or None: the
+        engine skips the invocation and replays the site's previous output
+        — its payload files are the untouched previous round's, exactly a
+        delayed duplicate of the site→aggregator message."""
+        for fault in self.faults:
+            if fault.kind != "stale":
+                continue
+            if not (fault.matches(rnd, site) and fault.can_fire()):
+                continue
+            self._fire(fault, rec)
+            return fault
+        return None
+
+    def reappear_deliveries(self, rnd, rec):
+        """Sites whose reappear redelivery is due this round (their death
+        fired one round earlier); each delivers exactly once."""
+        due = sorted(
+            s for s, r in self._reappear_due.items() if r == int(rnd)
+        )
+        for s in due:
+            del self._reappear_due[s]
+            if rec is not None:
+                rec.event(
+                    "chaos:inject", cat="chaos", fault="reappear",
+                    fault_round=int(rnd), site=s, delivery=True,
+                )
+        return due
 
     # ---------------------------------------------------------- payload damage
     def payload_faults(self, rnd, site, dirpath, rec):
@@ -378,9 +465,24 @@ class ChaosSession:
     def on_load_failure(self, path, attempt, exc):
         """Transport load-failure hook (in-process readers): repair the
         damaged payload once ``heal_after`` failed attempts accumulated —
-        the deterministic 'relay completed' moment."""
+        the deterministic 'relay completed' moment.
+
+        The damage blocking a load is not always ON the loaded file: a
+        dropped/duplicated ``.wire_manifest.json`` fails the PAYLOAD's
+        manifest-CRC cross-check, so the failing path and the damaged file
+        differ.  Before the tier-4 model checker surfaced it
+        (``proto-model-unrecoverable``), that repair was keyed only by the
+        damaged path and could never fire — a single transient relay fault
+        on the manifest killed the reader's node despite the wire-retry
+        contract.  The heal now also covers damage registered on the
+        failing file's directory manifest."""
         key = os.path.abspath(str(path))
         entry = self._repairs.get(key)
+        if entry is None:
+            key = os.path.abspath(os.path.join(
+                os.path.dirname(str(path)), transport.MANIFEST_NAME
+            ))
+            entry = self._repairs.get(key)
         if entry is None:
             return False
         entry[2] += 1

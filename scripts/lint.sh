@@ -35,6 +35,26 @@ if [ "${DINULINT_TIER3:-}" = "1" ]; then
     # inside the static gate's wall-clock budget
     extra+=(--tier3 --deep)
 fi
+if [ "${DINULINT_MODEL:-}" = "1" ]; then
+    # tier-4 federation protocol model checker (pure Python, exhaustive
+    # within the default bound; docs/ANALYSIS.md "Tier 4").  Knobs:
+    # DINULINT_MODEL_SITES / _ROUNDS / _FAULTS override the bound;
+    # DINULINT_MODEL_PLANS names a directory for the replayable
+    # counterexample fault plans (the CI model-check job uploads it).
+    extra+=(--model)
+    if [ -n "${DINULINT_MODEL_SITES:-}" ]; then
+        extra+=(--model-sites "$DINULINT_MODEL_SITES")
+    fi
+    if [ -n "${DINULINT_MODEL_ROUNDS:-}" ]; then
+        extra+=(--model-rounds "$DINULINT_MODEL_ROUNDS")
+    fi
+    if [ -n "${DINULINT_MODEL_FAULTS:-}" ]; then
+        extra+=(--model-faults "$DINULINT_MODEL_FAULTS")
+    fi
+    if [ -n "${DINULINT_MODEL_PLANS:-}" ]; then
+        extra+=(--model-plans "$DINULINT_MODEL_PLANS")
+    fi
+fi
 
 echo "== dinulint (${DINULINT[*]} ${extra[*]-}) =="
 # Under GitHub Actions, emit ::error workflow annotations so findings land

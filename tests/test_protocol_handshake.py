@@ -16,11 +16,17 @@ from test_trainer import XorDataset, XorTrainer
 
 # golden per-phase wire vocabularies, straight from the protocol design
 # (docs/ANALYSIS.md "protocol-conformance"): round 1 is the INIT_RUNS
-# handshake, round 2 the first dSGD train round.
+# handshake, round 2 the first dSGD train round.  ``wire_round`` is the
+# lockstep round stamp (broadcast every round, echoed by sites from round
+# 2 on — round 1's site input carries no stamp yet): the at-most-once
+# delivery witness the tier-4 model checker demanded (proto-model-
+# stale-contribution, docs/ANALYSIS.md "Tier 4").
 GOLDEN_SITE_ROUND1 = {"data_size", "mode", "phase", "shared_args"}
-GOLDEN_REMOTE_ROUND1 = {"global_modes", "global_runs", "phase"}
-GOLDEN_SITE_TRAIN = {"grad_weight", "grads_file", "mode", "phase", "reduce"}
-GOLDEN_REMOTE_TRAIN = {"avg_grads_file", "global_modes", "phase", "update"}
+GOLDEN_REMOTE_ROUND1 = {"global_modes", "global_runs", "phase", "wire_round"}
+GOLDEN_SITE_TRAIN = {"grad_weight", "grads_file", "mode", "phase", "reduce",
+                     "wire_round"}
+GOLDEN_REMOTE_TRAIN = {"avg_grads_file", "global_modes", "phase", "update",
+                       "wire_round"}
 
 
 def _engine(tmp_path, n_sites=2, per_site=16, **args):
