@@ -25,14 +25,40 @@ the caller's problem):
   metric.
 - ``<watchdog>.observe(NAME, ...)`` on a ``Watchdog(...)`` chain or a
   ``wd``/``watchdog`` binding — declared metric.
+- ``<recorder>.event(Live.X, ...)`` / any ``Live.X`` spelling — the member
+  must exist in the :class:`~..config.keys.Live` vocabulary (event-name
+  literals stay free-form; only member spellings are resolvable).
+
+The rule additionally validates the VOCABULARY DEFINITION itself, in any
+module that defines a ``Live`` class (in this repo: ``config/keys.py``):
+
+- ``Live`` values must use the legal telemetry-name charset, and
+  ``Live.HEARTBEAT`` must keep its load-bearing ``engine:`` prefix (the
+  live tailer keys site liveness on it).
+- ``Live.PROM_PREFIX``, every ``Live.VERDICT_*`` kind and every ``Metric``
+  value must already be legal Prometheus metric-name material
+  (``[a-z_][a-z0-9_]*``) — the exporter's name mapping
+  (``telemetry/serve.py::prometheus_name``) must be the identity plus the
+  prefix, never a mangling (a mangled name silently breaks every deployed
+  dashboard that scraped the old spelling).
 """
 import ast
 import os
+import re
 
 from .core import Finding, Rule, dotted_name, register_rule
 
 METRIC_CLASS = "Metric"
 ANOMALY_CLASS = "Anomaly"
+LIVE_CLASS = "Live"
+
+#: legal Prometheus metric-name fragment — the exporter mapping must be the
+#: identity on every declared series/verdict/prefix
+_PROM_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+#: legal telemetry event/cache-key charset (colon namespaces event names)
+_TELEMETRY_NAME_RE = re.compile(r"^[a-z_][a-z0-9_:.]*$")
+#: the heartbeat event's stable prefix (telemetry/live.py keys on it)
+_HEARTBEAT_PREFIX = "engine:"
 
 _RECORDER_ROOTS = {"rec", "recorder", "telemetry", "tracer"}
 _WATCHDOG_ROOTS = {"wd", "watchdog"}
@@ -47,12 +73,12 @@ def _keys_module_path():
 
 def load_name_vocab(keys_source=None):
     """Parse ``config/keys.py`` into ``{class_name: {member: value}}`` for
-    the :class:`Metric` and :class:`Anomaly` vocabularies."""
+    the :class:`Metric`, :class:`Anomaly` and :class:`Live` vocabularies."""
     if keys_source is None:
         with open(_keys_module_path(), "r", encoding="utf-8") as f:
             keys_source = f.read()
     tree = ast.parse(keys_source)
-    vocab = {METRIC_CLASS: {}, ANOMALY_CLASS: {}}
+    vocab = {METRIC_CLASS: {}, ANOMALY_CLASS: {}, LIVE_CLASS: {}}
     for node in tree.body:
         if isinstance(node, ast.ClassDef) and node.name in vocab:
             for stmt in node.body:
@@ -90,7 +116,8 @@ def _resolve_name(node, vocab):
     if isinstance(node, ast.Name):
         parts.append(node.id)
     parts.reverse()
-    if len(parts) >= 2 and parts[-2] in (METRIC_CLASS, ANOMALY_CLASS):
+    if len(parts) >= 2 and parts[-2] in (METRIC_CLASS, ANOMALY_CLASS,
+                                         LIVE_CLASS):
         return vocab[parts[-2]].get(parts[-1]), "member"
     return None, "dynamic"
 
@@ -139,7 +166,9 @@ class TelemetryMetricNameRule(Rule):
     doc = ("Metric/anomaly names in record_metric()/Recorder.metric()/"
            "Watchdog.observe() calls and register_detector() registrations "
            "must come from the config/keys.py Metric/Anomaly vocabulary "
-           "(typos make silently-unwatched series).")
+           "(typos make silently-unwatched series); Live vocabulary members "
+           "must exist, keep the heartbeat's engine: prefix, and stay legal "
+           "under the Prometheus metric-name mapping of telemetry/serve.py.")
 
     def __init__(self, keys_source=None):
         self._keys_source = keys_source
@@ -160,12 +189,13 @@ class TelemetryMetricNameRule(Rule):
         if kind in ("none", "dynamic"):
             return None
         if kind == "member" and resolved is None:
-            cls = METRIC_CLASS if which == METRIC_CLASS else ANOMALY_CLASS
+            dotted = dotted_name(node, require_name_root=False) or ""
+            segs = dotted.split(".")
+            cls = segs[-2] if len(segs) >= 2 else which
             return Finding(
                 rule=self.id, path=module.path, line=node.lineno,
                 col=node.col_offset,
-                message=f"unknown {cls} member "
-                        f"'{dotted_name(node, require_name_root=False)}' — "
+                message=f"unknown {cls} member '{dotted}' — "
                         f"declare it in config/keys.py {cls}",
             )
         if resolved not in values:
@@ -187,8 +217,62 @@ class TelemetryMetricNameRule(Rule):
                     return kw.value
         return None
 
-    def visit_module(self, module):
+    # ------------------------------------------------- vocabulary definition
+    def _definition_findings(self, module):
+        """Validate the vocabulary DEFINITION in a module that defines a
+        ``Live`` class (config/keys.py; fixture modules in tests): event
+        prefix stability, telemetry-name charset, and the Prometheus
+        mapping's identity requirement for ``Metric``/``PROM_PREFIX``/
+        ``VERDICT_*`` values."""
+        classes = {
+            node.name: node for node in module.tree.body
+            if isinstance(node, ast.ClassDef)
+        }
+        if LIVE_CLASS not in classes:
+            return []
         findings = []
+
+        def members(cls_node):
+            for stmt in cls_node.body:
+                if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, str)):
+                    yield stmt.targets[0].id, stmt.value.value, stmt
+
+        def bad(stmt, message):
+            findings.append(Finding(
+                rule=self.id, path=module.path, line=stmt.lineno,
+                col=stmt.col_offset, message=message,
+            ))
+
+        for member, value, stmt in members(classes[LIVE_CLASS]):
+            if member == "HEARTBEAT" and not value.startswith(
+                    _HEARTBEAT_PREFIX):
+                bad(stmt, f"Live.HEARTBEAT '{value}' must keep the stable "
+                          f"'{_HEARTBEAT_PREFIX}' prefix — the live tailer "
+                          "keys site liveness on it")
+            elif (member == "PROM_PREFIX" or member.startswith("VERDICT_")):
+                if not _PROM_NAME_RE.match(value):
+                    bad(stmt, f"Live.{member} '{value}' is not legal "
+                              "Prometheus metric-name material "
+                              "([a-z_][a-z0-9_]*) — the exporter mapping "
+                              "(telemetry/serve.py) would mangle it")
+            elif not _TELEMETRY_NAME_RE.match(value):
+                bad(stmt, f"Live.{member} '{value}' uses characters outside "
+                          "the telemetry name charset ([a-z_][a-z0-9_:.]*)")
+        if METRIC_CLASS in classes:
+            for member, value, stmt in members(classes[METRIC_CLASS]):
+                if not _PROM_NAME_RE.match(value):
+                    bad(stmt, f"Metric.{member} '{value}' is not a legal "
+                              "Prometheus metric-name suffix "
+                              "([a-z_][a-z0-9_]*) — the /metrics exporter "
+                              "mapping must stay the identity plus the "
+                              "prefix")
+        return findings
+
+    def visit_module(self, module):
+        findings = self._definition_findings(module)
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -225,6 +309,27 @@ class TelemetryMetricNameRule(Rule):
                     arg = self._first_arg(node, kwarg="name")
                     if arg is not None:
                         hit = self._check_name(module, arg, METRIC_CLASS)
+            elif last == "event" and isinstance(node.func, ast.Attribute):
+                # event-name LITERALS are free-form; only vocabulary-member
+                # spellings are checked (an unknown Live/Metric/Anomaly
+                # member is a typo that would emit a name no tailer watches)
+                if _is_recorder_expr(node.func.value):
+                    arg = self._first_arg(node, kwarg="name")
+                    if arg is not None:
+                        vocab = self.vocab()
+                        resolved, kind = _resolve_name(arg, vocab)
+                        if kind == "member" and resolved is None:
+                            dotted = dotted_name(
+                                arg, require_name_root=False
+                            ) or ""
+                            cls = dotted.split(".")[-2] if "." in dotted else "?"
+                            hit = Finding(
+                                rule=self.id, path=module.path,
+                                line=arg.lineno, col=arg.col_offset,
+                                message=f"unknown {cls} member '{dotted}' "
+                                        "in recorder event name — declare "
+                                        f"it in config/keys.py {cls}",
+                            )
             if hit:
                 findings.append(hit)
         return findings

@@ -345,6 +345,77 @@ class Perf:
     MEMORY_LIMIT = "memory_limit_bytes"
 
 
+class Live:
+    """Vocabulary for the live federation ops plane
+    (:mod:`coinstac_dinunet_tpu.telemetry.live` /
+    :mod:`coinstac_dinunet_tpu.telemetry.serve` — the in-flight counterpart
+    of the post-hoc ``telemetry doctor``).
+
+    Plain ``str`` constants, mirroring :class:`Metric`.  Three families
+    share the class (the ``telemetry-metric-name`` dinulint rule validates
+    all of them statically — event-name prefix stability, cache-key
+    charset, and Prometheus-mapping legality):
+
+    Event names:
+
+    - ``HEARTBEAT`` — the lightweight ``engine:heartbeat`` event both
+      engines emit per node invocation (serial engines: one per site per
+      round; the site-vectorized engine: one per round with the alive
+      count).  The live tailer keys site liveness on it, so the
+      ``engine:`` prefix is load-bearing and must stay stable.
+
+    Cache keys (knobs):
+
+    - ``FLUSH_INTERVAL`` — wall-clock seconds between Recorder auto-flushes
+      (default 5.0; ``0`` restores size-bounded-only flushing).  Without it
+      a long invocation buffers everything until the end and a live tailer
+      sees no progress mid-epoch.
+    - ``SILENCE_AFTER`` — seconds of per-site heartbeat silence before the
+      heartbeat-silence verdict fires (default 30).  Guarded twice: the
+      rest of the federation must still be live (a finished run is not a
+      stall), and the federation must have moved MORE THAN ONE round past
+      the site's (serial engines invoke sites one after another, so a
+      one-round lag is the healthy steady state of every waiting lane;
+      two rounds means a whole round completed without the site).
+    - ``ROUND_OUTLIER`` — multiple of the rolling-median round duration a
+      round must exceed to fire the round-duration-outlier verdict
+      (default 4.0).
+    - ``MFU_COLLAPSE`` — fraction of the MFU EMA below which a sample
+      fires the MFU-collapse verdict (default 0.3).
+    - ``RETRY_STORM`` / ``RETRY_WINDOW`` — wire-retry count per rolling
+      window (seconds) that fires the retry-storm verdict (default 10
+      retries per 30 s).
+
+    In-flight verdict kinds (edge-triggered; same ``severity``/``cause``/
+    ``evidence`` shape as the doctor's ranked verdicts, so the live board
+    and the postmortem speak one language; each kind is also a Prometheus
+    ``verdicts_total{kind=...}`` label, hence the legal-metric-charset
+    requirement):
+
+    - ``VERDICT_SILENCE`` — a site's heartbeat went silent mid-run.
+    - ``VERDICT_ROUND_OUTLIER`` — a round blew past the rolling median.
+    - ``VERDICT_MFU_COLLAPSE`` — utilization collapsed vs its own EMA.
+    - ``VERDICT_RETRY_STORM`` — wire retries bursting (flaky relay).
+
+    ``PROM_PREFIX`` is the stable prefix of every exported Prometheus
+    metric name (``coinstac_dinunet_<series>``); renaming it breaks every
+    deployed dashboard, so the lint rule pins its legality.
+    """
+
+    HEARTBEAT = "engine:heartbeat"
+    FLUSH_INTERVAL = "telemetry_flush_interval_s"
+    SILENCE_AFTER = "watch_silence_after_s"
+    ROUND_OUTLIER = "watch_round_outlier"
+    MFU_COLLAPSE = "watch_mfu_collapse"
+    RETRY_STORM = "watch_retry_storm"
+    RETRY_WINDOW = "watch_retry_window_s"
+    PROM_PREFIX = "coinstac_dinunet"
+    VERDICT_SILENCE = "heartbeat_silence"
+    VERDICT_ROUND_OUTLIER = "round_duration_outlier"
+    VERDICT_MFU_COLLAPSE = "mfu_collapse"
+    VERDICT_RETRY_STORM = "wire_retry_storm"
+
+
 class Capture:
     """Cache-key vocabulary for anomaly-triggered profiler capture
     (:mod:`coinstac_dinunet_tpu.telemetry.capture`).

@@ -23,7 +23,7 @@ import shutil
 import numpy as np
 
 from . import config, telemetry, utils
-from .config.keys import Key, Mode, Phase
+from .config.keys import Key, Live, Mode, Phase
 from .telemetry import capture as _capture
 from .data import COINNDataHandle
 from .nodes import COINNLocal, COINNRemote
@@ -403,6 +403,9 @@ class InProcessEngine:
                     self._site_failure(s, exc, attempts=policy.last_attempts)
                     continue
                 site_outs[s] = result["output"]
+                # liveness pulse for the live ops plane (telemetry/live.py):
+                # a site that stops completing invocations stops beating
+                rec.event(Live.HEARTBEAT, cat="engine", site=s)
                 # chaos payload damage happens AFTER the site committed its
                 # outbound files — exactly where a truncated relay would
                 self.chaos.payload_faults(
@@ -431,6 +434,7 @@ class InProcessEngine:
             result = self._invoke_with_retry(
                 self._invoke_policy("remote"), remote_attempt, "remote", rec,
             )
+            rec.event(Live.HEARTBEAT, cat="engine", site="remote")
             remote_out = result["output"]
             self.success = bool(result.get("success"))
             self.last_remote_out = remote_out
@@ -558,6 +562,7 @@ class SubprocessEngine(InProcessEngine):
                     continue
                 self.site_caches[s] = res.get("cache", {})
                 site_outs[s] = res["output"]
+                rec.event(Live.HEARTBEAT, cat="engine", site=s)
                 self.chaos.payload_faults(
                     rnd, s, self.site_states[s]["transferDirectory"], rec
                 )
@@ -583,6 +588,7 @@ class SubprocessEngine(InProcessEngine):
             res = self._invoke_with_retry(
                 self._invoke_policy("remote"), remote_attempt, "remote", rec,
             )
+            rec.event(Live.HEARTBEAT, cat="engine", site="remote")
             self.remote_cache = res.get("cache", {})
             remote_out = res["output"]
             self.success = bool(res.get("success"))

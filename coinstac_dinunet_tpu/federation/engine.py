@@ -33,7 +33,7 @@ import time
 
 import numpy as np
 
-from ..config.keys import Metric
+from ..config.keys import Live, Metric
 from ..engine import MeshEngine
 from ..nodes.remote import COINNRemote
 from ..resilience.chaos import ChaosFault, ChaosSession
@@ -141,6 +141,14 @@ class SiteVectorizedEngine(MeshEngine):
             _perf.sample_device_memory(self.cache, recorder=rec)
         self.rounds += 1
         rec.set_context(round=self.rounds)
+        if rec.enabled:
+            # one liveness pulse per ROUND (not per site: at 10^3 stacked
+            # sites per jit, per-site events would dwarf the payload) —
+            # the live board keys vectorized-plane progress on it
+            rec.event(
+                Live.HEARTBEAT, cat="engine",
+                alive=len(self.site_ids) - len(self.dead_sites),
+            )
         try:
             for s in self.site_ids:
                 if s in self.dead_sites:

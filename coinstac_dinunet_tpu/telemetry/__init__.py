@@ -27,6 +27,12 @@ Public surface:
 - :mod:`.doctor` — postmortem report over a merged run (anomaly timeline,
   per-site divergence, roofline + MFU/memory floor verdicts, ranked
   verdicts); CLI at ``python -m coinstac_dinunet_tpu.telemetry doctor``.
+- :mod:`.live` — the live ops plane: rotation/crash-safe incremental JSONL
+  tailing (per-file byte cursors, torn-tail tolerance) into a
+  federation-wide in-flight state machine with edge-triggered stall
+  verdicts; CLI at ``python -m coinstac_dinunet_tpu.telemetry watch``.
+- :mod:`.serve` — stdlib HTTP exporters over the live state: Prometheus
+  text-format ``/metrics`` and a ``/healthz`` JSON summary.
 
 jax-free by design: importing this package never pulls in jax (the recorder
 bridges to ``jax.monitoring`` only if jax is already loaded, and

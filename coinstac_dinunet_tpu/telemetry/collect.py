@@ -41,35 +41,80 @@ def find_event_files(root):
     return out
 
 
+def read_jsonl_segment(path, offset=0):
+    """Tolerant incremental JSONL read: records from byte ``offset`` to the
+    last COMPLETE (newline-terminated) line of ``path``.
+
+    This is the one line reader shared by the post-hoc :func:`load_events`
+    and the live tailer (:mod:`.live`): the Recorder writes each record as
+    ``line + "\\n"`` in a single append, so an unterminated trailing line is
+    by definition a torn write from a dying (or still-mid-append) site — it
+    is never parsed (a truncation can otherwise *mis*-parse as a shorter
+    valid value), never consumed, and counted instead.
+
+    Returns ``(records, new_offset, bad_lines, has_partial_tail)``:
+    ``new_offset`` points just past the last complete line (the resume
+    cursor), ``bad_lines`` counts undecodable complete lines (corruption),
+    ``has_partial_tail`` flags unterminated trailing bytes left unread.
+    """
+    with open(path, "rb") as f:
+        f.seek(int(offset))
+        data = f.read()
+    end = data.rfind(b"\n")
+    if end < 0:
+        return [], int(offset), 0, bool(data)
+    records, bad = [], 0
+    for line in data[:end].split(b"\n"):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            bad += 1
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
+        else:
+            bad += 1
+    return records, int(offset) + end + 1, bad, end + 1 < len(data)
+
+
+class EventLog(list):
+    """A merged record list that additionally carries the torn/corrupt line
+    count — plain-list compatible, so every existing ``load_events`` caller
+    keeps working while :func:`summarize` surfaces ``truncated_lines``."""
+
+    truncated_lines = 0
+
+
 def load_events(root_or_files):
     """Parse one run's telemetry records, wall-clock ordered.
 
     ``root_or_files`` is a run directory (recursively scanned) or an
-    explicit list of JSONL paths.  Undecodable lines (a crash mid-append)
-    are skipped, never fatal.
+    explicit list of JSONL paths.  Torn trailing lines (a site killed
+    mid-append) and undecodable lines are skipped, never fatal — they are
+    COUNTED on the returned :class:`EventLog`'s ``truncated_lines`` and
+    surfaced by :func:`summarize`.
     """
     if isinstance(root_or_files, (str, os.PathLike)):
         files = find_event_files(root_or_files)
     else:
         files = [str(p) for p in root_or_files]
-    events = []
+    events = EventLog()
+    truncated = 0
     for path in files:
         try:
-            with open(path, "r", encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue
-                    if isinstance(rec, dict):
-                        rec.setdefault("node", _node_from_filename(path))
-                        events.append(rec)
+            records, _, bad, partial = read_jsonl_segment(path)
         except OSError:
             continue
+        truncated += bad + (1 if partial else 0)
+        node = _node_from_filename(path)
+        for rec in records:
+            rec.setdefault("node", node)
+        events.extend(records)
     events.sort(key=lambda r: (float(r.get("t0", 0.0)), r.get("node", "")))
+    events.truncated_lines = truncated
     return events
 
 
@@ -188,6 +233,9 @@ def summarize(events):
         "nodes": nodes, "spans": spans, "wire": wire, "counters": counters,
         "events": evcounts, "metrics": metrics,
         "wall_s": (round(t_hi - t_lo, 6) if t_lo is not None else 0.0),
+        # torn/corrupt line count carried by load_events' EventLog (0 for a
+        # plain list) — a dying site's last write is evidence, not noise
+        "truncated_lines": int(getattr(events, "truncated_lines", 0) or 0),
     }
 
 
@@ -202,6 +250,11 @@ def _fmt_bytes(n):
 def render_summary(summary):
     """Human-readable per-phase/per-site table for a merged timeline."""
     lines = [f"federation wall-clock: {summary['wall_s']:.3f}s"]
+    if summary.get("truncated_lines"):
+        lines.append(
+            f"!! {summary['truncated_lines']} truncated/undecodable JSONL "
+            "line(s) skipped (torn writes from dying writers)"
+        )
     for node in summary["nodes"]:
         lines.append(f"\n[{node}]")
         spans = summary["spans"].get(node, {})

@@ -23,9 +23,12 @@ Design constraints, in priority order:
    inside a jitted/shard_mapped function (it would be traced away at best,
    force host syncs at worst).  The ``trace-telemetry`` dinulint rule
    (:mod:`..analysis.trace_hazards`) enforces this statically.
-3. **Crash-friendly.**  Records buffer in memory and flush as one appended
-   write per node invocation (plus a size-bounded auto-flush), so a dying
-   site still leaves its timeline up to the last flush on disk.
+3. **Crash-friendly and tailable.**  Records buffer in memory and flush as
+   one appended write per node invocation, plus a size-bounded AND a
+   wall-clock auto-flush (``cache['telemetry_flush_interval_s']``, default
+   5 s) — so a dying site still leaves its timeline up to the last flush
+   on disk, and a live tailer (:mod:`.live`) sees progress inside long
+   invocations instead of one burst at the end.
 
 Record schema (one JSON object per line; absent context fields are omitted)::
 
@@ -58,6 +61,14 @@ FILE_SUFFIX = ".jsonl"
 
 # records buffered before an automatic mid-invocation flush
 _AUTOFLUSH_AT = 512
+
+# wall-clock seconds between automatic flushes (the live-tailer contract:
+# a long invocation must surface progress mid-epoch, not at its end).
+# Overridable per node via cache['telemetry_flush_interval_s']
+# (config/keys.py::Live.FLUSH_INTERVAL; 0 disables the timer and restores
+# size-bounded-only flushing).  Only consulted on the ENABLED path — the
+# disabled fast path never reaches _append, so the knob costs nothing there.
+_FLUSH_INTERVAL_S = 5.0
 
 
 class _NullSpan:
@@ -195,6 +206,22 @@ class Recorder:
         # flush drain must be serialized
         self._lock = threading.Lock()
         self._io_lock = threading.Lock()  # keeps concurrent flushes' JSONL lines whole
+        # time-based auto-flush (the live-tailer contract; see
+        # _FLUSH_INTERVAL_S).  Resolved once at construction — one node
+        # invocation never outlives its recorder's config.
+        try:
+            interval = float(
+                (cache or {}).get("telemetry_flush_interval_s",
+                                  _FLUSH_INTERVAL_S)
+                or 0.0
+            )
+        except (TypeError, ValueError):
+            interval = _FLUSH_INTERVAL_S
+        self._flush_interval = max(interval, 0.0)
+        self._flush_deadline = (
+            time.monotonic() + self._flush_interval
+            if self._flush_interval else None
+        )
         _maybe_install_jax_compile_listener()
         _maybe_emit_degraded(self)
 
@@ -254,7 +281,10 @@ class Recorder:
             return  # stats-only mode (PhaseTimer shim): no sink, no buffering
         with self._lock:
             self._buffer.append(record)
-            full = len(self._buffer) >= _AUTOFLUSH_AT
+            full = len(self._buffer) >= _AUTOFLUSH_AT or (
+                self._flush_deadline is not None
+                and time.monotonic() >= self._flush_deadline
+            )
         if full:
             self.flush()
 
@@ -350,6 +380,8 @@ class Recorder:
         JSONL file in one write.  Without an ``out_dir`` this only drops the
         buffer (stats-only mode)."""
         with self._lock:
+            if self._flush_interval:
+                self._flush_deadline = time.monotonic() + self._flush_interval
             if self._counters:
                 now = time.time()
                 ctx = self._ctx()

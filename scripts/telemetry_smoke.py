@@ -75,7 +75,11 @@ def main(argv=None):
                    help="run under the chaos harness: PATH is a fault-plan "
                         "JSON (resilience/chaos.py schema); bare --fault-plan "
                         "uses the built-in demo plan (truncated payload at "
-                        "round 2 + hung site at round 3)")
+                        "round 2 + hung site at round 3); 'stall' is the "
+                        "live-watch variant (hung site at round 3 plus slow "
+                        "rounds on a survivor, so the run provably outlives "
+                        "the silence threshold while `telemetry watch` "
+                        "fires the heartbeat-silence verdict in flight)")
     args = p.parse_args(argv)
     if args.capture_on_anomaly and args.inject_nan_site is None:
         # the capture assertions need a deterministic anomaly source — a
@@ -127,6 +131,19 @@ def main(argv=None):
                 {"kind": "truncate_payload", "round": 2, "site": "site_0",
                  "file": "grads.npy"},
                 {"kind": "hang", "round": 3, "site": "site_1"},
+            ]}
+        elif args.fault_plan == "stall":
+            # the live-watch acceptance plan: after the hang kills site_1 at
+            # round 3, every later round is slowed on the surviving site_0
+            # so the run provably outlives a small heartbeat-silence
+            # threshold while site_1's lane stays dark — the in-flight
+            # stall-verdict scenario `telemetry watch --assert-verdict
+            # heartbeat_silence` gates on in CI (faults pinned to rounds the
+            # run never reaches simply don't fire)
+            fault_plan = {"faults": [
+                {"kind": "hang", "round": 3, "site": "site_1"},
+                *({"kind": "slow", "round": r, "site": "site_0",
+                   "seconds": 0.8} for r in range(4, 31)),
             ]}
         else:
             with open(args.fault_plan) as f:

@@ -1,0 +1,604 @@
+"""telemetry/live.py + telemetry/serve.py: the live federation ops plane
+(docs/TELEMETRY.md "Live ops plane").
+
+Covers the subsystem's contracts:
+
+- **Tolerant line reading** (shared with the collector): a torn trailing
+  JSONL line from a dying writer is counted, never parsed, never consumed;
+  ``load_events`` surfaces ``truncated_lines`` through ``summarize``.
+- **Tailer**: incremental polling, per-file byte cursors persisted to a
+  sidecar (a restarted tailer resumes without replaying), rotation/
+  truncation reset, and torn-tail carry-over (consumed once completed).
+- **LiveState verdicts**: each edge-triggered rule (heartbeat silence,
+  round-duration outlier, MFU collapse, wire-retry storm) fires exactly
+  once per excursion and re-arms on recovery.
+- **Exporters**: a real HTTP scrape of ``/metrics`` (Prometheus text
+  format) and ``/healthz`` (JSON) whose values match the post-hoc
+  ``telemetry doctor`` report built over the SAME records.
+- **Acceptance**: a 3-site ``InProcessEngine`` run under a chaos ``hang``
+  fault fires the heartbeat-silence verdict for the hung site *while the
+  run is still alive*, and the run then completes on the survivors.
+- **watch CLI**: ``--until-exit`` over a spawned run, ``--assert-verdict``
+  in-flight gating, board snapshot / metrics scrape / healthz JSON outputs.
+"""
+import ast
+import json
+import os
+import sys
+import textwrap
+import time
+
+from coinstac_dinunet_tpu.config.keys import Live
+from coinstac_dinunet_tpu.engine import InProcessEngine
+from coinstac_dinunet_tpu.telemetry.collect import (
+    load_events,
+    read_jsonl_segment,
+    render_summary,
+    summarize,
+)
+from coinstac_dinunet_tpu.telemetry.doctor import build_report
+from coinstac_dinunet_tpu.telemetry.live import LiveState, Tailer, render_board
+from coinstac_dinunet_tpu.telemetry.serve import (
+    OpsServer,
+    prometheus_name,
+    render_prometheus,
+)
+
+from test_trainer import XorDataset, XorTrainer  # noqa: F401 (fixture reuse)
+
+
+def _line(**rec):
+    rec.setdefault("v", 1)
+    return json.dumps(rec) + "\n"
+
+
+# ------------------------------------------------------- tolerant line reader
+def test_read_jsonl_segment_skips_torn_tail_and_counts_bad_lines(tmp_path):
+    p = tmp_path / "telemetry.site_0.jsonl"
+    p.write_text(
+        _line(kind="event", name="a", t0=1.0)
+        + "{corrupt-complete-line}\n"
+        + _line(kind="event", name="b", t0=2.0)
+        + '{"kind":"event","name":"torn","t0":3.0'  # no newline: torn write
+    )
+    records, offset, bad, partial = read_jsonl_segment(str(p))
+    assert [r["name"] for r in records] == ["a", "b"]
+    assert bad == 1 and partial is True
+    # the cursor stops at the torn line's start: completing it later makes
+    # it readable from exactly that offset
+    with open(p, "a") as f:
+        f.write(',"late":true}\n')
+    records2, _, bad2, partial2 = read_jsonl_segment(str(p), offset)
+    assert [r["name"] for r in records2] == ["torn"]
+    assert records2[0]["late"] is True and bad2 == 0 and partial2 is False
+
+
+def test_load_events_surfaces_truncated_lines_in_summary(tmp_path):
+    p = tmp_path / "telemetry.site_0.jsonl"
+    p.write_text(
+        _line(kind="span", name="ok", t0=1.0, dur=0.1)
+        + '{"kind":"metric","name":"mfu","value":0.1'  # killed mid-append
+    )
+    events = load_events(str(tmp_path))
+    assert [e["name"] for e in events] == ["ok"]
+    assert events.truncated_lines == 1
+    summary = summarize(events)
+    assert summary["truncated_lines"] == 1
+    assert "truncated/undecodable" in render_summary(summary)
+    # a plain list keeps the old contract (count 0, no warning line)
+    assert summarize(list(events))["truncated_lines"] == 0
+
+
+# -------------------------------------------------------------------- tailer
+def test_tailer_incremental_poll_and_sidecar_resume(tmp_path):
+    p = tmp_path / "telemetry.site_0.jsonl"
+    cursors = tmp_path / "cursors.json"
+    p.write_text(_line(kind="event", name="a", t0=1.0))
+    t = Tailer(str(tmp_path), cursor_path=str(cursors))
+    assert [r["name"] for r in t.poll()] == ["a"]
+    assert t.poll() == []  # nothing new
+    with open(p, "a") as f:
+        f.write(_line(kind="event", name="b", t0=2.0))
+    polled = t.poll()
+    assert [r["name"] for r in polled] == ["b"]
+    assert polled[0]["node"] == "site_0"  # lane stamped from the filename
+
+    # a NEW tailer over the persisted sidecar resumes — no replay of a/b
+    t2 = Tailer(str(tmp_path), cursor_path=str(cursors))
+    assert t2.poll() == []
+    with open(p, "a") as f:
+        f.write(_line(kind="event", name="c", t0=3.0))
+    assert [r["name"] for r in t2.poll()] == ["c"]
+
+
+def test_tailer_rotation_resets_cursor(tmp_path):
+    p = tmp_path / "telemetry.site_0.jsonl"
+    p.write_text(_line(kind="event", name="old_one", t0=1.0)
+                 + _line(kind="event", name="old_two", t0=2.0))
+    t = Tailer(str(tmp_path))
+    assert [r["name"] for r in t.poll()] == ["old_one", "old_two"]
+    # rotation: the lane restarts SMALLER than the cursor (a fresh file
+    # after logrotate/workdir reuse) — the tailer re-reads from 0
+    p.write_text(_line(kind="event", name="new", t0=3.0))
+    assert [r["name"] for r in t.poll()] == ["new"]
+    # a replacement with a different inode resets too, even if it is larger
+    alt = tmp_path / "replacement"
+    alt.write_text(_line(kind="event", name="replaced", t0=4.0)
+                   + _line(kind="event", name="tail", t0=5.0))
+    os.replace(alt, p)
+    polled = [r["name"] for r in t.poll()]
+    assert polled in (["replaced", "tail"], ["tail"])  # ino reuse tolerated
+
+
+def test_tailer_never_consumes_a_torn_tail(tmp_path):
+    p = tmp_path / "telemetry.site_0.jsonl"
+    p.write_text('{"kind":"event","name":"torn","t0":1.0')
+    t = Tailer(str(tmp_path))
+    assert t.poll() == []  # mid-append: not an error, not consumed
+    assert t.truncated_lines == 0
+    with open(p, "a") as f:
+        f.write("}\n" + "{undecodable}\n")
+    polled = t.poll()
+    assert [r["name"] for r in polled] == ["torn"]
+    assert t.truncated_lines == 1  # the undecodable COMPLETE line
+
+
+# ---------------------------------------------------------- verdict rules
+def test_heartbeat_silence_fires_once_and_rearms():
+    st = LiveState(silence_after=5.0)
+    st.ingest([
+        {"kind": "event", "name": Live.HEARTBEAT, "t0": 100.0,
+         "node": "engine", "site": "site_1", "round": 1},
+        {"kind": "span", "name": "engine:round", "t0": 100.0, "dur": 0.5,
+         "node": "engine", "round": 1},
+    ])
+    assert st.check(now=102.0) == []  # fresh
+    # one round of lag is the healthy serial steady state: no verdict even
+    # though the site's lane has aged past the threshold
+    st.ingest([{"kind": "span", "name": "engine:round", "t0": 108.0,
+                "dur": 0.5, "node": "engine", "round": 2}])
+    assert st.check(now=109.0) == []
+    # a SECOND round completes without the site -> silent
+    st.ingest([{"kind": "span", "name": "engine:round", "t0": 109.0,
+                "dur": 0.5, "node": "engine", "round": 3}])
+    fired = st.check(now=110.0)
+    assert [v["verdict"] for v in fired] == [Live.VERDICT_SILENCE]
+    assert fired[0]["site"] == "site_1"
+    assert fired[0]["severity"] == "critical"  # the doctor's vocabulary
+    assert st.check(now=111.0) == []  # edge-triggered: no re-fire
+    assert st.snapshot(now=111.0)["sites"]["site_1"]["status"] == "silent"
+    # the site speaks again -> re-armed -> a later silence fires again
+    st.ingest([
+        {"kind": "event", "name": Live.HEARTBEAT, "t0": 112.0,
+         "node": "engine", "site": "site_1", "round": 4},
+    ])
+    assert st.check(now=112.5) == []
+    st.ingest([{"kind": "span", "name": "engine:round", "t0": 119.5,
+                "dur": 0.5, "node": "engine", "round": 6}])
+    assert [v["verdict"] for v in st.check(now=120.0)] == [
+        Live.VERDICT_SILENCE
+    ]
+
+
+def test_silence_never_fires_against_a_finished_run():
+    """A run whose EVERY lane went quiet is over (or wholly wedged) — the
+    per-site rule must not storm one verdict per site."""
+    st = LiveState(silence_after=5.0)
+    st.ingest([
+        {"kind": "event", "name": Live.HEARTBEAT, "t0": 100.0,
+         "node": "engine", "site": s} for s in ("site_0", "site_1")
+    ])
+    assert st.check(now=500.0) == []
+
+
+def test_remote_heartbeat_feeds_liveness_but_is_not_a_site():
+    """The aggregator's pulse keeps the federation-liveness clock fresh but
+    must not become a per-site row: the doctor's per-site view has no
+    remote entry, and the always-invoked-last aggregator would otherwise be
+    a standing false candidate for the silence verdict."""
+    st = LiveState(silence_after=5.0)
+    st.ingest([
+        {"kind": "event", "name": Live.HEARTBEAT, "t0": 100.0,
+         "node": "engine", "site": "remote", "round": 1},
+        {"kind": "event", "name": Live.HEARTBEAT, "t0": 100.0,
+         "node": "engine", "site": "site_0", "round": 1},
+    ])
+    assert set(st.snapshot(now=100.5)["sites"]) == {"site_0"}
+    assert st.last_event_t == 100.0
+
+
+def test_round_outlier_mfu_collapse_and_retry_storm_rules():
+    st = LiveState(silence_after=30.0, round_outlier=4.0, mfu_collapse=0.3,
+                   retry_storm=3, retry_window=10.0)
+    now = 1000.0
+    rounds = [0.1] * 6 + [1.0]  # the last round blows past the median
+    recs = []
+    for i, dur in enumerate(rounds):
+        recs.append({"kind": "span", "name": "engine:round", "node": "engine",
+                     "t0": now + i, "dur": dur, "round": i + 1})
+    for i, v in enumerate([0.2] * 6 + [0.01]):  # MFU collapses at the end
+        recs.append({"kind": "metric", "name": "mfu", "node": "engine",
+                     "t0": now + i, "value": v})
+    for i in range(3):  # a retry burst inside the window
+        recs.append({"kind": "event", "name": "wire:retry", "node": "remote",
+                     "t0": now + 6 + 0.1 * i})
+    st.ingest(recs)
+    fired = {v["verdict"] for v in st.check(now=now + 7)}
+    assert fired == {Live.VERDICT_ROUND_OUTLIER, Live.VERDICT_MFU_COLLAPSE,
+                     Live.VERDICT_RETRY_STORM}
+    assert st.check(now=now + 7.5) == []  # all edge-triggered
+    # recovery re-arms: a normal round, recovered MFU, drained retry window
+    st.ingest([
+        {"kind": "span", "name": "engine:round", "node": "engine",
+         "t0": now + 8, "dur": 0.1, "round": 9},
+        {"kind": "metric", "name": "mfu", "node": "engine", "t0": now + 8,
+         "value": 0.2},
+    ])
+    assert st.check(now=now + 30) == []
+    assert st.status() == "ok"
+
+
+# ----------------------------------------------------------------- exporters
+def _prom_values(text):
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, value = line.rsplit(" ", 1)
+        out[name] = float(value)
+    return out
+
+
+def _golden_events():
+    """A small synthetic run: 6 rounds, two sites, one anomaly, MFU series
+    — folded into BOTH the live state and the post-hoc doctor report."""
+    events = []
+    for r in range(1, 7):
+        t = 100.0 + r
+        for s in ("site_0", "site_1"):
+            events.append({"kind": "event", "name": Live.HEARTBEAT,
+                           "node": "engine", "site": s, "t0": t,
+                           "round": r})
+        events.append({"kind": "span", "name": "engine:round",
+                       "node": "engine", "t0": t, "dur": 0.5, "round": r})
+        events.append({"kind": "metric", "name": "mfu", "node": "engine",
+                       "t0": t, "value": 0.19, "round": r})
+    events.append({"kind": "event", "name": "anomaly:grad_explosion",
+                   "node": "site_1", "site": "site_1", "t0": 105.5,
+                   "round": 4, "metric": "grad_norm", "value": 99.0})
+    events.append({"kind": "wire", "op": "save", "node": "site_0",
+                   "t0": 103.0, "bytes": 4096, "arrays": 2, "file": "g.npy"})
+    return events
+
+
+def test_metrics_and_healthz_scrape_match_the_doctor(tmp_path):
+    events = _golden_events()
+    st = LiveState(silence_after=30.0)
+    st.ingest(events)
+    st.check(now=106.5)
+    report = build_report(events)
+
+    server = OpsServer(lambda: st.snapshot(now=106.5))
+    try:
+        text = server.scrape("/metrics")
+        hz = json.loads(server.scrape("/healthz"))
+    finally:
+        server.close()
+
+    vals = _prom_values(text)
+    # per-site round, rounds/sec basis, MFU and anomaly counters all match
+    # what `telemetry doctor` reports post-hoc over the SAME records
+    assert vals['coinstac_dinunet_site_round{site="site_0"}'] == 6
+    assert vals['coinstac_dinunet_site_round{site="site_1"}'] == 6
+    assert vals["coinstac_dinunet_rounds_total"] == report["rounds"]["count"]
+    assert vals["coinstac_dinunet_mfu"] == report["metrics"]["mfu"]["last"]
+    assert vals["coinstac_dinunet_anomalies_total"] == len(report["anomalies"])
+    assert (vals['coinstac_dinunet_site_anomalies_total{site="site_1"}']
+            == report["sites"]["site_1"]["anomalies"])
+    assert vals['coinstac_dinunet_wire_bytes_total{op="save"}'] == 4096
+    assert vals["coinstac_dinunet_up"] == 1
+    # every exported name is legal Prometheus material with the stable prefix
+    for name in vals:
+        bare = name.split("{", 1)[0]
+        assert bare.startswith(Live.PROM_PREFIX + "_"), bare
+        assert prometheus_name(bare[len(Live.PROM_PREFIX) + 1:]) == bare
+
+    assert hz["status"] == "ok"
+    assert hz["round"] == 6 and hz["rounds_done"] == 6
+    assert set(hz["sites"]) == {"site_0", "site_1"}
+    assert hz["anomalies"]["total"] == 1
+
+    # unknown paths 404; the direct rendering equals the served one
+    import urllib.error
+    import urllib.request
+
+    server2 = OpsServer(lambda: st.snapshot(now=106.5))
+    try:
+        try:
+            urllib.request.urlopen(server2.url("/nope"), timeout=5)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 404
+    finally:
+        server2.close()
+    assert render_prometheus(st.snapshot(now=106.5)) == text
+
+
+def test_render_board_shows_sites_and_verdicts():
+    st = LiveState(silence_after=5.0)
+    st.ingest(_golden_events())
+    st.ingest([{"kind": "event", "name": "site_died", "node": "engine",
+                "site": "site_1", "t0": 106.8, "round": 6}])
+    board = render_board(st.snapshot(now=107.0), root="/runs/demo")
+    assert "/runs/demo" in board
+    assert "site_0" in board and "site_1" in board
+    assert "DEAD" in board
+    assert "round 6" in board
+
+
+# ---------------------------------------------------------------- acceptance
+def test_hang_fault_fires_silence_verdict_during_live_run(tmp_path):
+    """The ISSUE-10 acceptance gate: a 3-site federation with a chaos
+    ``hang`` killing site_2 at round 3 (quorum keeps the run going) must
+    fire the heartbeat-silence verdict for site_2 WHILE the run is alive,
+    and the final /metrics view must agree with the run's own records."""
+    eng = InProcessEngine(
+        tmp_path, n_sites=3, trainer_cls=XorTrainer, dataset_cls=XorDataset,
+        task_id="xor", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+        batch_size=8, epochs=4, validation_epochs=1, learning_rate=5e-2,
+        input_shape=(2,), seed=11, patience=50, profile=True, site_quorum=2,
+        fault_plan={"faults": [{"kind": "hang", "round": 3,
+                               "site": "site_2"}]},
+    )
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(24):
+            with open(os.path.join(d, f"s_{i * 24 + j}"), "w") as f:
+                f.write("x")
+
+    tailer = Tailer(str(tmp_path), cursor_path=str(tmp_path / "cursors.json"))
+    state = LiveState(silence_after=0.6)
+    silence, fired_mid_run = [], False
+    while not eng.success and eng.rounds < 400:
+        eng.step_round()
+        state.ingest(tailer.poll())
+        new = [v for v in state.check()
+               if v["verdict"] == Live.VERDICT_SILENCE]
+        if new and not silence:
+            fired_mid_run = not eng.success  # the run is provably alive
+        silence += new
+        if eng.dead_sites and not silence:
+            # let the dead site's lane age past the threshold while the
+            # survivors keep the engine lane fresh
+            time.sleep(0.25)
+
+    assert eng.success, f"no SUCCESS after {eng.rounds} rounds"
+    assert eng.dead_sites == {"site_2"}
+    assert silence, "heartbeat-silence verdict never fired"
+    assert fired_mid_run, "verdict only fired after the run exited"
+    assert silence[0]["site"] == "site_2"
+    assert silence[0]["severity"] == "critical"
+
+    # final drain + snapshot: site_2 is dead and stuck rounds behind
+    state.ingest(tailer.poll())
+    snap = state.snapshot()
+    assert snap["dead_sites"] == ["site_2"]
+    assert snap["sites"]["site_2"]["status"] == "dead"
+    assert snap["sites"]["site_2"]["round"] < snap["sites"]["site_0"]["round"]
+    # the live view agrees with the post-hoc merge over the same files
+    events = load_events(str(tmp_path))
+    assert snap["rounds_done"] == sum(
+        1 for e in events
+        if e.get("kind") == "span" and e["name"] == "engine:round"
+    )
+    assert snap["round"] == eng.rounds
+    vals = _prom_values(render_prometheus(snap))
+    assert vals['coinstac_dinunet_site_dead{site="site_2"}'] == 1
+    assert (vals['coinstac_dinunet_verdicts_total{kind="heartbeat_silence"}']
+            >= 1)
+    # heartbeats landed on the engine lane for every surviving invocation
+    beats = [e for e in events if e.get("kind") == "event"
+             and e["name"] == Live.HEARTBEAT]
+    assert {e.get("site") for e in beats} >= {"site_0", "site_1", "remote"}
+
+
+# ----------------------------------------------------------------- watch CLI
+_CHILD = textwrap.dedent("""
+    import json, os, sys, time
+    d = sys.argv[1]
+    os.makedirs(d, exist_ok=True)
+    def emit(node, rec):
+        rec.setdefault("v", 1)
+        with open(os.path.join(d, f"telemetry.{node}.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\\n")
+    for r in range(1, 4):   # both sites beating
+        t = time.time()
+        for s in ("site_0", "site_1"):
+            emit("engine", {"kind": "event", "name": "engine:heartbeat",
+                            "cat": "engine", "t0": t, "site": s, "round": r})
+        emit("engine", {"kind": "span", "name": "engine:round", "t0": t,
+                        "dur": 0.1, "round": r})
+        time.sleep(0.15)
+    for r in range(4, 16):  # site_1 goes dark; the engine keeps going
+        t = time.time()
+        emit("engine", {"kind": "event", "name": "engine:heartbeat",
+                        "cat": "engine", "t0": t, "site": "site_0",
+                        "round": r})
+        emit("engine", {"kind": "span", "name": "engine:round", "t0": t,
+                        "dur": 0.1, "round": r})
+        time.sleep(0.15)
+""")
+
+
+def test_watch_cli_until_exit_asserts_inflight_verdict(tmp_path):
+    from coinstac_dinunet_tpu.telemetry.__main__ import main
+
+    root = tmp_path / "run"
+    snap = tmp_path / "board.txt"
+    metrics = tmp_path / "metrics.prom"
+    hz = tmp_path / "healthz.json"
+    rc = main([
+        "watch", str(root), "--until-exit", "--quiet", "--interval", "0.1",
+        "--silence-after", "0.6", "--serve", "0",
+        "--assert-verdict", Live.VERDICT_SILENCE,
+        "--snapshot", str(snap), "--metrics-out", str(metrics),
+        "--json", str(hz),
+        "--", sys.executable, "-c", _CHILD, str(root),
+    ])
+    assert rc == 0
+
+    board = snap.read_text()
+    assert "site_1" in board and Live.VERDICT_SILENCE in board
+    vals = _prom_values(metrics.read_text())
+    assert vals['coinstac_dinunet_site_round{site="site_0"}'] == 15
+    assert vals['coinstac_dinunet_site_round{site="site_1"}'] == 3
+    assert (vals['coinstac_dinunet_verdicts_total{kind="heartbeat_silence"}']
+            >= 1)
+    snapshot = json.loads(hz.read_text())
+    assert any(v["verdict"] == Live.VERDICT_SILENCE and v["during_run"]
+               for v in snapshot["verdicts"])
+
+
+def test_watch_cli_until_exit_requires_a_command(tmp_path):
+    import pytest
+
+    from coinstac_dinunet_tpu.telemetry.__main__ import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["watch", str(tmp_path), "--until-exit"])
+    assert exc.value.code == 2  # argparse usage error, not a silent no-op
+
+
+def test_watch_cli_assert_fails_when_verdict_never_fires(tmp_path):
+    from coinstac_dinunet_tpu.telemetry.__main__ import main
+
+    root = tmp_path / "run"
+    root.mkdir()
+    (root / "telemetry.site_0.jsonl").write_text(
+        _line(kind="event", name=Live.HEARTBEAT, t0=time.time(),
+              site="site_0", round=1)
+    )
+    rc = main([
+        "watch", str(root), "--quiet",
+        "--assert-verdict", Live.VERDICT_RETRY_STORM,
+    ])
+    assert rc == 3
+
+
+# --------------------------------------------------- recorder time autoflush
+def test_recorder_wall_clock_autoflush(tmp_path):
+    from coinstac_dinunet_tpu.telemetry import Recorder
+
+    cache = {"profile": True, Live.FLUSH_INTERVAL: 0.05}
+    rec = Recorder("t", cache=cache, out_dir=str(tmp_path))
+    rec.event("one")
+    assert load_events(str(tmp_path)) == []  # buffered, deadline not hit
+    time.sleep(0.08)
+    rec.event("two")  # crosses the wall-clock deadline: flushes BOTH
+    assert [e["name"] for e in load_events(str(tmp_path))] == ["one", "two"]
+
+    # 0 disables the timer: size-bounded-only flushing is restored
+    rec2 = Recorder("u", cache={"profile": True, Live.FLUSH_INTERVAL: 0},
+                    out_dir=str(tmp_path / "u"))
+    rec2.event("a")
+    time.sleep(0.06)
+    rec2.event("b")
+    assert load_events(str(tmp_path / "u")) == []
+    rec2.flush()
+    assert len(load_events(str(tmp_path / "u"))) == 2
+
+
+# ------------------------------------------------------------- lint fixtures
+_LIVE_KEYS_FIXTURE = """
+class Metric:
+    GRAD_NORM = "grad_norm"
+
+class Anomaly:
+    NONFINITE = "nonfinite"
+
+class Live:
+    HEARTBEAT = "engine:heartbeat"
+    PROM_PREFIX = "coinstac_dinunet"
+    VERDICT_SILENCE = "heartbeat_silence"
+    FLUSH_INTERVAL = "telemetry_flush_interval_s"
+"""
+
+
+def _tel_findings(source, keys=_LIVE_KEYS_FIXTURE, path="pkg/fixture.py"):
+    from coinstac_dinunet_tpu.analysis.core import Module
+    from coinstac_dinunet_tpu.analysis.telemetry_names import (
+        TelemetryMetricNameRule,
+    )
+
+    rule = TelemetryMetricNameRule(keys_source=textwrap.dedent(keys))
+    src = textwrap.dedent(source)
+    return rule.visit_module(Module(path, src, ast.parse(src)))
+
+
+def test_metric_name_rule_resolves_live_members_in_event_calls():
+    findings = _tel_findings("""
+        from pkg.keys import Live
+
+        def f(rec):
+            rec.event(Live.HEARTBEAT, cat="engine", site="s")  # declared
+            rec.event("free_form_event")                       # literal: fine
+            rec.event(Live.HEARTBEET)                          # typo'd member
+    """)
+    assert len(findings) == 1
+    assert "Live.HEARTBEET" in findings[0].message
+    assert "config/keys.py Live" in findings[0].message
+
+
+def test_metric_name_rule_validates_live_vocabulary_definition():
+    findings = _tel_findings("""
+        class Metric:
+            GRAD_NORM = "grad_norm"
+            BAD = "Grad Norm!"         # would be mangled by the prom mapping
+
+        class Live:
+            HEARTBEAT = "heartbeat"            # lost the engine: prefix
+            PROM_PREFIX = "9coinstac-dinunet"  # illegal prom name
+            VERDICT_SILENCE = "Heartbeat-Silence"  # illegal prom suffix
+            FLUSH_INTERVAL = "telemetry_flush_interval_s"  # fine
+    """)
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 4
+    assert "engine:" in msgs
+    assert "PROM_PREFIX" in msgs
+    assert "VERDICT_SILENCE" in msgs
+    assert "Metric.BAD" in msgs
+
+
+def test_metric_name_rule_keeps_clean_definitions_clean():
+    findings = _tel_findings(_LIVE_KEYS_FIXTURE)
+    assert findings == []
+    # the REAL vocabulary passes its own definition checks
+    import coinstac_dinunet_tpu.config.keys as keys_mod
+    from coinstac_dinunet_tpu.analysis.core import Module
+    from coinstac_dinunet_tpu.analysis.telemetry_names import (
+        TelemetryMetricNameRule,
+    )
+
+    path = keys_mod.__file__
+    with open(path) as f:
+        src = f.read()
+    findings = TelemetryMetricNameRule().visit_module(
+        Module(path, src, ast.parse(src))
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------- disabled-mode cost
+def test_disabled_mode_overhead_includes_heartbeats():
+    """The engines now emit a heartbeat per node invocation — the disabled
+    fast path must absorb it like every other call site (one attribute
+    lookup + one no-op call)."""
+    from coinstac_dinunet_tpu import telemetry
+
+    get_active = telemetry.get_active
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        rec = get_active()
+        rec.event(Live.HEARTBEAT, cat="engine", site="site_0")
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"disabled heartbeat cost {dt:.3f}s for 200k beats"

@@ -242,12 +242,16 @@ def test_disabled_mode_overhead_is_bounded():
     """The no-op fast path: one attribute lookup + one no-op call.  200k
     disabled call sites must stay well under a second (they measure in the
     tens of milliseconds) — a regression here means the disabled path grew
-    real work."""
+    real work.  The per-invocation engine heartbeat (``engine:heartbeat``,
+    the live ops plane's pulse) rides the same bound."""
+    from coinstac_dinunet_tpu.config.keys import Live
+
     get_active = telemetry.get_active
     t0 = time.perf_counter()
     for _ in range(200_000):
         rec = get_active()
         rec.count("steps")
+        rec.event(Live.HEARTBEAT, cat="engine", site="site_0")
         with rec.span("phase"):
             pass
     dt = time.perf_counter() - t0
