@@ -46,12 +46,39 @@ from .core import Finding
 from .proto_ir import build_protocol_ir
 
 #: the fault alphabet the explorer schedules (ISSUE 9 bound; ``slow`` is
-#: protocol-invisible in a lockstep engine and is deliberately absent)
+#: protocol-invisible in a lockstep engine and is deliberately absent).
+#: ``worker_crash``/``worker_restart`` (ISSUE 11) are the DAEMON
+#: supervision actions: the site's long-lived worker process is killed
+#: mid-invocation (crash) or between rounds (restart), the supervisor
+#: restarts it and the invocation re-runs against the engine's
+#: round-tripped cache — so unlike ``crash``/``hang`` the SITE stays
+#: alive, and the invariants verify the supervision contract: a restarted
+#: worker contributes to the round's reduce exactly once (the crashed
+#: attempt's output is discarded by the engine and its payload files are
+#: atomically overwritten by the re-invocation), and a restart during the
+#: relay never wedges the next round.  Counterexamples replay as
+#: ``worker_kill`` chaos plans through a real ``DaemonEngine``
+#: (``tests/test_daemon.py``).
 FAULT_ALPHABET = (
     "crash", "hang", "stale", "reappear",
     "truncate_payload", "corrupt_payload",
     "drop_relay", "duplicate_delivery",
+    "worker_crash", "worker_restart",
 )
+
+#: model action -> replayable chaos fault-plan kind (worker actions map to
+#: the daemon engine's worker_kill fault with the matching kill point)
+_WORKER_ACTIONS = {"worker_crash": "invoke", "worker_restart": "idle"}
+
+#: broken-supervisor semantics switch (tests only): a mis-implemented
+#: daemon supervisor might REDELIVER the crashed worker's previous output
+#: instead of re-invoking the node — exactly the stale-delivery class the
+#: round-stamp invariant exists to catch.  ``tests/test_model_check.py``
+#: flips this to prove the worker_crash action is checkable, not vacuous:
+#: with the ``wire_round`` stamp intact the protocol refuses the
+#: redelivery loudly; with the stamp fact flipped, STALE_CONTRIBUTION
+#: fires with a worker_kill counterexample plan.
+_RESTART_REDELIVERS_LAST_OUTPUT = False
 
 #: broadcast-channel components a relay fault can target
 _COMPONENTS = ("payload", "manifest")
@@ -151,7 +178,12 @@ def _plan_faults(trace, avg_file, manifest_file):
         rnd, kind, site = e[0], e[1], e[2]
         comp = e[3] if len(e) > 3 else None
         entry = {"kind": kind, "round": int(rnd), "site": f"site_{site}"}
-        if kind in ("truncate_payload", "corrupt_payload"):
+        if kind in _WORKER_ACTIONS:
+            # the executable counterpart is the daemon engine's
+            # worker_kill fault at the matching kill point
+            entry["kind"] = "worker_kill"
+            entry["when"] = _WORKER_ACTIONS[kind]
+        elif kind in ("truncate_payload", "corrupt_payload"):
             entry["file"] = "grads.npy"
         elif comp is not None:
             entry["file"] = manifest_file if comp == "manifest" else avg_file
@@ -366,6 +398,31 @@ class _Explorer:
         )
         msg_keys = bcast[1] if bcast else frozenset()
         steady = had_comp and incoming == "computation"
+        # worker_crash / worker_restart (ISSUE 11): the site's DAEMON
+        # WORKER dies (mid-invocation / between rounds) but the SITE does
+        # not.  worker_crash: the crashed attempt half-ran — its cache/
+        # wire events executed against the engine's round-tripped cache —
+        # then the supervisor restarts the worker and RE-INVOKES, so the
+        # invocation's events run a SECOND time below and only the
+        # re-invocation's output is delivered (exactly once into the
+        # reduce).  worker_restart (between rounds) loses only worker-
+        # local state; the protocol state lives entirely in the engine's
+        # round-trip, so the next invocation proceeds unchanged.  The
+        # invariant sweep judges every path carrying these actions
+        # (exactly-once contributions/updates, deadlock freedom), and the
+        # broken-supervisor switch above pins the check as non-vacuous.
+        if "worker_crash" in my_faults:
+            if _RESTART_REDELIVERS_LAST_OUTPUT:
+                if last is not None:
+                    phase, keys, contrib, _ = last
+                    return site, chan, (phase, keys, contrib, False), None
+            else:
+                _, cache_crash, anyw_crash = self._exec_events(
+                    self.ir.local, site, executed, incoming, msg_keys,
+                    steady, scenario, trace,
+                )
+                site = (alive, redeliver, applied, cache_crash, anyw_crash,
+                        had_comp, last)
         produced, cache, any_w = self._exec_events(
             self.ir.local, site, executed, incoming, msg_keys, steady,
             scenario, trace,

@@ -416,6 +416,49 @@ class Live:
     VERDICT_RETRY_STORM = "wire_retry_storm"
 
 
+class Daemon:
+    """Vocabulary for the persistent engine daemon
+    (:mod:`coinstac_dinunet_tpu.federation.daemon` — one long-lived warm
+    worker process per site + one for the aggregator, fed invocations over
+    a framed JSON pipe instead of paying interpreter start, imports and
+    jit compilation every round).
+
+    Plain ``str`` constants, mirroring :class:`Retry`.  Two families:
+
+    Cache keys (knobs — resolved per target over the same arg channels as
+    the ``invoke_retry_*`` keys, ``engine.py::_target_config``):
+
+    - ``RESTART_*`` — the worker *supervision* retry policy
+      (:meth:`~..resilience.retry.RetryPolicy.for_worker`): a crashed or
+      wedged worker is killed and RESTARTED (not declared a dead site)
+      up to ``RESTART_ATTEMPTS`` times per invocation, with exponential
+      backoff.  Defaults ON (3 attempts) — restarting a warm worker is
+      side-effect-free at the node level (the node's durable state lives
+      in the engine's round-tripped cache + on disk), unlike re-invoking
+      a node, which stays opt-in via ``invoke_retry_*``.
+
+    Event names (the daemon's observability feed — ``cat="daemon"`` on
+    the engine telemetry lane, consumed by ``telemetry watch``/
+    ``/metrics``/``/healthz`` and `telemetry doctor`):
+
+    - ``EVENT_START`` — a target's first worker process came up (carries
+      pid + warm-up ms).
+    - ``EVENT_RESTART`` — the supervisor replaced a dead/wedged worker
+      (carries pid, generation, and the error that killed the last one).
+      The live ops plane counts these per site (``worker_restarts``).
+    - ``EVENT_SHUTDOWN`` — orderly worker shutdown at engine close.
+    """
+
+    RESTART_ATTEMPTS = "worker_restart_attempts"
+    RESTART_BASE_DELAY = "worker_restart_base_delay"
+    RESTART_MAX_DELAY = "worker_restart_max_delay"
+    RESTART_DEADLINE = "worker_restart_deadline"
+
+    EVENT_START = "worker:start"
+    EVENT_RESTART = "worker:restart"
+    EVENT_SHUTDOWN = "worker:shutdown"
+
+
 class Capture:
     """Cache-key vocabulary for anomaly-triggered profiler capture
     (:mod:`coinstac_dinunet_tpu.telemetry.capture`).
@@ -487,6 +530,11 @@ class ModelCheck:
       site exactly once (never silently replaced by a stale delivery).
     - ``UNRECOVERABLE`` — a single transient relay fault never kills a
       site or the run while wire retries + chaos heal are in play.
+      The daemon supervision actions (``worker_crash``/``worker_restart``
+      in the fault alphabet — ISSUE 11) are checked against the same
+      vocabulary: a restarted worker must contribute exactly once and a
+      restart during the relay must never wedge the round; their
+      counterexamples replay as ``worker_kill`` chaos plans.
     - ``CACHE`` / ``VOLATILE`` — path-sensitive cache write-before-read
       and volatile-key hygiene over the explored executions.
     - ``WIRE`` — every wire key produced on an explored path is consumed

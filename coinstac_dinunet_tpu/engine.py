@@ -36,6 +36,13 @@ from .utils.utils import performance_improved_, stop_training_
 from .vision import plotter
 
 
+class InvokeTimeout(RuntimeError):
+    """A fresh-process (or daemon-worker) node invocation exceeded the
+    engine's ``timeout``.  Typed so the retry/quorum machinery and
+    ``telemetry doctor`` can attribute the failure; the message carries the
+    partial stderr the process wrote before it was killed."""
+
+
 def load_inputspec(path, site_index=None):
     """Parse a COINSTAC simulator ``inputspec.json`` into plain args.
 
@@ -82,7 +89,18 @@ def _engine_recorder(eng, chans):
         return rec
 
     def on(d):
-        return isinstance(d, dict) and (d.get("profile") or d.get("telemetry"))
+        # like _quorum_configured, the flag may sit nested in a ``*_args``
+        # tier of a fresh-process engine's first_input — without this,
+        # round-1 events (worker:start, the INIT invoke spans) would land
+        # on a null recorder until the flag round-trips through the cache
+        if not isinstance(d, dict):
+            return False
+        if d.get("profile") or d.get("telemetry"):
+            return True
+        return any(
+            isinstance(v, dict) and (v.get("profile") or v.get("telemetry"))
+            for k, v in d.items() if str(k).endswith("_args")
+        )
 
     if any(on(c) for c in chans):
         eng._telemetry_rec = telemetry.Recorder("engine", out_dir=eng.workdir)
@@ -237,18 +255,18 @@ class InProcessEngine:
         )
 
     # ---------------------------------------------------------- invoke retry
-    def _invoke_policy(self, target):
-        """The invocation retry policy for ONE target, resolved over that
-        target's own arg channels so a retry opt-in scoped to one site via
-        ``site_args``/``inputspec`` never silently applies to another
-        (re-invoking a node has side effects the operator opts into
-        per-site).  Site priority mirrors node construction: ``site_args``
-        > engine ``**args`` > ``site_spec``, then the round-tripped cache
-        and the fresh-process ``first_input``.  The remote scans every
-        channel (mirroring ``_quorum_configured``) because its config can
-        only arrive via a site's ``first_input`` before round 1 freezes
+    def _target_config(self, target):
+        """Merged configuration for ONE target, resolved over that target's
+        own arg channels so a knob scoped to one site via
+        ``site_args``/``inputspec`` never silently applies to another.
+        Site priority mirrors node construction: ``site_args`` > engine
+        ``**args`` > ``site_spec``, then the round-tripped cache and the
+        fresh-process ``first_input``.  The remote scans every channel
+        (mirroring ``_quorum_configured``) because its config can only
+        arrive via a site's ``first_input`` before round 1 freezes
         ``shared_args`` into its cache.  Nested ``*_args`` tiers count.
-        Default is 1 attempt (retry off)."""
+        Shared by the invoke retry policy and the daemon engine's worker
+        restart policy (:mod:`.federation.daemon`)."""
         if target == "remote":
             chans = [self.args, self.remote_cache,
                      *self.site_args.values(), *self.site_spec.values(),
@@ -269,7 +287,13 @@ class InProcessEngine:
                         cfg.setdefault(k2, v2)
                 else:
                     cfg.setdefault(k, v)
-        return RetryPolicy.for_invoke(cfg)
+        return cfg
+
+    def _invoke_policy(self, target):
+        """The invocation retry policy for ONE target (re-invoking a node
+        has side effects the operator opts into per-site — default is 1
+        attempt, retry off)."""
+        return RetryPolicy.for_invoke(self._target_config(target))
 
     def _invoke_with_retry(self, policy, attempt_fn, target, rec):
         """Run one node invocation under the retry policy: every retry first
@@ -365,6 +389,53 @@ class InProcessEngine:
                     continue
                 wire_transport.atomic_copy(src, dst)
 
+    # -------------------------------------------------------- invocation hooks
+    # ``step_round`` below is the ONE engine round template every serial
+    # engine shares (in-process, fresh-process, daemon): the chaos replay
+    # faults, the invoke retry policy, quorum dropout, heartbeats, payload
+    # faults and the relay broadcast all live there exactly once.  What
+    # differs per engine is only HOW one node invocation attempt runs —
+    # these three hooks.
+
+    def _site_input(self, s):
+        """The input dict for this round's invocation of site ``s``
+        (computed ONCE per round, before the retry loop, so every retry
+        attempt sees identical input)."""
+        return self.site_inputs[s]
+
+    def _site_attempt(self, rnd, s, inp, rec):
+        """ONE invocation attempt of site ``s``; returns its output dict.
+        Raises on failure (the retry policy and quorum machinery in
+        ``step_round`` handle it)."""
+        self.chaos.invoke_fault(rnd, s, rec)
+        node = COINNLocal(
+            cache=self.site_caches[s], input=inp, state=self.site_states[s],
+            **{**self.site_spec.get(s, {}), **self.args,
+               **self.site_args.get(s, {})},
+        )
+        with rec.span(f"invoke:{s}", cat="invoke"):
+            return node(
+                trainer_cls=self.trainer_cls,
+                dataset_cls=self.dataset_cls,
+                datahandle_cls=self.datahandle_cls,
+                learner_cls=self.learner_cls,
+            )["output"]
+
+    def _remote_attempt(self, rnd, site_outs, rec):
+        """ONE aggregator invocation attempt; returns its output dict and
+        records ``success``."""
+        self.chaos.invoke_fault(rnd, "remote", rec)
+        remote = COINNRemote(
+            cache=self.remote_cache, input=site_outs, state=self.remote_state,
+        )
+        with rec.span("invoke:remote", cat="invoke"):
+            result = remote(
+                trainer_cls=self.remote_trainer_cls,
+                reducer_cls=self.reducer_cls,
+            )
+        self.success = bool(result.get("success"))
+        return result["output"]
+
     def step_round(self):
         """One full engine round: every site computes, files relay to the
         aggregator, the aggregator computes, its output + files relay back."""
@@ -379,30 +450,18 @@ class InProcessEngine:
                     site_outs[s] = replay
                     continue
                 policy = self._invoke_policy(s)
+                inp = self._site_input(s)
 
-                def attempt(s=s):
-                    self.chaos.invoke_fault(rnd, s, rec)
-                    node = COINNLocal(
-                        cache=self.site_caches[s],
-                        input=self.site_inputs[s],
-                        state=self.site_states[s],
-                        **{**self.site_spec.get(s, {}), **self.args,
-                           **self.site_args.get(s, {})},
-                    )
-                    with rec.span(f"invoke:{s}", cat="invoke"):
-                        return node(
-                            trainer_cls=self.trainer_cls,
-                            dataset_cls=self.dataset_cls,
-                            datahandle_cls=self.datahandle_cls,
-                            learner_cls=self.learner_cls,
-                        )
+                def attempt(s=s, inp=inp):
+                    return self._site_attempt(rnd, s, inp, rec)
 
                 try:
-                    result = self._invoke_with_retry(policy, attempt, s, rec)
+                    site_outs[s] = self._invoke_with_retry(
+                        policy, attempt, s, rec
+                    )
                 except Exception as exc:  # noqa: BLE001 — see _site_failure
                     self._site_failure(s, exc, attempts=policy.last_attempts)
                     continue
-                site_outs[s] = result["output"]
                 # liveness pulse for the live ops plane (telemetry/live.py):
                 # a site that stops completing invocations stops beating
                 rec.event(Live.HEARTBEAT, cat="engine", site=s)
@@ -419,24 +478,12 @@ class InProcessEngine:
                     f"{self.site_failures}"
                 )
 
-            def remote_attempt():
-                self.chaos.invoke_fault(rnd, "remote", rec)
-                remote = COINNRemote(
-                    cache=self.remote_cache, input=site_outs,
-                    state=self.remote_state,
-                )
-                with rec.span("invoke:remote", cat="invoke"):
-                    return remote(
-                        trainer_cls=self.remote_trainer_cls,
-                        reducer_cls=self.reducer_cls,
-                    )
-
-            result = self._invoke_with_retry(
-                self._invoke_policy("remote"), remote_attempt, "remote", rec,
+            remote_out = self._invoke_with_retry(
+                self._invoke_policy("remote"),
+                lambda: self._remote_attempt(rnd, site_outs, rec),
+                "remote", rec,
             )
             rec.event(Live.HEARTBEAT, cat="engine", site="remote")
-            remote_out = result["output"]
-            self.success = bool(result.get("success"))
             self.last_remote_out = remote_out
 
             with rec.span("engine:relay", cat="relay"):
@@ -499,17 +546,39 @@ class SubprocessEngine(InProcessEngine):
         self.first_input = first_input
         self._first_done = set()
 
-    def _invoke(self, script, payload):
+    def _invoke(self, script, payload, target=None, rec=None):
         import json
         import subprocess
         import sys
 
-        res = subprocess.run(
-            [sys.executable, script],
-            input=json.dumps(utils.clean_recursive(payload)),
-            capture_output=True, text=True, env=self.env,
-            timeout=self.timeout,
-        )
+        try:
+            res = subprocess.run(
+                [sys.executable, script],
+                input=json.dumps(utils.clean_recursive(payload)),
+                capture_output=True, text=True, env=self.env,
+                timeout=self.timeout,
+            )
+        except subprocess.TimeoutExpired as exc:
+            # a wedged node used to propagate as a raw TimeoutExpired with
+            # no telemetry attribution and no stderr — map it to a typed
+            # failure carrying the partial stderr the process managed to
+            # write, and land an ``invoke:timeout`` event so `telemetry
+            # doctor` can attribute the death (the retry/quorum machinery
+            # in step_round treats it exactly like any other site failure)
+            stderr = exc.stderr or ""
+            if isinstance(stderr, bytes):
+                stderr = stderr.decode("utf-8", "replace")
+            if rec is not None:
+                rec.event(
+                    "invoke:timeout", cat="invoke", target=str(target),
+                    timeout_s=float(self.timeout), script=str(script),
+                    stderr=stderr[-1000:],
+                )
+            raise InvokeTimeout(
+                f"{script} timed out after {self.timeout}s"
+                f"{f' (target {target})' if target else ''}\n"
+                f"--- partial stderr ---\n{stderr[-4000:]}"
+            ) from exc
         if res.returncode != 0:
             raise RuntimeError(
                 f"{script} exited rc={res.returncode}\n--- stderr ---\n"
@@ -528,78 +597,39 @@ class SubprocessEngine(InProcessEngine):
             f"{res.stdout[-2000:]}"
         )
 
-    def step_round(self):
-        rec = self._recorder()
-        rnd = self.rounds + 1
-        rec.set_context(round=rnd)
-        site_outs = {}
-        with self.chaos.activate(rec), rec.span("engine:round", cat="engine"):
-            for s in self._alive_site_ids():
-                replay = self._stale_replay(rnd, s, rec)
-                if replay is not None:
-                    site_outs[s] = replay
-                    continue
-                policy = self._invoke_policy(s)
-                inp = dict(self.site_inputs[s])
-                if s not in self._first_done:
-                    inp.update(self.first_input.get(s, {}))
-                    self._first_done.add(s)
+    # --------------------------------------------------------- template hooks
+    def _site_input(self, s):
+        inp = dict(self.site_inputs[s])
+        if s not in self._first_done:
+            inp.update(self.first_input.get(s, {}))
+            self._first_done.add(s)
+        return inp
 
-                def attempt(s=s, inp=inp):
-                    # a hung process produces no output until the timeout
-                    # kills it — the chaos hang raises in its place
-                    self.chaos.invoke_fault(rnd, s, rec)
-                    with rec.span(f"invoke:{s}", cat="invoke"):
-                        return self._invoke(self.local_script, {
-                            "cache": self.site_caches[s], "input": inp,
-                            "state": self.site_states[s],
-                        })
+    def _site_attempt(self, rnd, s, inp, rec):
+        # a hung process produces no output until the timeout kills it —
+        # the chaos hang raises in its place
+        self.chaos.invoke_fault(rnd, s, rec)
+        with rec.span(f"invoke:{s}", cat="invoke"):
+            res = self._invoke(self.local_script, {
+                "cache": self.site_caches[s], "input": inp,
+                "state": self.site_states[s],
+            }, target=s, rec=rec)
+        self.site_caches[s] = res.get("cache", {})
+        return res["output"]
 
-                try:
-                    res = self._invoke_with_retry(policy, attempt, s, rec)
-                except Exception as exc:  # noqa: BLE001 — see _site_failure
-                    self._site_failure(s, exc, attempts=policy.last_attempts)
-                    continue
-                self.site_caches[s] = res.get("cache", {})
-                site_outs[s] = res["output"]
-                rec.event(Live.HEARTBEAT, cat="engine", site=s)
-                self.chaos.payload_faults(
-                    rnd, s, self.site_states[s]["transferDirectory"], rec
-                )
-
-            self._finish_site_outputs(rnd, site_outs, rec)
-            if not site_outs:
-                raise RuntimeError(
-                    "every site died; nothing to aggregate — failures: "
-                    f"{self.site_failures}"
-                )
-
-            def remote_attempt():
-                # fresh-process nodes load payloads OUTSIDE this process, so
-                # a corrupt payload fails the whole invocation: the retry
-                # (which first heals pending chaos damage) is the recovery
-                self.chaos.invoke_fault(rnd, "remote", rec)
-                with rec.span("invoke:remote", cat="invoke"):
-                    return self._invoke(self.remote_script, {
-                        "cache": self.remote_cache, "input": site_outs,
-                        "state": self.remote_state,
-                    })
-
-            res = self._invoke_with_retry(
-                self._invoke_policy("remote"), remote_attempt, "remote", rec,
-            )
-            rec.event(Live.HEARTBEAT, cat="engine", site="remote")
-            self.remote_cache = res.get("cache", {})
-            remote_out = res["output"]
-            self.success = bool(res.get("success"))
-            self.last_remote_out = remote_out
-
-            with rec.span("engine:relay", cat="relay"):
-                self._relay_broadcast(rnd, rec)
-        rec.flush()
-        self.site_inputs = {s: dict(remote_out) for s in self._alive_site_ids()}
-        self.rounds += 1
-        return site_outs, remote_out
+    def _remote_attempt(self, rnd, site_outs, rec):
+        # fresh-process nodes load payloads OUTSIDE this process, so a
+        # corrupt payload fails the whole invocation: the retry (which
+        # first heals pending chaos damage) is the recovery
+        self.chaos.invoke_fault(rnd, "remote", rec)
+        with rec.span("invoke:remote", cat="invoke"):
+            res = self._invoke(self.remote_script, {
+                "cache": self.remote_cache, "input": site_outs,
+                "state": self.remote_state,
+            }, target="remote", rec=rec)
+        self.remote_cache = res.get("cache", {})
+        self.success = bool(res.get("success"))
+        return res["output"]
 
 
 class MeshEngine:

@@ -17,15 +17,22 @@ is the scale inversion (ROADMAP item 1):
   hierarchical tree-reduce (``cache['reduce_fanin']``) streams the
   aggregator fan-in through the atomic transport instead of
   materializing all ``n_sites`` payloads.
+- :mod:`.daemon` — :class:`DaemonEngine`: the fresh-process deployment
+  without its per-invocation cold start — one long-lived warm worker
+  process per site (+ aggregator) over a framed JSON pipe, supervised
+  restarts (``worker:restart``) instead of dead sites, the node scripts
+  and the cache/input/state contract untouched.
 
 Benchmark: ``scripts/bench_federation.py`` (headline: rounds/sec at 1,000
 simulated sites, ledgered for ``telemetry doctor`` regression verdicts).
 See docs/FEDERATION.md for the operator guide.
 """
+from .daemon import DaemonEngine  # noqa: F401
 from .engine import SiteVectorizedEngine  # noqa: F401
 from .vector import SiteVectorizedFederation, resolve_site_shards  # noqa: F401
 
 __all__ = [
+    "DaemonEngine",
     "SiteVectorizedEngine",
     "SiteVectorizedFederation",
     "resolve_site_shards",

@@ -22,7 +22,9 @@ Fault plan schema (a dict, or a path to a JSON file)::
         {"kind": "duplicate_delivery","round": 4, "site": "site_1",
          "file": "avg_grads.npy"},
         {"kind": "stale",    "round": 2, "site": "site_1"},
-        {"kind": "reappear", "round": 3, "site": "site_2"}
+        {"kind": "reappear", "round": 3, "site": "site_2"},
+        {"kind": "worker_kill", "round": 3, "site": "site_1",
+         "when": "invoke"}
     ]}
 
 ``stale`` replays the site's previous round output in place of a fresh
@@ -30,7 +32,11 @@ invocation (a delayed duplicate of the site→aggregator message);
 ``reappear`` kills the site permanently at the pinned round and redelivers
 its stale last output ONE round later — the dropped-site-reappears
 scenario.  Both are the tier-4 model checker's counterexample vocabulary
-(``dinulint --model``, docs/ANALYSIS.md "Tier 4").
+(``dinulint --model``, docs/ANALYSIS.md "Tier 4").  ``worker_kill``
+SIGKILLs a daemon engine's long-lived worker process (``when`` picks the
+kill point: mid-invocation or between rounds) — the supervision drill
+whose expected outcome is a ``worker:restart``, never a dead site; serial
+per-invocation engines ignore it.
 
 Optional per-fault keys: ``times`` (how many firings before the fault heals;
 default 1 for payload/relay faults, *permanent* for crash/hang — a hung
@@ -65,6 +71,7 @@ FAULT_KINDS = (
     "truncate_payload", "corrupt_payload",
     "drop_relay", "duplicate_delivery",
     "stale", "reappear",
+    "worker_kill",
 )
 _INVOKE_KINDS = ("crash", "hang", "slow")
 _PAYLOAD_KINDS = ("truncate_payload", "corrupt_payload")
@@ -80,6 +87,15 @@ _RELAY_KINDS = ("drop_relay", "duplicate_delivery")
 #:   output is redelivered — the dropped-site-reappears scenario whose
 #:   stale payload only the aggregator's roster filtering can reject.
 _REPLAY_KINDS = ("stale", "reappear")
+#: daemon-only process fault (``federation/daemon.py``): SIGKILL the
+#: target's long-lived worker process.  ``when`` picks the kill point:
+#: ``"invoke"`` (default) kills it mid-invocation — the supervisor must
+#: restart it and re-run the invocation within the same round; ``"idle"``
+#: kills it between rounds (during the relay) — the next round's
+#: invocation finds it dead and restarts it.  Either way the SITE
+#: survives: worker death is a supervision event, not a quorum event.
+#: Serial per-invocation engines have no worker processes and ignore it.
+_WORKER_KINDS = ("worker_kill",)
 #: bytes XOR-flipped at the payload tail by corrupt_payload (data section —
 #: past any header/manifest bytes, so the CRC check is what catches it)
 _CORRUPT_TAIL = 8
@@ -115,7 +131,7 @@ class Fault:
     """One pinned fault from the plan."""
 
     __slots__ = ("kind", "round", "site", "file", "times", "seconds",
-                 "heal_after", "fired")
+                 "heal_after", "when", "fired")
 
     def __init__(self, spec, index):
         if not isinstance(spec, dict):
@@ -135,7 +151,7 @@ class Fault:
         self.site = str(spec["site"]) if spec.get("site") is not None else None
         self.file = str(spec["file"]) if spec.get("file") is not None else None
         if self.site is None and self.kind in (
-            _INVOKE_KINDS + _PAYLOAD_KINDS + _REPLAY_KINDS
+            _INVOKE_KINDS + _PAYLOAD_KINDS + _REPLAY_KINDS + _WORKER_KINDS
         ):
             raise ValueError(
                 f"fault[{index}] ({self.kind}): 'site' is required"
@@ -156,6 +172,13 @@ class Fault:
         )
         self.seconds = float(spec.get("seconds", 0.25))
         self.heal_after = int(spec.get("heal_after", 1))
+        self.when = str(spec.get("when", "invoke"))
+        if self.kind in _WORKER_KINDS and self.when not in ("invoke", "idle"):
+            raise ValueError(
+                f"fault[{index}] ({self.kind}): 'when' must be 'invoke' "
+                f"(kill mid-invocation) or 'idle' (kill between rounds), "
+                f"got {self.when!r}"
+            )
         self.fired = 0
 
     def matches(self, rnd, site=None):
@@ -239,6 +262,9 @@ class _NullChaos:
         return None
 
     def stale_fault(self, rnd, site, rec):
+        return None
+
+    def worker_fault(self, rnd, site, rec, when="invoke"):
         return None
 
     def reappear_deliveries(self, rnd, rec):
@@ -345,6 +371,26 @@ class ChaosSession:
             if not (fault.matches(rnd, site) and fault.can_fire()):
                 continue
             self._fire(fault, rec)
+            return fault
+        return None
+
+    def worker_fault(self, rnd, site, rec, when="invoke"):
+        """A matching ``worker_kill`` fault for this (round, site, kill
+        point), or None.  The DAEMON engine acts on it (SIGKILLs the
+        target's live worker process — ``federation/daemon.py``); serial
+        per-invocation engines never call this hook.  ``when="invoke"``
+        faults fire right before the request is written (the worker dies
+        mid-round, the supervisor restarts it and re-invokes);
+        ``when="idle"`` faults fire at the round's relay barrier (the
+        next invocation finds the worker dead)."""
+        for fault in self.faults:
+            if fault.kind not in _WORKER_KINDS:
+                continue
+            if fault.when != str(when):
+                continue
+            if not (fault.matches(rnd, site) and fault.can_fire()):
+                continue
+            self._fire(fault, rec, when=fault.when)
             return fault
         return None
 

@@ -21,7 +21,7 @@ runs (and their golden comparisons) are deterministic.
 import threading
 import time
 
-from ..config.keys import Retry
+from ..config.keys import Daemon, Retry
 
 # stats sinks are plain cache dicts shared with the caller thread (and, at
 # the aggregator fan-in, across pool threads) — one lock keeps increments
@@ -32,6 +32,12 @@ _NOTE_LOCK = threading.Lock()
 WIRE_DEFAULTS = dict(attempts=3, base_delay=0.05, max_delay=2.0, deadline=30.0)
 #: invocation defaults: retry is side-effectful — OFF until configured
 INVOKE_DEFAULTS = dict(attempts=1, base_delay=0.5, max_delay=30.0,
+                       deadline=None)
+#: daemon-worker supervision defaults: restarting a warm worker is
+#: side-effect-free at the node level (its durable state lives in the
+#: engine's round-tripped cache + on disk), so restart is ON by default —
+#: a crashed worker is a process to replace, not a site to bury
+WORKER_DEFAULTS = dict(attempts=3, base_delay=0.1, max_delay=5.0,
                        deadline=None)
 
 
@@ -112,6 +118,20 @@ class RetryPolicy:
             (Retry.INVOKE_ATTEMPTS, Retry.INVOKE_BASE_DELAY,
              Retry.INVOKE_MAX_DELAY, Retry.INVOKE_DEADLINE),
             INVOKE_DEFAULTS,
+        )
+
+    @classmethod
+    def for_worker(cls, cache):
+        """Daemon-worker supervision policy
+        (:mod:`~..federation.daemon`): how many times a crashed/wedged
+        long-lived worker is killed and restarted per invocation before
+        the failure surfaces to the (separate, default-off) invoke retry
+        and quorum machinery.  Defaults ON (3 attempts)."""
+        return cls._from_cache(
+            cache,
+            (Daemon.RESTART_ATTEMPTS, Daemon.RESTART_BASE_DELAY,
+             Daemon.RESTART_MAX_DELAY, Daemon.RESTART_DEADLINE),
+            WORKER_DEFAULTS,
         )
 
     # -------------------------------------------------------------- behavior

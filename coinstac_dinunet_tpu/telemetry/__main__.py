@@ -141,6 +141,12 @@ def build_watch_parser():
                    action="append",
                    help="exit 3 unless this verdict kind fired WHILE the "
                         "run was alive (repeatable; e.g. heartbeat_silence)")
+    p.add_argument("--assert-event", default=None, metavar="NAME",
+                   action="append",
+                   help="exit 3 unless this telemetry event name was "
+                        "observed WHILE the run was alive (repeatable; "
+                        "e.g. worker:restart — the daemon chaos drill's "
+                        "live supervision gate)")
     p.add_argument("--quiet", action="store_true",
                    help="suppress the periodic board on stdout (the final "
                         "board still prints / lands in --snapshot)")
@@ -178,12 +184,6 @@ def watch_main(argv=None):
         print(f"serving /metrics + /healthz on {server.url('')}",
               file=sys.stderr)
 
-    child = None
-    if command:
-        import subprocess
-
-        child = subprocess.Popen(command)
-
     clear = sys.stdout.isatty() and not args.quiet
 
     def emit_board():
@@ -196,18 +196,37 @@ def watch_main(argv=None):
             sys.stdout.write(board + "\n" + "-" * 72 + "\n")
         sys.stdout.flush()
 
+    asserted_events = list(args.assert_event or ())
+    events_during_run = set()
+
     def step(during_run):
         """One poll + rule evaluation; stamps verdicts fired while the run
         (the child, or an unconditioned follow) was still alive."""
+        before = {n: state.event_counts.get(n, 0) for n in asserted_events}
         records = tailer.poll()
         state.truncated_lines = tailer.truncated_lines
         state.ingest(records)
+        if during_run:
+            for n in asserted_events:
+                if state.event_counts.get(n, 0) > before[n]:
+                    events_during_run.add(n)
         for v in state.check():
             v["during_run"] = bool(during_run)
             line = (f"!! [{v['severity']}] {v['verdict']}"
                     + (f" [{v.get('site')}]" if v.get("site") else "")
                     + f" — {v['cause']}: {v['evidence']}")
             print(line, file=sys.stderr)
+
+    child = None
+    if command:
+        import subprocess
+
+        # drain whatever is ALREADY in the workdir before the child
+        # spawns: records left by an earlier run in a reused directory
+        # land with during_run=False, so they can never satisfy
+        # --assert-event/--assert-verdict on behalf of the new run
+        step(during_run=False)
+        child = subprocess.Popen(command)
 
     t_start = time.monotonic()
     rc = 0
@@ -271,6 +290,17 @@ def watch_main(argv=None):
             return 3
         print(f"asserted: '{kind}' fired in-flight "
               f"({len(hits)} occurrence(s))", file=sys.stderr)
+    for name in asserted_events:
+        total = state.event_counts.get(name, 0)
+        if name not in events_during_run:
+            print(
+                f"ASSERT FAILED: event '{name}' was not observed while "
+                f"the run was alive ({total} observed overall)",
+                file=sys.stderr,
+            )
+            return 3
+        print(f"asserted: '{name}' observed in-flight "
+              f"({total} occurrence(s))", file=sys.stderr)
     return rc
 
 

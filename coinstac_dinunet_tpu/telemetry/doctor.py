@@ -251,10 +251,7 @@ def _mfu_floor_data(bench_history, metrics, threshold):
     }
 
 
-def _bench_verdict_data(bench_history, threshold):
-    if not bench_history or len(bench_history) < 2:
-        return None
-    prev, last = bench_history[-2], bench_history[-1]
+def _bench_pair_data(prev, last, threshold):
     pv, lv = prev.get("value"), last.get("value")
     if not (_finite(pv) and _finite(lv)) or float(pv) <= 0:
         return None
@@ -264,12 +261,43 @@ def _bench_verdict_data(bench_history, threshold):
         "drop_pct": round(100.0 * drop, 1),
         "regressed": drop > threshold,
         "threshold_pct": round(100.0 * threshold, 1),
-        # ledgers are per-metric files (BENCH_HISTORY.jsonl,
-        # BENCH_FEDERATION_HISTORY.jsonl, ...): name the unit so the
-        # verdict reads correctly for any of them
+        # name the metric + unit so the verdict reads correctly for any
+        # ledger (samples/sec/chip, rounds/sec, per-engine series, ...)
         "metric": str(last.get("metric") or "bench"),
         "unit": str(last.get("unit") or "samples/sec/chip"),
     }
+
+
+def _bench_verdict_data(bench_history, threshold):
+    """Latest same-metric pair comparison over a possibly mixed-metric
+    ledger (the engine A/B appends one line per engine kind into
+    BENCH_FEDERATION_HISTORY.jsonl — diffing a daemon entry against a
+    vectorized one would be apples vs oranges).  EVERY metric's latest
+    pair is evaluated; the verdict surfaces the worst regression, or the
+    final entry's metric comparison when nothing regressed."""
+    if not bench_history or len(bench_history) < 2:
+        return None
+    latest_pairs = {}  # metric -> (prev, last), walking oldest -> newest
+    for e in bench_history:
+        m = e.get("metric")
+        prev_last = latest_pairs.get(m)
+        latest_pairs[m] = ((prev_last[1] if prev_last else None), e)
+    candidates = {}
+    for m, (prev, last) in latest_pairs.items():
+        if prev is None:
+            continue
+        data = _bench_pair_data(prev, last, threshold)
+        if data is not None:
+            candidates[m] = data
+    if not candidates:
+        return None
+    regressed = [d for d in candidates.values() if d["regressed"]]
+    if regressed:
+        return max(regressed, key=lambda d: d["drop_pct"])
+    return candidates.get(
+        bench_history[-1].get("metric"),
+        next(iter(candidates.values())),
+    )
 
 
 def _rank_verdicts(report):

@@ -33,9 +33,13 @@ mid-invocation.  Liveness is judged on record ``t0`` wall-clock stamps —
 comparable across node processes on one host, the same contract the merged
 timeline already relies on.
 
-This is the monitoring substrate the persistent engine daemon (ROADMAP open
-item 2) and staleness-bounded async rounds (item 4) plug into: a long-lived
-worker is exactly the thing you watch with heartbeats, not autopsies.
+The persistent engine daemon (:mod:`~..federation.daemon`) plugs in
+natively: its warm workers pulse the same ``engine:heartbeat`` per
+completed invocation, and the supervisor's ``worker:restart`` events
+surface as federation + per-site ``worker_restarts`` counters on the
+board, ``/metrics`` and ``/healthz`` — a long-lived worker is exactly the
+thing you watch with heartbeats, not autopsies.  Staleness-bounded async
+rounds (ROADMAP item 2) plug into the same substrate.
 """
 import json
 import os
@@ -44,7 +48,7 @@ import threading
 import time
 from collections import deque
 
-from ..config.keys import Live, Metric
+from ..config.keys import Daemon, Live, Metric
 from .collect import find_event_files, read_jsonl_segment
 
 _EMA_DECAY = 0.8
@@ -165,7 +169,7 @@ class Tailer:
 def _site_entry():
     return {"round": 0, "phase": None, "epoch": None, "last_seen": None,
             "last_heartbeat": None, "anomalies": 0, "dead": False,
-            "quarantined": False}
+            "quarantined": False, "worker_restarts": 0}
 
 
 class LiveState:
@@ -203,6 +207,11 @@ class LiveState:
         self.anomalies = 0
         self.anomalies_by_kind = {}
         self.chaos = 0
+        self.worker_restarts = 0
+        # event-name counts (bounded by the event vocabulary): the watch
+        # CLI's --assert-event gating reads this, it stays out of the
+        # snapshot to keep /healthz stable
+        self.event_counts = {}
         self.wire_retries = 0
         self._retry_times = deque(maxlen=4096)
         self.corruption_recovered = 0
@@ -221,7 +230,9 @@ class LiveState:
     @classmethod
     def from_cache(cls, cache):
         """Thresholds from the :class:`~..config.keys.Live` cache keys —
-        the embedding surface the daemon-mode engine will use."""
+        the embedding surface for engines that watch themselves (the
+        daemon engine's workers are monitored through exactly these
+        records via ``telemetry watch``)."""
         cache = cache or {}
         return cls(
             silence_after=cache.get(Live.SILENCE_AFTER, 30.0),
@@ -283,6 +294,7 @@ class LiveState:
     def _ingest_event(self, rec, t0):
         name = rec.get("name", "")
         site = rec.get("site")
+        self.event_counts[name] = self.event_counts.get(name, 0) + 1
         if name == Live.HEARTBEAT:
             # the aggregator's pulse ("remote") feeds federation liveness
             # (last_event_t) but must NOT become a per-site row: the
@@ -310,6 +322,13 @@ class LiveState:
                 self.site(site)["anomalies"] += 1
         elif name == "chaos:inject":
             self.chaos += 1
+        elif name == Daemon.EVENT_RESTART:
+            # the daemon engine replaced a dead/wedged worker — the site
+            # SURVIVED (supervision, not quorum), but the board/metrics
+            # must show the churn, per site
+            self.worker_restarts += 1
+            if site is not None and str(site) != "remote":
+                self.site(site)["worker_restarts"] += 1
         elif name == "wire:retry":
             self.wire_retries += 1
             self._retry_times.append(t0)
@@ -522,6 +541,7 @@ class LiveState:
                 "epoch": s["epoch"],
                 "heartbeat_age_s": (round(now - last, 3) if last else None),
                 "anomalies": s["anomalies"],
+                "worker_restarts": s["worker_restarts"],
                 "status": ("dead" if s["dead"] else
                            "quarantined" if s["quarantined"] else
                            "silent" if f"silence:{name}" in self._armed else
@@ -543,6 +563,7 @@ class LiveState:
             "anomalies": {"total": self.anomalies,
                           "by_kind": dict(self.anomalies_by_kind)},
             "chaos_injections": self.chaos,
+            "worker_restarts": self.worker_restarts,
             "wire_retries": self.wire_retries,
             "corruption_recovered": self.corruption_recovered,
             "dead_sites": sorted(self.dead),
@@ -590,6 +611,7 @@ def render_board(snap, root=""):
     lines.append(
         f"anomalies {snap['anomalies']['total']} · "
         f"chaos {snap['chaos_injections']} · "
+        f"worker restarts {snap.get('worker_restarts', 0)} · "
         f"truncated lines {snap['truncated_lines']} · "
         f"dead: {', '.join(snap['dead_sites']) or '-'} · "
         f"quarantined: {', '.join(snap['quarantined_sites']) or '-'}"

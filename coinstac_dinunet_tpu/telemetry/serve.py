@@ -110,6 +110,8 @@ def render_prometheus(snap):
               "watchdog anomaly events observed")
     w.counter("chaos_injections_total", snap.get("chaos_injections"),
               "deterministic chaos faults injected")
+    w.counter("worker_restarts_total", snap.get("worker_restarts"),
+              "daemon workers killed and restarted by the supervisor")
     w.counter("wire_retries_total", snap.get("wire_retries"),
               "wire load retries observed")
     w.counter("corruption_recovered_total", snap.get("corruption_recovered"),
@@ -133,6 +135,10 @@ def render_prometheus(snap):
     for name, s in sites.items():
         w.counter("site_anomalies_total", s.get("anomalies"),
                   "watchdog anomalies attributed to the site",
+                  labels={"site": name})
+    for name, s in sites.items():
+        w.counter("site_worker_restarts_total", s.get("worker_restarts"),
+                  "daemon worker restarts attributed to the site",
                   labels={"site": name})
     by_kind = {}
     for v in snap.get("verdicts") or ():

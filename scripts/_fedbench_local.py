@@ -1,0 +1,33 @@
+"""Site-node script for the federation engine A/B bench
+(``scripts/bench_federation.py --engine ...``).
+
+Same ``compute(payload)`` + one-shot ``__main__`` contract as
+``examples/*/local.py``, over the shared synthetic XOR task — so the
+fresh-process engine spawns it per invocation and the daemon engine runs
+it unmodified inside a warm worker.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from _fedbench_task import make_dataset_cls, make_trainer_cls  # noqa: E402
+from coinstac_dinunet_tpu import COINNLocal  # noqa: E402
+
+
+def compute(payload):
+    node = COINNLocal(
+        cache=payload.get("cache", {}),
+        input=payload.get("input", {}),
+        state=payload.get("state", {}),
+        task_id="fedbench",
+    )
+    return node(trainer_cls=make_trainer_cls(),
+                dataset_cls=make_dataset_cls())
+
+
+if __name__ == "__main__":
+    print(json.dumps(compute(json.loads(sys.stdin.read()))))

@@ -1,0 +1,26 @@
+"""Aggregator script for the federation engine A/B bench
+(``scripts/bench_federation.py --engine ...``) — see ``_fedbench_local.py``.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from _fedbench_task import make_trainer_cls  # noqa: E402
+from coinstac_dinunet_tpu import COINNRemote  # noqa: E402
+
+
+def compute(payload):
+    node = COINNRemote(
+        cache=payload.get("cache", {}),
+        input=payload.get("input", {}),
+        state=payload.get("state", {}),
+    )
+    return node(trainer_cls=make_trainer_cls())
+
+
+if __name__ == "__main__":
+    print(json.dumps(compute(json.loads(sys.stdin.read()))))

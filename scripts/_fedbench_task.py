@@ -1,0 +1,91 @@
+"""Shared synthetic task for the federation benchmarks: a 2-feature noisy
+XOR classified by a tiny MLP.
+
+One definition serves three consumers that must time the SAME work:
+``scripts/bench_federation.py`` (in-process + vectorized points), and the
+``_fedbench_local.py`` / ``_fedbench_remote.py`` node scripts the
+fresh-process and daemon engines execute (the ``--engine`` A/B).  The
+class factories memoize per process — a daemon worker building a new
+trainer class per invocation would churn any class-keyed cache and
+misrepresent the warm path it exists to measure.
+"""
+import numpy as np
+
+#: shared run configuration (epochs/patience pushed out of reach: the
+#: engine A/B times steady-state rounds, not a converging run)
+CACHE = dict(
+    task_id="fedbench", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+    batch_size=8, learning_rate=5e-2, input_shape=(2,), seed=11,
+    patience=10_000, validation_epochs=10_000, epochs=10_000,
+)
+
+_TRAINER_CLS = None
+_DATASET_CLS = None
+
+
+def _mlp():
+    import flax.linen as fnn
+
+    class MLP(fnn.Module):
+        @fnn.compact
+        def __call__(self, x):
+            x = fnn.relu(fnn.Dense(16)(x))
+            return fnn.Dense(2)(x)
+
+    return MLP()
+
+
+def make_trainer_cls():
+    global _TRAINER_CLS
+    if _TRAINER_CLS is not None:
+        return _TRAINER_CLS
+    import jax.numpy as jnp
+
+    from coinstac_dinunet_tpu.metrics import cross_entropy
+    from coinstac_dinunet_tpu.trainer import COINNTrainer
+
+    class BenchTrainer(COINNTrainer):
+        def _init_nn_model(self):
+            self.nn["net"] = _mlp()
+
+        def iteration(self, params, batch, rng=None):
+            logits = self.nn["net"].apply(params["net"], batch["inputs"])
+            loss = cross_entropy(logits, batch["labels"],
+                                 mask=batch.get("_mask"))
+            pred = jnp.argmax(logits, axis=-1)
+            return {"loss": loss, "pred": pred, "true": batch["labels"]}
+
+    _TRAINER_CLS = BenchTrainer
+    return BenchTrainer
+
+
+def make_dataset_cls():
+    global _DATASET_CLS
+    if _DATASET_CLS is not None:
+        return _DATASET_CLS
+    from coinstac_dinunet_tpu.data import COINNDataset
+
+    class BenchDataset(COINNDataset):
+        def __getitem__(self, ix):
+            _, f = self.indices[ix]
+            fid = int(str(f).split("_")[-1])
+            rng = np.random.default_rng(fid)
+            bits = rng.integers(0, 2, size=2)
+            x = ((bits * 2 - 1).astype(np.float32)
+                 + rng.normal(0, 0.1, 2).astype(np.float32))
+            return {"inputs": x, "labels": np.int32(bits[0] ^ bits[1])}
+
+    _DATASET_CLS = BenchDataset
+    return BenchDataset
+
+
+def fill_site_data(eng, per_site=64):
+    """Deterministic per-site file roster (the dataset derives each
+    sample from its filename's integer suffix)."""
+    import os
+
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(per_site):
+            with open(os.path.join(d, f"s_{i * per_site + j}"), "w") as f:
+                f.write("x")

@@ -25,10 +25,24 @@ rounds/sec drop >10% becomes a ranked verdict exactly like an MFU drop.
 The CI ``federation`` job runs the 64-site smoke this way and uploads the
 ledger entry + postmortem as an artifact.
 
+``--engine inprocess,subprocess,daemon`` switches to the **process-model
+A/B** (ISSUE 11): the same synthetic task and node protocol driven by the
+persistent in-process engine, the paper's fresh-process-per-invocation
+engine, and the warm-worker daemon (``federation/daemon.py``) — per-kind
+cold-start (rounds 1-3: INIT handshake + imports + first compiles) vs
+steady-state rounds/sec, one ledger JSON line per kind (stable per-kind
+metric names, so the metric-aware doctor regression verdicts track each
+engine independently in the SAME ledger file).  ``--engine-assert`` gates
+the ISSUE-11 acceptance ratios (daemon within 2x of in-process, >= 10x
+the subprocess engine).
+
 Usage::
 
     JAX_PLATFORMS=cpu python scripts/bench_federation.py --sites 1000
     python scripts/bench_federation.py --sites 64 --smoke --workdir /tmp/fb
+    python scripts/bench_federation.py --engine inprocess,subprocess,daemon \\
+        --smoke | python scripts/bench_history.py append --all \\
+        --history BENCH_FEDERATION_HISTORY.jsonl
 """
 import argparse
 import json
@@ -44,61 +58,15 @@ sys.path.insert(0, _REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from _bench_util import ensure_warm_backend  # noqa: E402
-
-
-# ---------------------------------------------------------- synthetic task
-def _mlp():
-    import flax.linen as fnn
-
-    class MLP(fnn.Module):
-        @fnn.compact
-        def __call__(self, x):
-            x = fnn.relu(fnn.Dense(16)(x))
-            return fnn.Dense(2)(x)
-
-    return MLP()
-
-
-def _make_trainer_cls():
-    from coinstac_dinunet_tpu.metrics import cross_entropy
-    from coinstac_dinunet_tpu.trainer import COINNTrainer
-    import jax.numpy as jnp
-
-    class BenchTrainer(COINNTrainer):
-        def _init_nn_model(self):
-            self.nn["net"] = _mlp()
-
-        def iteration(self, params, batch, rng=None):
-            logits = self.nn["net"].apply(params["net"], batch["inputs"])
-            loss = cross_entropy(logits, batch["labels"],
-                                 mask=batch.get("_mask"))
-            pred = jnp.argmax(logits, axis=-1)
-            return {"loss": loss, "pred": pred, "true": batch["labels"]}
-
-    return BenchTrainer
-
-
-def _make_dataset_cls():
-    from coinstac_dinunet_tpu.data import COINNDataset
-
-    class BenchDataset(COINNDataset):
-        def __getitem__(self, ix):
-            _, f = self.indices[ix]
-            fid = int(str(f).split("_")[-1])
-            rng = np.random.default_rng(fid)
-            bits = rng.integers(0, 2, size=2)
-            x = ((bits * 2 - 1).astype(np.float32)
-                 + rng.normal(0, 0.1, 2).astype(np.float32))
-            return {"inputs": x, "labels": np.int32(bits[0] ^ bits[1])}
-
-    return BenchDataset
-
-
-_CACHE = dict(
-    task_id="fedbench", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
-    batch_size=8, learning_rate=5e-2, input_shape=(2,), seed=11,
-    patience=10_000, validation_epochs=10_000, epochs=10_000,
+from _fedbench_task import (  # noqa: E402
+    CACHE as _CACHE,
+    fill_site_data,
+    make_dataset_cls as _make_dataset_cls,
+    make_trainer_cls as _make_trainer_cls,
 )
+
+_SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+ENGINE_KINDS = ("inprocess", "subprocess", "daemon")
 
 
 # -------------------------------------------------------------- vectorized
@@ -183,11 +151,7 @@ def _bench_serial(n_sites, rounds, workdir, per_site=64, telemetry=False):
         dataset_cls=_make_dataset_cls(),
         **dict(_CACHE, profile=bool(telemetry)),
     )
-    for i, s in enumerate(eng.site_ids):
-        d = eng.site_data_dir(s)
-        for j in range(per_site):
-            with open(os.path.join(d, f"s_{i * per_site + j}"), "w") as f:
-                f.write("x")
+    fill_site_data(eng, per_site=per_site)
     # warm-up rounds: INIT_RUNS handshake + first compiled steps
     for _ in range(3):
         eng.step_round()
@@ -197,6 +161,160 @@ def _bench_serial(n_sites, rounds, workdir, per_site=64, telemetry=False):
     dt = time.perf_counter() - t0
     return {"rounds_per_sec": round(rounds / dt, 3),
             "round_ms": round(1e3 * dt / rounds, 3)}
+
+
+# -------------------------------------------------------------- engine A/B
+def _build_engine(kind, n_sites, workdir, per_site):
+    """One serial engine on the SAME synthetic task and node protocol —
+    the process model is the only variable:
+
+    - ``inprocess``: persistent single process (the ceiling).
+    - ``subprocess``: the paper's deployment — ``python <script>`` per
+      node per round; pays interpreter + imports + jit every invocation.
+    - ``daemon``: one long-lived warm worker per node over the framed
+      pipe (``federation/daemon.py``) — fresh-process isolation without
+      the per-invocation cold start.
+    """
+    node_args = dict(_CACHE, persist_round_state=True)
+    node_args.pop("task_id", None)
+    if kind == "inprocess":
+        from coinstac_dinunet_tpu.engine import InProcessEngine
+
+        eng = InProcessEngine(
+            workdir, n_sites=n_sites, trainer_cls=_make_trainer_cls(),
+            dataset_cls=_make_dataset_cls(), **dict(_CACHE),
+        )
+    else:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONPATH"] = (
+            _REPO + os.pathsep + _SCRIPTS_DIR + os.pathsep
+            + env.get("PYTHONPATH", "")
+        ).rstrip(os.pathsep)
+        kw = dict(
+            local_script=os.path.join(_SCRIPTS_DIR, "_fedbench_local.py"),
+            remote_script=os.path.join(_SCRIPTS_DIR, "_fedbench_remote.py"),
+            first_input={"fedbench_args": node_args}, env=env,
+        )
+        if kind == "daemon":
+            from coinstac_dinunet_tpu.federation.daemon import DaemonEngine
+
+            eng = DaemonEngine(workdir, n_sites=n_sites, **kw)
+        else:
+            from coinstac_dinunet_tpu.engine import SubprocessEngine
+
+            # the fresh-process engine gets the same persistent compile
+            # cache the daemon enables by default: the A/B isolates the
+            # process model, not a compile-cache handicap
+            env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                           os.path.join(workdir, "xla_cache"))
+            eng = SubprocessEngine(workdir, n_sites=n_sites, **kw)
+    fill_site_data(eng, per_site=per_site)
+    return eng
+
+
+def _bench_engine(kind, n_sites, rounds, workdir, per_site=64,
+                  warmup_rounds=3):
+    """Cold-start vs steady-state of ONE engine kind: per-round wall times
+    for the first ``warmup_rounds`` (the INIT handshake + first compiles —
+    what the daemon amortizes across the run) and rounds/sec over the
+    ``rounds`` after them."""
+    eng = _build_engine(kind, n_sites, workdir, per_site)
+    try:
+        cold = []
+        for _ in range(warmup_rounds):
+            t0 = time.perf_counter()
+            eng.step_round()
+            cold.append(round(time.perf_counter() - t0, 4))
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            eng.step_round()
+        dt = time.perf_counter() - t0
+    finally:
+        if hasattr(eng, "close"):
+            eng.close()
+    return {
+        "rounds_per_sec": round(rounds / dt, 3),
+        "round_ms": round(1e3 * dt / rounds, 3),
+        "round_1_s": cold[0],
+        "cold_rounds_s": cold,
+        "rounds_timed": rounds,
+    }
+
+
+def run_engine_ab(kinds, n_sites, rounds, workdir, per_site=16):
+    """The ``--engine`` A/B: each engine kind on the same config, plus the
+    ISSUE-11 acceptance ratios (daemon within 2x of in-process;
+    >= 10x the per-invocation subprocess engine)."""
+    engines = {}
+    for kind in kinds:
+        engines[kind] = _bench_engine(
+            kind, n_sites, rounds, os.path.join(workdir, f"engine_{kind}"),
+            per_site=per_site,
+        )
+        print(f"# engine {kind:>10}: "
+              f"{engines[kind]['rounds_per_sec']:g} rounds/s steady, "
+              f"round 1 {engines[kind]['round_1_s']:g}s", file=sys.stderr)
+    out = {"sites": int(n_sites), "engines": engines}
+    d = engines.get("daemon")
+    ip = engines.get("inprocess")
+    sp = engines.get("subprocess")
+    if d and ip and ip["rounds_per_sec"] > 0:
+        out["daemon_vs_inprocess"] = round(
+            d["rounds_per_sec"] / ip["rounds_per_sec"], 3
+        )
+    if d and sp and sp["rounds_per_sec"] > 0:
+        out["daemon_vs_subprocess"] = round(
+            d["rounds_per_sec"] / sp["rounds_per_sec"], 2
+        )
+    return out
+
+
+def _engine_main(args, workdir, probe):
+    """``--engine`` mode: the process-model A/B, one ledger line per kind
+    (same metric name per kind across runs, so the metric-aware doctor
+    regression verdicts track each engine's trend independently)."""
+    kinds = [k.strip() for k in str(args.engine).split(",") if k.strip()]
+    for k in kinds:
+        if k not in ENGINE_KINDS:
+            print(f"unknown --engine kind {k!r} "
+                  f"(known: {', '.join(ENGINE_KINDS)})", file=sys.stderr)
+            return 2
+    # daemon LAST: a plain `bench_history.py append` (no --all) ledgers it
+    kinds = [k for k in ENGINE_KINDS if k in kinds]
+    rounds = args.engine_rounds or (4 if args.smoke else 10)
+    if args.engine_assert and set(kinds) != set(ENGINE_KINDS):
+        print("--engine-assert needs all three kinds in --engine",
+              file=sys.stderr)
+        return 2
+    ab = run_engine_ab(kinds, args.engine_sites, rounds, workdir)
+    for kind in kinds:
+        e = ab["engines"][kind]
+        line = {
+            "metric": f"engine_{kind}_rounds_per_sec",
+            "value": e["rounds_per_sec"], "unit": "rounds/sec",
+            "sites": ab["sites"], "rounds_timed": e["rounds_timed"],
+            "round_ms": e["round_ms"], "round_1_s": e["round_1_s"],
+            "cold_rounds_s": e["cold_rounds_s"],
+            "workdir": workdir, "backend_probe": probe,
+        }
+        if kind == "daemon":
+            line["daemon_vs_inprocess"] = ab.get("daemon_vs_inprocess")
+            line["daemon_vs_subprocess"] = ab.get("daemon_vs_subprocess")
+        print(json.dumps(line))
+    if args.engine_assert:
+        vs_ip = ab.get("daemon_vs_inprocess") or 0.0
+        vs_sp = ab.get("daemon_vs_subprocess") or 0.0
+        if vs_ip < 0.5 or vs_sp < 10.0:
+            print(f"ENGINE ASSERT FAILED: daemon_vs_inprocess={vs_ip} "
+                  f"(need >= 0.5, i.e. within 2x) daemon_vs_subprocess="
+                  f"{vs_sp} (need >= 10)", file=sys.stderr)
+            return 4
+        print(f"engine assert OK: daemon within "
+              f"{round(1 / vs_ip, 2) if vs_ip else '?'}x of in-process, "
+              f"{vs_sp}x the subprocess engine", file=sys.stderr)
+    return 0
 
 
 def main(argv=None):
@@ -219,6 +337,27 @@ def main(argv=None):
                         "(cache['donate_buffers']=False) — the before/"
                         "after HBM-peak A/B against a default run shows "
                         "what donation of the stacked site state saves")
+    p.add_argument("--engine", default=None, metavar="KINDS",
+                   help="comma list of serial engine kinds to A/B "
+                        f"({','.join(ENGINE_KINDS)}): per-kind cold-start "
+                        "(round-1..3 wall) vs steady-state rounds/sec on "
+                        "the same node protocol, ONE ledger JSON line per "
+                        "kind on stdout (daemon last, carrying the "
+                        "daemon_vs_* ratios).  Replaces the vectorized "
+                        "sweep for this run; ledger with "
+                        "`bench_history.py append --all`")
+    p.add_argument("--engine-sites", type=int, default=3,
+                   help="site count for the --engine A/B (default 3 — "
+                        "the subprocess engine pays seconds per "
+                        "invocation, so keep this honest-but-small)")
+    p.add_argument("--engine-rounds", type=int, default=None,
+                   help="steady-state rounds per engine kind (default "
+                        "10; 4 with --smoke)")
+    p.add_argument("--engine-assert", action="store_true",
+                   help="exit 4 unless the daemon's steady-state is "
+                        "within 2x of the in-process engine AND >= 10x "
+                        "the subprocess engine (the ISSUE-11 acceptance "
+                        "gate; requires all three kinds in --engine)")
     args = p.parse_args(argv)
     rounds = args.rounds or (3 if args.smoke else 10)
     serial_cap = args.serial_cap or (16 if args.smoke else 100)
@@ -252,6 +391,9 @@ def main(argv=None):
 
         workdir = tempfile.mkdtemp(prefix="fedbench_")
     os.makedirs(workdir, exist_ok=True)
+
+    if args.engine:
+        return _engine_main(args, workdir, probe)
 
     vec_points = sorted({s for s in (10, 100, args.sites) if s <= args.sites})
     ser_points = [s for s in vec_points if s <= serial_cap]

@@ -33,10 +33,21 @@ def _load_history(path):
 
 
 def _compare(history, threshold):
-    """(message, regressed) for the last two entries of ``history``."""
+    """(message, regressed) for the latest entry vs the PREVIOUS entry of
+    the same metric — a ledger may interleave metrics (the engine A/B
+    appends one line per engine kind), and diffing a daemon entry against
+    a vectorized one would compare apples to oranges."""
     if len(history) < 2:
         return f"{len(history)} entr{'y' if len(history) == 1 else 'ies'} — nothing to compare yet", False
-    prev, last = history[-2], history[-1]
+    last = history[-1]
+    metric = last.get("metric")
+    prev = next(
+        (e for e in reversed(history[:-1]) if e.get("metric") == metric),
+        None,
+    )
+    if prev is None:
+        return (f"first entry for metric {metric!r} — "
+                "nothing to compare yet"), False
     pv, lv = prev.get("value"), last.get("value")
     try:
         pv, lv = float(pv), float(lv)
@@ -45,8 +56,9 @@ def _compare(history, threshold):
     if pv <= 0:
         return f"previous value {pv} not positive; skipping comparison", False
     drop = 1.0 - lv / pv
+    unit = last.get("unit") or "samples/sec/chip"
     msg = (
-        f"samples/sec/chip {lv:g} vs previous {pv:g} "
+        f"{metric or 'bench'} {lv:g} vs previous {pv:g} {unit} "
         f"({-100.0 * drop:+.1f}%)"
     )
     if drop > threshold:
@@ -63,6 +75,10 @@ def main(argv=None):
     ap.add_argument("--history", default=DEFAULT_HISTORY)
     ap.add_argument("--threshold", type=float, default=0.10)
     ap.add_argument("--fail-on-regression", action="store_true")
+    ap.add_argument("--all", action="store_true",
+                    help="append EVERY JSON line in the input (oldest "
+                         "first), not just the last — the engine A/B "
+                         "emits one per-metric line per engine kind")
     cp = sub.add_parser("check", help="compare the last two history entries")
     cp.add_argument("--history", default=DEFAULT_HISTORY)
     cp.add_argument("--threshold", type=float, default=0.10)
@@ -71,25 +87,38 @@ def main(argv=None):
     if args.cmd == "append":
         raw = (sys.stdin.read() if args.input == "-"
                else open(args.input, "r", encoding="utf-8").read())
-        # bench.py may print progress lines; the LAST JSON line is the result
-        entry = None
-        for line in reversed(raw.strip().splitlines()):
+        # bench.py may print progress lines; JSON lines are the results —
+        # default: the LAST one; --all: every one, oldest first
+        entries = []
+        for line in raw.strip().splitlines():
             line = line.strip()
             if line.startswith("{"):
                 try:
-                    entry = json.loads(line)
-                    break
+                    entries.append(json.loads(line))
                 except ValueError:
                     continue
-        if not isinstance(entry, dict):
+        entries = [e for e in entries if isinstance(e, dict)]
+        if not args.all:
+            entries = entries[-1:]
+        if not entries:
             print("no JSON object found in the input", file=sys.stderr)
             return 2
         with open(args.history, "a", encoding="utf-8") as f:
-            f.write(json.dumps(entry, separators=(",", ":"), sort_keys=True) + "\n")
+            for entry in entries:
+                f.write(json.dumps(entry, separators=(",", ":"),
+                                   sort_keys=True) + "\n")
         history = _load_history(args.history)
-        msg, regressed = _compare(history, args.threshold)
-        print(f"appended entry #{len(history)} to {args.history}; {msg}")
-        return 1 if (regressed and args.fail_on_regression) else 0
+        regressed_any, msgs = False, []
+        # compare each appended metric against its own predecessor
+        for n in range(len(entries), 0, -1):
+            msg, regressed = _compare(history[:len(history) - n + 1],
+                                      args.threshold)
+            msgs.append(msg)
+            regressed_any = regressed_any or regressed
+        print(f"appended {len(entries)} entr"
+              f"{'y' if len(entries) == 1 else 'ies'} to {args.history}; "
+              + "; ".join(msgs))
+        return 1 if (regressed_any and args.fail_on_regression) else 0
 
     history = _load_history(args.history)
     msg, regressed = _compare(history, args.threshold)

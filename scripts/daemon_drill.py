@@ -1,0 +1,117 @@
+"""Chaos worker-kill drill for the persistent engine daemon (ISSUE 11 CI gate).
+
+Runs a 3-site federated FSV run on :class:`DaemonEngine` with a
+deterministic ``worker_kill`` plan — site_1's worker SIGKILLed
+mid-invocation at round 4, site_0's between rounds at round 6 — and
+asserts the supervision contract: both workers restart (``worker:restart``
+on the engine lane, new pids), NO site is declared dead, and the run
+reaches SUCCESS with the standard score artifacts.
+
+CI wraps it in the live ops plane::
+
+    python -m coinstac_dinunet_tpu.telemetry watch <workdir> \\
+        --follow --until-exit --assert-event worker:restart \\
+        --serve 0 --metrics-out metrics.prom --snapshot board.txt \\
+        -- python scripts/daemon_drill.py --workdir <workdir>
+
+so the restart must be OBSERVED while the run is alive (the
+``--assert-event`` gate), and the final board/metrics scrape carries the
+``worker_restarts`` counters as the artifact.
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+ARGS = dict(
+    data_dir="data", split_ratio=[0.6, 0.2, 0.2], batch_size=4, epochs=2,
+    validation_epochs=1, learning_rate=5e-2, input_size=12, hidden_sizes=[8],
+    num_classes=2, seed=7, synthetic=True, verbose=False, patience=50,
+    persist_round_state=True, profile=True,
+)
+
+PLAN = {"faults": [
+    {"kind": "worker_kill", "round": 4, "site": "site_1"},
+    {"kind": "worker_kill", "round": 6, "site": "site_0", "when": "idle"},
+]}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--workdir", required=True)
+    p.add_argument("--sites", type=int, default=3)
+    p.add_argument("--max-rounds", type=int, default=200)
+    args = p.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from coinstac_dinunet_tpu.federation.daemon import DaemonEngine
+
+    os.makedirs(args.workdir, exist_ok=True)
+    with open(os.path.join(args.workdir, "fault_plan.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(PLAN, f, indent=2)
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    example = os.path.join(_REPO, "examples", "fsv_classification")
+    eng = DaemonEngine(
+        args.workdir, n_sites=args.sites,
+        local_script=os.path.join(example, "local.py"),
+        remote_script=os.path.join(example, "remote.py"),
+        first_input={"fsv_classification_args": dict(ARGS)},
+        env=env, fault_plan=PLAN,
+    )
+    for s in eng.site_ids:
+        d = eng.site_data_dir(s)
+        for i in range(10):
+            with open(os.path.join(d, f"{s}_subj{i}.txt"), "w") as f:
+                f.write("x")
+
+    try:
+        for _ in range(3):
+            eng.step_round()
+        pids_before = dict(eng.worker_pids())
+        eng.run(max_rounds=args.max_rounds)
+        pids_after = dict(eng.worker_pids())
+    finally:
+        eng.close()
+
+    failures = []
+    if not eng.success:
+        failures.append(f"run did not reach SUCCESS ({eng.rounds} rounds)")
+    if eng.dead_sites:
+        failures.append(
+            f"sites declared DEAD {sorted(eng.dead_sites)} — worker death "
+            "must be a supervision event, not a quorum event"
+        )
+    for site in ("site_0", "site_1"):
+        if pids_after.get(site) == pids_before.get(site):
+            failures.append(f"{site} worker pid never changed — no restart?")
+    if pids_after.get("remote") != pids_before.get("remote"):
+        failures.append("the aggregator worker restarted unexpectedly")
+    task_dir = os.path.join(eng.remote_state["outputDirectory"],
+                            "fsv_classification")
+    if not (os.path.isdir(task_dir) and any(
+            "global_test_metrics" in f for f in os.listdir(task_dir))):
+        failures.append("global score artifacts missing")
+
+    if failures:
+        for f in failures:
+            print(f"DRILL FAILED: {f}", file=sys.stderr)
+        return 1
+    print(
+        f"drill OK: {eng.rounds} rounds, restarts "
+        f"{ {s: (pids_before.get(s), pids_after.get(s)) for s in ('site_0', 'site_1')} }",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

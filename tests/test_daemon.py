@@ -1,0 +1,409 @@
+"""Persistent engine daemon: warm workers, framed pipe, supervised restarts.
+
+The fresh-process deployment (``tests/test_subprocess_engine.py``) pays
+interpreter start + imports + jit compilation per invocation.  These tests
+drive the SAME ``examples/*/local.py`` / ``remote.py`` scripts through
+:class:`~coinstac_dinunet_tpu.federation.daemon.DaemonEngine` — one
+long-lived worker per node, invocations over the length-prefixed JSON
+frame pipe — and pin the ISSUE-11 contract: score parity with the
+in-process engine, warm-worker reuse (one pid + one jit build per surface
+for the whole run), and the chaos ``worker_kill`` drill where the site
+SURVIVES via a supervised ``worker:restart`` that the live ops plane can
+see.
+"""
+import io
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu.config.keys import Daemon, Live
+from coinstac_dinunet_tpu.engine import InProcessEngine, InvokeTimeout
+from coinstac_dinunet_tpu.federation.daemon import (
+    DaemonEngine,
+    WorkerCrashed,
+    WorkerTimeout,
+    read_frame,
+    write_frame,
+)
+from coinstac_dinunet_tpu.models import FSVDataset, FSVTrainer
+from coinstac_dinunet_tpu.telemetry import Recorder
+from coinstac_dinunet_tpu.telemetry.collect import load_events
+from coinstac_dinunet_tpu.telemetry.live import LiveState, Tailer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "fsv_classification")
+
+ARGS = dict(
+    data_dir="data", split_ratio=[0.6, 0.2, 0.2], batch_size=4, epochs=2,
+    validation_epochs=1, learning_rate=5e-2, input_size=12, hidden_sizes=[8],
+    num_classes=2, seed=7, synthetic=True, verbose=False, patience=50,
+)
+N_SITES = 3
+
+
+def _env(tmp_path):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _fill_sites(eng, per_site=10):
+    for s in eng.site_ids:
+        d = eng.site_data_dir(s)
+        for i in range(per_site):
+            with open(os.path.join(d, f"{s}_subj{i}.txt"), "w") as f:
+                f.write("x")
+
+
+def _daemon_engine(tmp_path, tag, fault_plan=None, **extra_args):
+    eng = DaemonEngine(
+        tmp_path / tag, n_sites=N_SITES,
+        local_script=os.path.join(EXAMPLE, "local.py"),
+        remote_script=os.path.join(EXAMPLE, "remote.py"),
+        first_input={"fsv_classification_args": {
+            **ARGS, "persist_round_state": True, "profile": True,
+            **extra_args,
+        }},
+        env=_env(tmp_path), fault_plan=fault_plan,
+    )
+    _fill_sites(eng)
+    return eng
+
+
+@pytest.fixture(scope="module")
+def inproc_golden(tmp_path_factory):
+    """The in-process 3-site acceptance run both parity tests compare
+    against (one engine run per module, not per test)."""
+    wd = tmp_path_factory.mktemp("inproc_golden")
+    eng = InProcessEngine(
+        wd, n_sites=N_SITES, trainer_cls=FSVTrainer, dataset_cls=FSVDataset,
+        task_id="fsv_classification", **ARGS,
+    )
+    _fill_sites(eng)
+    eng.run(max_rounds=200)
+    assert eng.success
+    return {k: np.asarray(eng.remote_cache[k], np.float64)
+            for k in ("train_log", "validation_log", "test_metrics")}
+
+
+# ------------------------------------------------------------ frame protocol
+def test_frame_roundtrip_and_desync():
+    buf = io.BytesIO()
+    payload = {"op": "invoke", "payload": {"cache": {"x": [1, 2]},
+                                           "text": "line\nbreaks ok"}}
+    write_frame(buf, payload)
+    write_frame(buf, {"op": "shutdown"})
+    buf.seek(0)
+    assert read_frame(buf) == payload
+    assert read_frame(buf) == {"op": "shutdown"}
+    assert read_frame(buf) is None  # EOF at a frame boundary
+    with pytest.raises(ValueError, match="bad frame header"):
+        read_frame(io.BytesIO(b"print output, not a frame\n"))
+
+
+# ----------------------------------------------------- worker loop (no JAX)
+_ECHO_NODE = textwrap.dedent("""
+    import json, os, sys, time
+
+    def compute(payload):
+        cache = payload.get("cache", {})
+        cmd = payload.get("input", {}).get("cmd")
+        if cmd == "boom":
+            raise ValueError("node-level failure")
+        if cmd == "die":
+            os._exit(9)  # the WORKER dies mid-invocation
+        if cmd == "wedge":
+            time.sleep(60)
+        cache["n"] = int(cache.get("n", 0)) + 1
+        cache["_live"] = object()  # non-JSON live state, in-worker only
+        return {"output": {"n": cache["n"], "pid": os.getpid()},
+                "cache": {k: v for k, v in cache.items()
+                          if not str(k).startswith("_")}}
+
+    if __name__ == "__main__":
+        print(json.dumps(compute(json.loads(sys.stdin.read()))))
+""")
+
+
+def _echo_engine(tmp_path, **kw):
+    script = tmp_path / "echo_node.py"
+    script.write_text(_ECHO_NODE)
+    eng = DaemonEngine(
+        tmp_path / "wd", n_sites=1, local_script=str(script),
+        remote_script=str(script), env=_env(tmp_path), timeout=5, **kw,
+    )
+    return eng, str(script)
+
+
+def _engine_rec(eng):
+    rec = Recorder("engine", out_dir=eng.workdir)
+    eng._telemetry_rec = rec
+    return rec
+
+
+def test_worker_stays_warm_across_invocations(tmp_path):
+    """The live (non-JSON) cache and the process itself persist between
+    rounds: same pid, counter advancing, the warm flag flipping on from
+    the second request — while the engine still receives a JSON-clean
+    cache each round (the fresh-process contract at the boundary)."""
+    eng, script = _echo_engine(tmp_path)
+    rec = _engine_rec(eng)
+    try:
+        outs = [eng._invoke(script, {"cache": {}, "input": {}, "state": {}},
+                            target="site_0", rec=rec)
+                for _ in range(3)]
+        assert [o["output"]["n"] for o in outs] == [1, 2, 3]
+        assert len({o["output"]["pid"] for o in outs}) == 1
+        assert all("_live" not in o["cache"] for o in outs)
+        assert eng.worker_pids() == {"site_0": outs[0]["output"]["pid"]}
+    finally:
+        eng.close()
+
+
+def test_crashed_worker_restarts_under_supervision(tmp_path):
+    """A worker that DIES mid-invocation is restarted (not declared a dead
+    site) under the worker restart policy, with typed worker:restart
+    events.  A PERMANENTLY crashing request exhausts the 3-attempt budget
+    as RetryExhausted (every restart re-runs the same request); a benign
+    follow-up runs on a fresh worker resumed from the engine's JSON
+    cache."""
+    from coinstac_dinunet_tpu.resilience.retry import RetryExhausted
+
+    eng, script = _echo_engine(tmp_path)
+    rec = _engine_rec(eng)
+    try:
+        first = eng._invoke(script, {"cache": {}, "input": {}, "state": {}},
+                            target="site_0", rec=rec)
+        pid0 = first["output"]["pid"]
+        with pytest.raises(RetryExhausted) as exc_info:
+            eng._invoke(
+                script, {"cache": first["cache"],
+                         "input": {"cmd": "die"}, "state": {}},
+                target="site_0", rec=rec,
+            )
+        assert isinstance(exc_info.value.last, WorkerCrashed)
+        assert exc_info.value.attempts == 3
+        out = eng._invoke(script, {"cache": first["cache"], "input": {},
+                                   "state": {}}, target="site_0", rec=rec)
+        assert out["output"]["pid"] != pid0
+        # the restarted worker lost its live cache; the engine's JSON
+        # cache round-trip is the durable state it resumed from
+        assert out["output"]["n"] == first["cache"]["n"] + 1
+        rec.flush()
+        events = load_events(eng.workdir)
+        names = [e["name"] for e in events if e.get("kind") == "event"]
+        assert names.count(Daemon.EVENT_START) == 1
+        # 2 restarts inside the exhausted call + 1 for the benign call
+        assert names.count(Daemon.EVENT_RESTART) == 3
+        restart = next(e for e in events
+                       if e.get("name") == Daemon.EVENT_RESTART)
+        assert restart["target"] == "site_0"
+        assert restart["generation"] == 2
+        assert "error" in restart
+    finally:
+        eng.close()
+
+
+def test_wedged_worker_times_out_typed_and_restarts(tmp_path):
+    """A worker that stops responding raises WorkerTimeout (after landing
+    an invoke:timeout event), is killed for restart, and the next
+    invocation gets a fresh worker."""
+    from coinstac_dinunet_tpu.resilience.retry import RetryExhausted
+
+    eng, script = _echo_engine(tmp_path)
+    eng.timeout = 1
+    rec = _engine_rec(eng)
+    try:
+        first = eng._invoke(script, {"cache": {}, "input": {}, "state": {}},
+                            target="site_0", rec=rec)
+        with pytest.raises(RetryExhausted) as exc_info:
+            eng._invoke(script, {"cache": {}, "input": {"cmd": "wedge"},
+                                 "state": {}}, target="site_0", rec=rec)
+        assert isinstance(exc_info.value.last, WorkerTimeout)
+        out = eng._invoke(script, {"cache": {}, "input": {}, "state": {}},
+                          target="site_0", rec=rec)
+        assert out["output"]["pid"] != first["output"]["pid"]
+        rec.flush()
+        events = load_events(eng.workdir)
+        timeouts = [e for e in events if e.get("name") == "invoke:timeout"]
+        assert timeouts and timeouts[0]["target"] == "site_0"
+    finally:
+        eng.close()
+
+
+def test_node_error_is_not_a_worker_failure(tmp_path):
+    """A node-level exception comes back as a plain RuntimeError carrying
+    the worker traceback; the worker itself stays up (same pid after)."""
+    eng, script = _echo_engine(tmp_path)
+    rec = _engine_rec(eng)
+    try:
+        first = eng._invoke(script, {"cache": {}, "input": {}, "state": {}},
+                            target="site_0", rec=rec)
+        with pytest.raises(RuntimeError, match="node-level failure"):
+            eng._invoke(script, {"cache": {}, "input": {"cmd": "boom"},
+                                 "state": {}}, target="site_0", rec=rec)
+        out = eng._invoke(script, {"cache": first["cache"], "input": {},
+                                   "state": {}}, target="site_0", rec=rec)
+        assert out["output"]["pid"] == first["output"]["pid"]
+        rec.flush()
+        events = load_events(eng.workdir)
+        names = [e["name"] for e in events if e.get("kind") == "event"]
+        assert Daemon.EVENT_RESTART not in names
+    finally:
+        eng.close()
+
+
+# --------------------------------------------- fresh-process timeout satellite
+def test_subprocess_timeout_is_typed_with_partial_stderr(tmp_path):
+    """SubprocessEngine._invoke maps subprocess.TimeoutExpired to the typed
+    InvokeTimeout (partial stderr in the failure record) and lands an
+    invoke:timeout event — doctor-attributable like any other site
+    failure."""
+    from coinstac_dinunet_tpu.engine import SubprocessEngine
+
+    script = tmp_path / "sleepy.py"
+    script.write_text(textwrap.dedent("""
+        import sys, time
+        print("about to wedge", file=sys.stderr, flush=True)
+        time.sleep(60)
+    """))
+    eng = SubprocessEngine(
+        tmp_path / "wd", n_sites=1, local_script=str(script),
+        remote_script=str(script), env=_env(tmp_path), timeout=1,
+    )
+    rec = _engine_rec(eng)
+    with pytest.raises(InvokeTimeout, match="about to wedge"):
+        eng._invoke(str(script), {"cache": {}, "input": {}, "state": {}},
+                    target="site_0", rec=rec)
+    rec.flush()
+    events = load_events(eng.workdir)
+    timeouts = [e for e in events if e.get("name") == "invoke:timeout"]
+    assert len(timeouts) == 1
+    assert timeouts[0]["target"] == "site_0"
+    assert "about to wedge" in timeouts[0]["stderr"]
+
+
+# ------------------------------------------------------- acceptance (FSV run)
+def test_daemon_run_matches_in_process_and_reuses_workers(
+        tmp_path, inproc_golden):
+    """ISSUE-11 (a)+(b): the daemon run's score trajectory equals the
+    in-process golden on the 3-site acceptance run, every target keeps ONE
+    worker pid for the whole run, and each compiled surface builds exactly
+    once federation-wide (the whole point of staying warm)."""
+    eng = _daemon_engine(tmp_path, "daemon")
+    try:
+        eng.step_round()
+        pids_round1 = eng.worker_pids()
+        assert set(pids_round1) == {"site_0", "site_1", "site_2", "remote"}
+        eng.run(max_rounds=200)
+        assert eng.success, eng.last_remote_out
+        assert eng.worker_pids() == pids_round1  # warm across the WHOLE run
+
+        for key, golden in inproc_golden.items():
+            got = np.asarray(eng.remote_cache[key], np.float64)
+            assert got.shape == golden.shape, (key, got, golden)
+            np.testing.assert_allclose(got, golden, atol=2e-3, err_msg=key)
+    finally:
+        eng.close()
+
+    events = load_events(str(tmp_path / "daemon"))
+    # exactly one jit_build per (node, surface): no per-round recompiles
+    builds = {}
+    for e in events:
+        if e.get("kind") == "event" and e.get("name") == "jit_build":
+            builds[(e.get("node"), e.get("fn"))] = (
+                builds.get((e.get("node"), e.get("fn")), 0) + 1
+            )
+    assert builds, "no jit_build events recorded — telemetry not enabled?"
+    assert all(n == 1 for n in builds.values()), builds
+    # one worker:start per target, zero restarts, heartbeats per invocation
+    names = [e["name"] for e in events if e.get("kind") == "event"]
+    assert names.count(Daemon.EVENT_START) == N_SITES + 1
+    assert names.count(Daemon.EVENT_RESTART) == 0
+    beats = [e for e in events if e.get("name") == Live.HEARTBEAT]
+    assert {e.get("site") for e in beats} == {
+        "site_0", "site_1", "site_2", "remote"
+    }
+
+
+def test_chaos_worker_kill_drill_survives_via_restart(
+        tmp_path, inproc_golden):
+    """ISSUE-11 (c): SIGKILL site_1's worker mid-invocation at round 4 and
+    site_0's between rounds at round 6 — both sites SURVIVE via supervised
+    restarts (no quorum drop), the run completes with score parity, and
+    the restarts + heartbeat gap are visible to the live ops plane."""
+    plan = {"faults": [
+        {"kind": "worker_kill", "round": 4, "site": "site_1"},
+        {"kind": "worker_kill", "round": 6, "site": "site_0",
+         "when": "idle"},
+    ]}
+    eng = _daemon_engine(tmp_path, "drill", fault_plan=plan)
+    tailer = Tailer(str(tmp_path / "drill"))
+    live = LiveState(silence_after=30.0)
+    try:
+        for _ in range(3):
+            eng.step_round()
+        pids_before = dict(eng.worker_pids())
+        eng.run(max_rounds=200)
+        assert eng.success, eng.last_remote_out
+        assert eng.dead_sites == set()  # supervision, not quorum
+        pids_after = eng.worker_pids()
+        assert pids_after["site_1"] != pids_before["site_1"]
+        assert pids_after["site_0"] != pids_before["site_0"]
+        assert pids_after["remote"] == pids_before["remote"]
+
+        for key, golden in inproc_golden.items():
+            got = np.asarray(eng.remote_cache[key], np.float64)
+            np.testing.assert_allclose(got, golden, atol=2e-3, err_msg=key)
+    finally:
+        eng.close()
+
+    # the live ops plane sees the churn: restart counters per site, and
+    # the killed worker's heartbeat gap brackets its restart event
+    live.ingest(tailer.poll())
+    snap = live.snapshot()
+    assert snap["worker_restarts"] == 2
+    assert snap["sites"]["site_1"]["worker_restarts"] == 1
+    assert snap["sites"]["site_0"]["worker_restarts"] == 1
+    assert snap["dead_sites"] == []
+
+    events = load_events(str(tmp_path / "drill"))
+    restarts = [e for e in events if e.get("name") == Daemon.EVENT_RESTART]
+    assert {e["target"] for e in restarts} == {"site_0", "site_1"}
+    kill_events = [e for e in events if e.get("name") == "chaos:inject"
+                   and e.get("fault") == "worker_kill"]
+    assert len(kill_events) == 2
+    # heartbeat-gap evidence: site_1's engine-lane heartbeats bracket the
+    # restart with a gap at least as long as the worker respawn took
+    site1_restart = next(e for e in restarts if e["target"] == "site_1")
+    beats = sorted(e["t0"] for e in events
+                   if e.get("name") == Live.HEARTBEAT
+                   and e.get("site") == "site_1")
+    before = [t for t in beats if t <= site1_restart["t0"]]
+    after = [t for t in beats if t > site1_restart["t0"]]
+    assert before and after, "restart not bracketed by heartbeats"
+    assert after[0] - before[-1] >= site1_restart["warm_s"]
+
+
+def test_worker_kill_plan_validates_in_the_schema():
+    """worker_kill fault-plan entries (incl. the 'when' kill point) load;
+    a bad 'when' is refused."""
+    from coinstac_dinunet_tpu.resilience.chaos import load_fault_plan
+
+    faults = load_fault_plan({"faults": [
+        {"kind": "worker_kill", "round": 2, "site": "site_0"},
+        {"kind": "worker_kill", "round": 3, "site": "site_1",
+         "when": "idle"},
+    ]})
+    assert [f.when for f in faults] == ["invoke", "idle"]
+    with pytest.raises(ValueError, match="'when'"):
+        load_fault_plan({"faults": [
+            {"kind": "worker_kill", "round": 2, "site": "site_0",
+             "when": "never"},
+        ]})
+    with pytest.raises(ValueError, match="'site' is required"):
+        load_fault_plan({"faults": [{"kind": "worker_kill", "round": 2}]})

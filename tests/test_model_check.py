@@ -503,3 +503,56 @@ def test_new_fault_kinds_validate_in_the_plan_schema():
     assert faults[1].times is None and faults[0].times == 1
     with pytest.raises(ValueError, match="'site' is required"):
         load_fault_plan({"faults": [{"kind": "stale", "round": 2}]})
+
+
+def test_worker_actions_in_alphabet_and_plans_map_to_worker_kill():
+    """ISSUE 11: the daemon supervision actions are explored by default,
+    and their counterexample plans are executable worker_kill chaos
+    entries (the daemon engine's fault) with the matching kill point."""
+    from coinstac_dinunet_tpu.analysis.model_check import (
+        FAULT_ALPHABET,
+        _plan_faults,
+        _Trace,
+    )
+
+    assert "worker_crash" in FAULT_ALPHABET
+    assert "worker_restart" in FAULT_ALPHABET
+    trace = _Trace().extend(2, [("worker_crash", 1)]).extend(
+        3, [("worker_restart", 0)]
+    )
+    plan = _plan_faults(trace, "avg_grads.npy", ".wire_manifest.json")
+    assert plan == [
+        {"kind": "worker_kill", "round": 2, "site": "site_1",
+         "when": "invoke"},
+        {"kind": "worker_kill", "round": 3, "site": "site_0",
+         "when": "idle"},
+    ]
+    # and the emitted plan is loadable by the chaos schema as-is
+    faults = load_fault_plan({"faults": plan})
+    assert [f.when for f in faults] == ["invoke", "idle"]
+
+
+def test_broken_restart_supervisor_is_refused_or_caught(monkeypatch):
+    """The supervision invariants are CHECKABLE, not vacuous: model a
+    broken supervisor that redelivers the crashed worker's previous
+    output instead of re-invoking.  With the wire_round stamp intact the
+    protocol refuses the redelivery loudly (still clean — PR 9's stamp
+    protects against a broken supervisor); with the stamp fact flipped,
+    the double-count surfaces as STALE_CONTRIBUTION with a replayable
+    worker_kill counterexample."""
+    from coinstac_dinunet_tpu.analysis import model_check as mc
+
+    cfg = ModelConfig(kinds=("worker_crash",))
+    # healthy supervisor (re-invoke): clean at the worker-only bound
+    assert run_model_check(config=cfg).findings == []
+    monkeypatch.setattr(mc, "_RESTART_REDELIVERS_LAST_OUTPUT", True)
+    # broken supervisor, stamp intact: refused loudly, still clean
+    assert run_model_check(config=cfg).findings == []
+    # broken supervisor, no round stamp: the invariant fires via the
+    # worker action and ships a worker_kill chaos plan
+    ir = proto_ir.build_protocol_ir()
+    ir.facts.round_lockstep_guard = False
+    res = run_model_check(config=cfg, ir=ir)
+    assert {f.rule for f in res.findings} == {ModelCheck.STALE_CONTRIBUTION}
+    assert any(f0["kind"] == "worker_kill"
+               for p in res.plans for f0 in p["faults"])
