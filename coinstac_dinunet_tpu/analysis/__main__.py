@@ -76,6 +76,13 @@ def build_parser():
                    help="fault budget per explored run — the "
                         "simultaneous-fault tolerance level verified "
                         "(default: ModelCheck.DEFAULT_FAULT_BUDGET)")
+    p.add_argument("--model-staleness", type=int, default=None,
+                   help="async staleness window k explored ALONGSIDE "
+                        "lockstep: every scenario runs at k=0 (exact "
+                        "wire_round stamp) and at this k (window-relaxed "
+                        "stamp + the staleness_k action; 0 disables the "
+                        "async dimension entirely; default: "
+                        "ModelCheck.DEFAULT_STALENESS_K)")
     p.add_argument("--model-plans", default=None, metavar="DIR",
                    help="write each proto-model-* counterexample as an "
                         "executable resilience/chaos.py fault plan JSON "
@@ -161,10 +168,11 @@ def main(argv=None):
 
     if not args.model and any(
         v is not None for v in (args.model_sites, args.model_rounds,
-                                args.model_faults, args.model_plans)
+                                args.model_faults, args.model_plans,
+                                args.model_staleness)
     ):
-        print("--model-sites/--model-rounds/--model-faults/--model-plans "
-              "require --model", file=sys.stderr)
+        print("--model-sites/--model-rounds/--model-faults/--model-plans/"
+              "--model-staleness require --model", file=sys.stderr)
         return 2
     if args.model_sites is not None and args.model_sites < 1:
         print(f"--model-sites {args.model_sites}: need at least 1 site",
@@ -178,6 +186,11 @@ def main(argv=None):
     if args.model_faults is not None and args.model_faults < 0:
         print(f"--model-faults {args.model_faults}: the fault budget "
               "cannot be negative (0 = fault-free runs only)",
+              file=sys.stderr)
+        return 2
+    if args.model_staleness is not None and args.model_staleness < 0:
+        print(f"--model-staleness {args.model_staleness}: the async "
+              "window cannot be negative (0 = lockstep only)",
               file=sys.stderr)
         return 2
     rule_ids = args.rules.split(",") if args.rules else None
@@ -264,6 +277,11 @@ def main(argv=None):
         from .model_check import MODEL_RULE_IDS, ModelConfig, run_model_check
 
         defaults = ModelConfig()
+        staleness = defaults.staleness
+        if args.model_staleness is not None:
+            staleness = (
+                (0, args.model_staleness) if args.model_staleness else (0,)
+            )
         cfg = ModelConfig(
             sites=(args.model_sites if args.model_sites is not None
                    else defaults.sites),
@@ -271,6 +289,7 @@ def main(argv=None):
                     else defaults.rounds),
             max_faults=(args.model_faults if args.model_faults is not None
                         else defaults.max_faults),
+            staleness=staleness,
         )
         result = run_model_check(config=cfg, plans_dir=args.model_plans)
         model_findings = result.findings

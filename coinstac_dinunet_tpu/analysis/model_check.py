@@ -64,11 +64,22 @@ FAULT_ALPHABET = (
     "truncate_payload", "corrupt_payload",
     "drop_relay", "duplicate_delivery",
     "worker_crash", "worker_restart",
+    # ISSUE 12: the async round engine's stand-in — the site is not
+    # invoked this round and its LAST output (with its lagging wire_round
+    # echo) is delivered instead.  Mechanically a delayed duplicate like
+    # ``stale``, but scheduled only in scenarios whose staleness window k
+    # is positive: the aggregator must ACCEPT it while the echo lags by at
+    # most k (the reducer down-weights it — not modeled) and must still
+    # refuse anything older.  Repeated firings age the stand-in past the
+    # window, which is how the seeded k-violation reaches the boundary.
+    "staleness_k",
 )
 
 #: model action -> replayable chaos fault-plan kind (worker actions map to
-#: the daemon engine's worker_kill fault with the matching kill point)
+#: the daemon engine's worker_kill fault with the matching kill point;
+#: staleness_k replays as the engines' ``stale`` replay fault)
 _WORKER_ACTIONS = {"worker_crash": "invoke", "worker_restart": "idle"}
+_STALE_ACTIONS = ("stale", "staleness_k")
 
 #: broken-supervisor semantics switch (tests only): a mis-implemented
 #: daemon supervisor might REDELIVER the crashed worker's previous output
@@ -79,6 +90,16 @@ _WORKER_ACTIONS = {"worker_crash": "invoke", "worker_restart": "idle"}
 #: redelivery loudly; with the stamp fact flipped, STALE_CONTRIBUTION
 #: fires with a worker_kill counterexample plan.
 _RESTART_REDELIVERS_LAST_OUTPUT = False
+
+#: broken-window semantics switch (tests only): a mis-implemented async
+#: window check might accept a contribution OLDER than the staleness bound
+#: k into the reduce — the exact boundary the window-relaxed
+#: STALE_CONTRIBUTION invariant patrols.  ``tests/test_async.py`` flips
+#: this to prove the staleness_k action is checkable, not vacuous: with
+#: the real window semantics a beyond-k echo is refused loudly (clean);
+#: with the flip, STALE_CONTRIBUTION fires with a replayable ``stale``
+#: chaos plan.
+_WINDOW_ACCEPTS_BEYOND_K = False
 
 #: broadcast-channel components a relay fault can target
 _COMPONENTS = ("payload", "manifest")
@@ -98,7 +119,12 @@ MAX_STATES = 250_000
 
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
-    """Exploration bound (defaults = the CI gate's contract)."""
+    """Exploration bound (defaults = the CI gate's contract).
+
+    ``staleness`` is a scenario dimension like ``quorums``: every bound is
+    explored at each listed async window k.  k=0 is today's exact-stamp
+    lockstep (every stale delivery refused); k>0 relaxes the stamp to the
+    window and schedules the ``staleness_k`` action."""
 
     sites: int = ModelCheck.DEFAULT_SITES
     rounds: int = ModelCheck.DEFAULT_ROUNDS
@@ -106,6 +132,7 @@ class ModelConfig:
     kinds: tuple = FAULT_ALPHABET
     quorums: tuple = (None, 1)
     pretrain: tuple = (False, True)
+    staleness: tuple = (0, ModelCheck.DEFAULT_STALENESS_K)
 
     @property
     def engine_rounds(self):
@@ -124,11 +151,16 @@ class ModelResult:
 # All state is plain hashable tuples.
 #
 # site:   (alive, redeliver_rnd, applied_tag, cache_keys, any_write,
-#          had_comp, last_out)      last_out = (phase, keys, contrib, echo_ok)
+#          had_comp, last_out)
+#         last_out = (phase, keys, contrib, echo_ok, made_rnd) — made_rnd
+#         is the engine round the output was produced in, so a stale
+#         delivery's echo lag (rnd - made_rnd) is judged against the
+#         scenario's staleness window
 # chan:   (payload_tag, manifest_tag, repairs)   repairs ⊆ {components}
 # remote: (cache_keys, any_write, dropped)
 # bcast:  (phase, keys, update_tag)
 # state:  (rnd, budget, sites, chans, remote, bcast, reduces)
+# scenario: (site_quorum, pretrain, staleness_k)
 
 _FRESH_SITE = (True, 0, 0, frozenset(), False, False, None)
 _FRESH_CHAN = (0, 0, frozenset())
@@ -183,6 +215,10 @@ def _plan_faults(trace, avg_file, manifest_file):
             # worker_kill fault at the matching kill point
             entry["kind"] = "worker_kill"
             entry["when"] = _WORKER_ACTIONS[kind]
+        elif kind == "staleness_k":
+            # the executable counterpart is the engines' stale replay
+            # fault: skip the invocation, redeliver the previous output
+            entry["kind"] = "stale"
         elif kind in ("truncate_payload", "corrupt_payload"):
             entry["file"] = "grads.npy"
         elif comp is not None:
@@ -279,15 +315,18 @@ class _Explorer:
                 "n_sites": self.config.sites,
                 "site_quorum": scenario[0],
                 "pretrain": bool(scenario[1]),
+                "staleness_k": int(scenario[2]) if len(scenario) > 2 else 0,
                 "engine_rounds": self.config.engine_rounds,
             },
             "faults": _plan_faults(trace, "avg_grads.npy",
                                    ".wire_manifest.json"),
         }
         quorum = scenario[0]
+        k = int(scenario[2]) if len(scenario) > 2 else 0
         msg = (
             f"{message} — counterexample: site_quorum={quorum}, "
-            f"pretrain={bool(scenario[1])}, faults=[{trace.describe()}] "
+            f"pretrain={bool(scenario[1])}, staleness_k={k}, "
+            f"faults=[{trace.describe()}] "
             f"(bound: {self.config.sites} sites x {self.config.rounds} "
             f"rounds, budget {self.config.max_faults}); replayable chaos "
             "plan via --model-plans"
@@ -387,10 +426,12 @@ class _Explorer:
             redeliver_rnd = rnd + 1 if "reappear" in my_faults else 0
             return ((False, redeliver_rnd, applied, cache, any_w, had_comp,
                      last), chan, None, None)
-        if "stale" in my_faults and last is not None:
-            # delayed duplicate: previous output redelivered, cache frozen
-            phase, keys, contrib, _ = last
-            return site, chan, (phase, keys, contrib, False), None
+        if (my_faults & set(_STALE_ACTIONS)) and last is not None:
+            # delayed duplicate / async stand-in: previous output
+            # redelivered, cache frozen — the echo lag (rnd - made_rnd)
+            # grows with every repeated firing
+            phase, keys, contrib, _, made = last
+            return site, chan, (phase, keys, contrib, False, made), None
 
         incoming = bcast[0] if bcast else "init_runs"
         executed, out_phase = _local_dispatch(
@@ -414,8 +455,9 @@ class _Explorer:
         if "worker_crash" in my_faults:
             if _RESTART_REDELIVERS_LAST_OUTPUT:
                 if last is not None:
-                    phase, keys, contrib, _ = last
-                    return site, chan, (phase, keys, contrib, False), None
+                    phase, keys, contrib, _, made = last
+                    return site, chan, (phase, keys, contrib, False,
+                                        made), None
             else:
                 _, cache_crash, anyw_crash = self._exec_events(
                     self.ir.local, site, executed, incoming, msg_keys,
@@ -485,7 +527,7 @@ class _Explorer:
 
         had_comp = had_comp or "computation" in executed
         contrib = rnd if "reduce" in produced else 0
-        out = (out_phase, frozenset(produced), contrib, True)
+        out = (out_phase, frozenset(produced), contrib, True, rnd)
         site = (alive, redeliver, applied, cache, any_w, had_comp, out)
         return site, chan, out, None
 
@@ -533,10 +575,29 @@ class _Explorer:
             )
             return remote, None, None, False
         phase = next(iter(phases)) if phases else "init_runs"
-        # stale same-phase message: only the echoed round stamp catches it
+        # stale same-phase message: only the echoed round stamp catches it.
+        # Under the async window (scenario staleness_k > 0 AND the guard
+        # implements the cache-keyed window — facts.round_lockstep_window)
+        # an echo lagging by at most k is ACCEPTED: the async engine's
+        # stand-in for a straggler, down-weighted by the reducer (not
+        # modeled).  Anything older must still be refused loudly; the
+        # test-only _WINDOW_ACCEPTS_BEYOND_K switch models a broken window
+        # check that lets it through, which the window-relaxed
+        # STALE_CONTRIBUTION invariant below must catch.
+        window = (
+            int(scenario[2])
+            if facts.round_lockstep_guard and facts.round_lockstep_window
+            else 0
+        )
         stale_in = {i for i in filtered if stale_flags.get(i)}
         if stale_in and facts.round_lockstep_guard:
-            return remote, None, "stale round echo refused", False
+            beyond = {
+                i for i in stale_in
+                if rnd - (filtered[i][4] if len(filtered[i]) > 4 else rnd)
+                > window
+            }
+            if beyond and not _WINDOW_ACCEPTS_BEYOND_K:
+                return remote, None, "stale round echo refused", False
 
         if phase not in self.ir.remote.tested_phases:
             fallthrough = self.ir.remote.phase_fallthrough
@@ -584,6 +645,13 @@ class _Explorer:
                 if "reduce" not in out[1]:
                     continue
                 if contrib and contrib < rnd:
+                    if i not in dropped and rnd - contrib <= window:
+                        # in-window stand-in of a LIVE site: the window-
+                        # relaxed exactly-once contract accepts it (the
+                        # reducer's staleness discount weights it down) —
+                        # only contributions OLDER than the window, or a
+                        # dropped site's redelivery, violate
+                        continue
                     if i in dropped:
                         anchor, why = self._anchor("reduce_input"), (
                             "the reducer's input snapshot is taken before "
@@ -635,8 +703,10 @@ class _Explorer:
         return remote, (out_phase, keys, update_tag, reduced), None, reduced
 
     # ---------------------------------------------------------------- rounds
-    def _round_actions(self, state):
-        """Every single-fault action available this round, sorted."""
+    def _round_actions(self, state, scenario):
+        """Every single-fault action available this round, sorted.  The
+        ``staleness_k`` action only exists in scenarios whose window is
+        positive — at k=0 the async engine never stands a site in."""
         rnd, budget, sites, chans, remote, bcast, reduces = state
         if budget <= 0:
             return []
@@ -648,9 +718,12 @@ class _Explorer:
                 if kind in ("drop_relay", "duplicate_delivery"):
                     for comp in _COMPONENTS:
                         actions.append((kind, i, comp))
-                elif kind == "stale":
-                    if site[6] is not None:
-                        actions.append((kind, i))
+                elif kind in _STALE_ACTIONS:
+                    if site[6] is None:
+                        continue
+                    if kind == "staleness_k" and not scenario[2]:
+                        continue
+                    actions.append((kind, i))
                 else:
                     actions.append((kind, i))
         return sorted(actions)
@@ -681,8 +754,8 @@ class _Explorer:
         # reappear redeliveries (death fired one round earlier)
         for i, site in enumerate(new_sites):
             if not site[0] and site[1] == rnd and site[6] is not None:
-                phase, keys, contrib, _ = site[6]
-                site_outs[i] = (phase, keys, contrib, False)
+                phase, keys, contrib, _, made = site[6]
+                site_outs[i] = (phase, keys, contrib, False, made)
                 stale_flags[i] = True
                 new_sites[i] = site[:1] + (0,) + site[2:]
 
@@ -735,7 +808,8 @@ class _Explorer:
     def explore(self):
         for quorum in self.config.quorums:
             for pretrain in self.config.pretrain:
-                self._explore_scenario((quorum, pretrain))
+                for k in self.config.staleness:
+                    self._explore_scenario((quorum, pretrain, int(k)))
         findings = [f for f, _ in self.findings.values()]
         plans = [p for _, p in self.findings.values()]
         order = sorted(
@@ -777,7 +851,7 @@ class _Explorer:
                         scenario, trace, "deadlock freedom",
                     )
                 continue
-            singles = self._round_actions(state)
+            singles = self._round_actions(state, scenario)
             subsets = [()]
             # the whole remaining budget may be spent in ONE round: the
             # --model-faults contract is the simultaneous-fault tolerance

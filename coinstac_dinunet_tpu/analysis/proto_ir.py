@@ -129,6 +129,11 @@ class SemanticFacts:
     quorum_before_reduce_input: bool = True
     lockstep_phase_guard: bool = True
     round_lockstep_guard: bool = True
+    # the round-stamp guard honors the async staleness WINDOW
+    # (Federation.ASYNC_STALENESS): an echo lagging by at most k is
+    # accepted instead of refused — the relaxation the staleness_k model
+    # action exercises (ISSUE 12)
+    round_lockstep_window: bool = True
     heal_bridges_manifest: bool = True
     anchors: dict = dataclasses.field(default_factory=dict)
 
@@ -400,8 +405,12 @@ def extract_remote_facts(remote_module, facts):
     ]
     facts.lockstep_phase_guard = bool(lockstep_names)
     # the stale-in-steady-state defense: the lockstep guard also compares
-    # the echoed round stamp (LocalWire.ROUND / the "wire_round" value)
+    # the echoed round stamp (LocalWire.ROUND / the "wire_round" value),
+    # and — since ISSUE 12 — may relax the exact-stamp comparison to the
+    # async staleness WINDOW (a reference to Federation.ASYNC_STALENESS /
+    # "async_staleness" inside the guard method is the marker)
     facts.round_lockstep_guard = False
+    facts.round_lockstep_window = False
     for name in lockstep_names:
         body = methods.get(name)
         if body is None:
@@ -414,6 +423,8 @@ def extract_remote_facts(remote_module, facts):
                 marker = sub.value
             if marker in ("ROUND", "wire_round"):
                 facts.round_lockstep_guard = True
+            if marker in ("ASYNC_STALENESS", "async_staleness"):
+                facts.round_lockstep_window = True
     if snapshot_line is not None:
         facts.anchors["reduce_input"] = (remote_module.path, snapshot_line)
     if quorum_line is not None:

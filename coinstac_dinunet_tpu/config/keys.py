@@ -201,6 +201,13 @@ class Metric:
     - ``ROUNDS_PER_SEC`` / ``SITES_PER_SEC`` — mega-federation engine
       throughput per round (``federation/engine.py``), same round
       definition as ``scripts/bench_federation.py``'s headline.
+    - ``SITE_STALENESS`` — per-site contribution staleness in rounds
+      under the async round engine (``Federation.ASYNC_STALENESS``):
+      0 = fresh this round, ``j`` = the site's last payload is ``j``
+      rounds behind the aggregator's ``wire_round``.  Recorded by the
+      engine at every delivery/stand-in and by the aggregator's window
+      check; the live board/Prometheus per-site staleness gauge and the
+      ``staleness_exceeded`` verdict read it.
     """
 
     GRAD_NORM = "grad_norm"
@@ -222,6 +229,7 @@ class Metric:
     HBM_UTILIZATION = "hbm_utilization"
     ROUNDS_PER_SEC = "rounds_per_sec"
     SITES_PER_SEC = "sites_per_sec"
+    SITE_STALENESS = "site_staleness"
 
 
 class Anomaly:
@@ -314,10 +322,36 @@ class Federation:
       stacked ``MeshAxis.SITE`` axis over (``federation/vector.py``).
       Default: every local device when it divides ``n_sites``, else 1
       (pure vmap).
+    - ``ASYNC_STALENESS`` — staleness bound ``k`` of the async round
+      engine (``engine.py::_step_round_async``; computation/communication-
+      decoupled SGD, arXiv:1906.12043).  ``0``/unset is today's lockstep;
+      ``k >= 1`` lets a straggling site's LAST contribution stand in for
+      up to ``k`` rounds (its echoed ``wire_round`` stamp then lags the
+      aggregator's by up to ``k``), with the aggregator's lockstep check
+      relaxed from exact-stamp to window semantics
+      (``nodes/remote.py::_check_lockstep_phases``) and the reducer
+      down-weighting stale contributions (``ASYNC_DISCOUNT``).  Frozen
+      into ``shared_args`` so every transport's aggregator sees the same
+      window the engine enforces.
+    - ``ASYNC_POOL`` — bounded invocation-pool size of the async engine
+      (sites invoked concurrently per round).  Default when async is on:
+      ``n_sites`` for the process-backed engines; the in-process engine
+      caps it at 1 (nodes share the ambient telemetry stack + the GIL).
+      ``async_invoke_pool=1`` with ``k=0`` runs the async code path in
+      strict serial order — score-identical to the serial template
+      (pinned in ``tests/test_async.py``).
+    - ``ASYNC_DISCOUNT`` — per-round staleness decay ``gamma`` of a stale
+      contribution's reduce weight (``parallel/reducer.py``): a payload
+      ``j`` rounds behind enters the participation-weighted mean at
+      ``grad_weight * gamma**j``, composing with the survivor/nonfinite
+      weighting.  Default 0.5.
     """
 
     REDUCE_FANIN = "reduce_fanin"
     SITE_SHARDS = "site_shards"
+    ASYNC_STALENESS = "async_staleness"
+    ASYNC_POOL = "async_invoke_pool"
+    ASYNC_DISCOUNT = "async_stale_discount"
 
 
 class Perf:
@@ -396,6 +430,11 @@ class Live:
     - ``VERDICT_ROUND_OUTLIER`` — a round blew past the rolling median.
     - ``VERDICT_MFU_COLLAPSE`` — utilization collapsed vs its own EMA.
     - ``VERDICT_RETRY_STORM`` — wire retries bursting (flaky relay).
+    - ``VERDICT_STALENESS`` — under async rounds
+      (``Federation.ASYNC_STALENESS``) a site fell MORE than ``k`` rounds
+      behind: the engine had to block on it (or it died — the evidence
+      reuses the dead-site retry-exhaustion attribution), so the
+      straggler is gating the federation again.
 
     ``PROM_PREFIX`` is the stable prefix of every exported Prometheus
     metric name (``coinstac_dinunet_<series>``); renaming it breaks every
@@ -414,6 +453,7 @@ class Live:
     VERDICT_ROUND_OUTLIER = "round_duration_outlier"
     VERDICT_MFU_COLLAPSE = "mfu_collapse"
     VERDICT_RETRY_STORM = "wire_retry_storm"
+    VERDICT_STALENESS = "staleness_exceeded"
 
 
 class Daemon:
@@ -526,6 +566,12 @@ class ModelCheck:
     - ``STALE_CONTRIBUTION`` / ``LOST_CONTRIBUTION`` — every gradient
       contribution is counted exactly once: no stale/redelivered payload
       enters a reduce, no fresh survivor payload is dropped from one.
+      Under the async window (the ``staleness_k`` action +
+      ``Federation.ASYNC_STALENESS``) the invariant is window-relaxed:
+      a stale delivery whose ``wire_round`` echo lags by at most ``k``
+      is ACCEPTED (down-weighted by the reducer, not modeled here);
+      anything older must still be refused loudly — a contribution
+      beyond the window entering a reduce is the violation.
     - ``LOST_UPDATE`` — every broadcast update is applied by every alive
       site exactly once (never silently replaced by a stale delivery).
     - ``UNRECOVERABLE`` — a single transient relay fault never kills a
@@ -544,6 +590,10 @@ class ModelCheck:
     DEFAULT_SITES = 2
     DEFAULT_ROUNDS = 3      # federated reduce rounds inside the bound
     DEFAULT_FAULT_BUDGET = 1  # simultaneous-fault tolerance level verified
+    # async staleness window explored alongside lockstep: every scenario
+    # runs at k=0 (exact stamp) AND k=DEFAULT_STALENESS_K (window stamp +
+    # the staleness_k action) — the relaxed protocol is checked by default
+    DEFAULT_STALENESS_K = 1
 
     DEADLOCK = "proto-model-deadlock"
     PHASE_RESET = "proto-model-phase-reset"

@@ -19,11 +19,13 @@ import datetime
 import math
 import os
 import shutil
+import statistics
+import time
 
 import numpy as np
 
 from . import config, telemetry, utils
-from .config.keys import Key, Live, Mode, Phase
+from .config.keys import Federation, Key, Live, Metric, Mode, Phase
 from .telemetry import capture as _capture
 from .data import COINNDataHandle
 from .nodes import COINNLocal, COINNRemote
@@ -186,6 +188,21 @@ class InProcessEngine:
         # n_sites, not silently absorbed into a shrunken roster
         # (COINNRemote._init_runs setdefaults, so this wins)
         self.remote_cache["all_sites"] = list(self.site_ids)
+        # staleness-bounded async round state (_step_round_async): the
+        # bounded invocation pool, per-site pending futures, and the
+        # submission round of each site's last FRESH delivered output —
+        # lazily built, zero cost on the lockstep path
+        self._async_cfg = None
+        self._async_pool = None
+        self._async_pending = {}   # site -> (submit_round, future, policy)
+        self._async_last_sub = {}  # site -> submit round of last fresh out
+        self._async_snapshots = {}  # site -> {output file key -> snapshot}
+        # per-site recent invoke wall-times (grace basis).  The FIRST
+        # completed invocation per site is dropped: it carries the one-off
+        # cold start (worker spawn, imports, first compiles) and would
+        # inflate the grace window for the whole run
+        self._async_invoke_hist = {}
+        self._async_warm = set()
 
     # ------------------------------------------------------------- telemetry
     def _recorder(self):
@@ -406,14 +423,20 @@ class InProcessEngine:
     def _site_attempt(self, rnd, s, inp, rec):
         """ONE invocation attempt of site ``s``; returns its output dict.
         Raises on failure (the retry policy and quorum machinery in
-        ``step_round`` handle it)."""
-        self.chaos.invoke_fault(rnd, s, rec)
+        ``step_round`` handle it).  The chaos invoke fault fires INSIDE
+        the span: a ``slow`` fault's sleep is the site's simulated compute
+        and must show on the timeline (the ``wire_overlap_ratio`` metric
+        and the async span-overlap tests read it)."""
         node = COINNLocal(
             cache=self.site_caches[s], input=inp, state=self.site_states[s],
             **{**self.site_spec.get(s, {}), **self.args,
                **self.site_args.get(s, {})},
         )
-        with rec.span(f"invoke:{s}", cat="invoke"):
+        # round pinned as a span attr: a pool-thread invocation may outlive
+        # the round it was submitted in, and the ambient round context is
+        # only read at span END — the explicit attr wins over it
+        with rec.span(f"invoke:{s}", cat="invoke", round=rnd):
+            self.chaos.invoke_fault(rnd, s, rec)
             return node(
                 trainer_cls=self.trainer_cls,
                 dataset_cls=self.dataset_cls,
@@ -436,9 +459,42 @@ class InProcessEngine:
         self.success = bool(result.get("success"))
         return result["output"]
 
+    def _remote_and_relay(self, rnd, site_outs, rec):
+        """The round's wire half, shared by the lockstep and async paths:
+        replay-fault bookkeeping barrier, aggregator invocation (under its
+        retry policy), and the broadcast relay.  Returns the aggregator's
+        output dict."""
+        self._finish_site_outputs(rnd, site_outs, rec)
+        if not site_outs:
+            raise RuntimeError(
+                "every site died; nothing to aggregate — failures: "
+                f"{self.site_failures}"
+            )
+
+        remote_out = self._invoke_with_retry(
+            self._invoke_policy("remote"),
+            lambda: self._remote_attempt(rnd, site_outs, rec),
+            "remote", rec,
+        )
+        rec.event(Live.HEARTBEAT, cat="engine", site="remote")
+        self.last_remote_out = remote_out
+
+        with rec.span("engine:relay", cat="relay"):
+            self._relay_broadcast(rnd, rec)
+        return remote_out
+
     def step_round(self):
         """One full engine round: every site computes, files relay to the
-        aggregator, the aggregator computes, its output + files relay back."""
+        aggregator, the aggregator computes, its output + files relay back.
+
+        With the async configuration present (``Federation.ASYNC_STALENESS``
+        / ``Federation.ASYNC_POOL`` on any of the engine's arg channels) the
+        round runs through :meth:`_step_round_async` instead: sites are
+        invoked concurrently through a bounded pool and a straggler's last
+        contribution may stand in for up to ``k`` rounds."""
+        ac = self._async_config()
+        if ac["enabled"]:
+            return self._step_round_async(ac)
         rec = self._recorder()
         rnd = self.rounds + 1
         rec.set_context(round=rnd)
@@ -471,27 +527,299 @@ class InProcessEngine:
                     rnd, s, self.site_states[s]["transferDirectory"], rec
                 )
 
-            self._finish_site_outputs(rnd, site_outs, rec)
-            if not site_outs:
-                raise RuntimeError(
-                    "every site died; nothing to aggregate — failures: "
-                    f"{self.site_failures}"
-                )
-
-            remote_out = self._invoke_with_retry(
-                self._invoke_policy("remote"),
-                lambda: self._remote_attempt(rnd, site_outs, rec),
-                "remote", rec,
-            )
-            rec.event(Live.HEARTBEAT, cat="engine", site="remote")
-            self.last_remote_out = remote_out
-
-            with rec.span("engine:relay", cat="relay"):
-                self._relay_broadcast(rnd, rec)
+            remote_out = self._remote_and_relay(rnd, site_outs, rec)
         rec.flush()
         self.site_inputs = {s: dict(remote_out) for s in self._alive_site_ids()}
         self.rounds += 1
         return site_outs, remote_out
+
+    # ------------------------------------------------- async rounds (ISSUE 12)
+    # Staleness-bounded async rounds, per computation/communication-
+    # decoupled SGD (arXiv:1906.12043): every idle site is invoked through a
+    # bounded thread pool, and a site still computing when the round's
+    # reduce arrives may be represented by its LAST completed contribution
+    # for up to k = Federation.ASYNC_STALENESS rounds — so one slow site no
+    # longer gates the federation, and the aggregator's reduce + relay for
+    # round r overlap the straggler computing what becomes its round-r+1
+    # contribution.  The aggregator accepts the lagging ``wire_round`` echo
+    # inside the window (nodes/remote.py::_check_lockstep_phases) and the
+    # reducer down-weights it (parallel/reducer.py::_site_weights); the
+    # tier-4 model checker's ``staleness_k`` action proves the relaxed
+    # protocol's exactly-once invariants at the bound.
+    #
+    # Stand-ins are confined to the COMPUTATION steady state (every fresh
+    # output this round in TRAIN mode with a reduce payload, and the stand-
+    # in likewise): INIT/fold transitions and the validation/test barriers
+    # stay strictly lockstep — the engine blocks on the straggler there, so
+    # every barrier's score/epoch semantics are exactly the serial ones.
+
+    #: bounded-pool ceiling; the in-process engine pins 1 (its nodes share
+    #: the process-global ambient telemetry stack and the GIL — real
+    #: concurrency comes from the process-backed engines, where the pool
+    #: threads only do pipe/process I/O)
+    _ASYNC_POOL_CAP = 1
+
+    def _async_config(self):
+        """Resolve the async round configuration once per engine, over the
+        same arg channels as the quorum/retry knobs (``_target_config``):
+        async mode is ON when either ``Federation`` key is configured
+        anywhere; ``k=0`` with pool 1 runs the async path in strict serial
+        order (score-identical to the lockstep template — the parity
+        contract of ``tests/test_async.py``)."""
+        if self._async_cfg is not None:
+            return self._async_cfg
+        cfg = self._target_config("remote")
+        k_raw = cfg.get(Federation.ASYNC_STALENESS)
+        pool_raw = cfg.get(Federation.ASYNC_POOL)
+        enabled = k_raw is not None or pool_raw is not None
+        k = max(int(k_raw or 0), 0)
+        if pool_raw is not None:
+            pool = max(int(pool_raw), 1)
+        else:
+            pool = self.n_sites if enabled else 1
+        if self._ASYNC_POOL_CAP is not None:
+            pool = min(pool, self._ASYNC_POOL_CAP)
+        self._async_cfg = {"enabled": bool(enabled), "k": k, "pool": pool}
+        return self._async_cfg
+
+    def _ensure_async_pool(self, size):
+        if self._async_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._async_pool = ThreadPoolExecutor(
+                max_workers=int(size), thread_name_prefix="coinn-async"
+            )
+        return self._async_pool
+
+    #: collect-phase grace: a round waits up to this multiple of the
+    #: federation's TYPICAL invoke duration (median of per-site EMAs) for
+    #: in-flight invocations before falling back to stand-ins — so a round
+    #: always carries fresh contributions from every healthy site and only
+    #: a genuine straggler (this factor or more behind its peers) is
+    #: represented by its last payload
+    _ASYNC_GRACE_FACTOR = 2.0
+
+    def _async_attempt(self, policy, rnd, s, inp, rec):
+        """One site invocation under its retry policy — the pool task.  The
+        retry/heal machinery is the serial template's; only the calling
+        thread differs.  The wall duration feeds the per-site recent-
+        invoke window the collect-phase grace is derived from (first
+        completed invocation per site skipped — cold start)."""
+
+        def attempt():
+            return self._site_attempt(rnd, s, inp, rec)
+
+        t0 = time.monotonic()
+        out = self._invoke_with_retry(policy, attempt, s, rec)
+        dur = time.monotonic() - t0
+        if s in self._async_warm:
+            from collections import deque
+
+            self._async_invoke_hist.setdefault(s, deque(maxlen=8)).append(
+                dur
+            )
+        else:
+            self._async_warm.add(s)
+        return out
+
+    def _async_grace(self):
+        """Seconds the collect phase waits for in-flight invocations: the
+        grace factor times the cross-site median of each site's recent
+        median invoke time — a double median, so neither one straggler nor
+        one outlier sample can inflate everyone's wait.  None before any
+        warm invocation completed (warm-up rounds block anyway)."""
+        per_site = [
+            statistics.median(hist)
+            for hist in self._async_invoke_hist.values() if hist
+        ]
+        if not per_site:
+            return None
+        return self._ASYNC_GRACE_FACTOR * statistics.median(per_site)
+
+    def _async_standin_ok(self, s):
+        """A straggler's last output can stand in only when it is a steady-
+        state TRAIN contribution (phase COMPUTATION, mode TRAIN, reduce
+        payload attached): barrier/transition outputs must never be
+        replayed — their keys drive epoch/fold state the protocol counts
+        exactly once."""
+        prev = self._last_site_outs.get(s)
+        return (
+            prev is not None
+            and prev.get("phase") == Phase.COMPUTATION.value
+            and prev.get("mode") == Mode.TRAIN.value
+            and bool(prev.get("reduce"))
+        )
+
+    def _async_steady(self, site_outs):
+        """True when this round is in the COMPUTATION/TRAIN steady state as
+        far as every FRESH output collected so far shows — the only regime
+        stand-ins are allowed in.  Any barrier signal (a waiting mode, a
+        phase transition, a non-computation broadcast) forces the round
+        back to lockstep blocking.  At least one fresh output is required:
+        a round of 100% stand-ins would re-reduce pure duplicates while
+        the round counter advances (the pool-of-1 shape where every future
+        is queued behind the straggler must block, not replay)."""
+        if not site_outs:
+            return False
+        if self.last_remote_out.get("phase") != Phase.COMPUTATION.value:
+            return False
+        for out in site_outs.values():
+            if out.get("phase") != Phase.COMPUTATION.value:
+                return False
+            if out.get("mode") != Mode.TRAIN.value:
+                return False
+        return True
+
+    def _async_deliver(self, rnd, s, rec, site_outs):
+        """Deliver site ``s``'s pending invocation (blocking if it has not
+        finished): fresh output, heartbeat, payload faults — the serial
+        template's per-site tail.  A failure flows to the quorum machinery
+        exactly like the serial path."""
+        q, fut, policy = self._async_pending.pop(s)
+        try:
+            out = fut.result()
+        except Exception as exc:  # noqa: BLE001 — see _site_failure
+            self._site_failure(s, exc, attempts=policy.last_attempts)
+            return
+        site_outs[s] = out
+        self._async_last_sub[s] = q
+        rec.event(Live.HEARTBEAT, cat="engine", site=s)
+        if self._async_cfg and self._async_cfg["k"]:
+            rec.metric(Metric.SITE_STALENESS, float(rnd - q), site=s)
+        self.chaos.payload_faults(
+            rnd, s, self.site_states[s]["transferDirectory"], rec
+        )
+        if self._async_cfg and self._async_cfg["k"]:
+            self._async_snapshot_payloads(s, out)
+
+    def _async_snapshot_payloads(self, s, out):
+        """Freeze a fresh contribution's payload files under stable
+        ``<name>.stale`` aliases (same directory, atomic copy).  A later
+        stand-in references the aliases instead of the live names: the
+        straggler's NEXT invocation commits over the live names at an
+        arbitrary moment, and without the alias the aggregator's mid-reduce
+        load of the stale payload would race that commit (manifest/CRC
+        mismatch → retry backoff on the round's critical path).  Alias
+        copies carry the embedded v2 checksum and sit outside the
+        directory manifest — 'no expectation', exactly like a not-yet-
+        relayed file."""
+        xfer = self.site_states[s]["transferDirectory"]
+        snaps = {}
+        for key, val in out.items():
+            if not (isinstance(key, str) and key.endswith("_file")):
+                continue
+            if not isinstance(val, str):
+                continue
+            src = os.path.join(xfer, val)
+            if not os.path.exists(src):
+                continue
+            alias = f"{val}.stale"
+            wire_transport.atomic_copy(src, os.path.join(xfer, alias))
+            snaps[key] = alias
+        self._async_snapshots[s] = snaps
+
+    def _async_standin_out(self, s):
+        """The stand-in output dict for a straggling site: its last
+        contribution with every payload reference rewritten to the frozen
+        ``.stale`` alias (see :meth:`_async_snapshot_payloads`)."""
+        out = dict(self._last_site_outs[s])
+        for key, alias in self._async_snapshots.get(s, {}).items():
+            if key in out:
+                out[key] = alias
+        return out
+
+    def _step_round_async(self, ac):
+        """One engine round of the async mode: submit every idle site to
+        the bounded pool, collect completed invocations, let in-window
+        stragglers be represented by their last contribution, then run the
+        shared remote+relay tail while the stragglers keep computing."""
+        rec = self._recorder()
+        rnd = self.rounds + 1
+        rec.set_context(round=rnd)
+        k = ac["k"]
+        site_outs = {}
+        with self.chaos.activate(rec), rec.span(
+            "engine:round", cat="engine", mode="async"
+        ):
+            pool = self._ensure_async_pool(ac["pool"])
+            # ---- submit: every alive site without a pending invocation
+            # computes this round, against the latest broadcast
+            for s in self._alive_site_ids():
+                if s in self._async_pending:
+                    continue
+                replay = self._stale_replay(rnd, s, rec)
+                if replay is not None:
+                    site_outs[s] = replay
+                    continue
+                policy = self._invoke_policy(s)
+                inp = self._site_input(s)
+                fut = pool.submit(
+                    self._async_attempt, policy, rnd, s, inp, rec
+                )
+                self._async_pending[s] = (rnd, fut, policy)
+
+            # ---- collect: give THIS round's submissions the grace window
+            # first (a healthy site's fresh contribution beats its
+            # stand-in; a straggler's older pending would eat the full
+            # timeout every round), then deliver what completed — the
+            # completed phases/modes decide whether stand-ins are allowed
+            fresh_futs = [
+                pend[1] for s in self._alive_site_ids()
+                for pend in (self._async_pending.get(s),)
+                if pend is not None and pend[0] == rnd
+            ]
+            if fresh_futs and not all(f.done() for f in fresh_futs):
+                grace = self._async_grace()
+                if grace:
+                    from concurrent.futures import wait as _futures_wait
+
+                    _futures_wait(fresh_futs, timeout=grace)
+            waiting = []
+            for s in self._alive_site_ids():
+                if s not in self._async_pending:
+                    continue
+                if self._async_pending[s][1].done():
+                    self._async_deliver(rnd, s, rec, site_outs)
+                else:
+                    waiting.append(s)
+            steady = self._async_steady(site_outs)
+            for s in waiting:
+                q = self._async_pending[s][0]
+                # staleness of the stand-in = rounds since the straggler's
+                # last FRESH contribution was submitted — exactly the lag
+                # its wire_round echo shows the aggregator
+                lag = rnd - self._async_last_sub.get(s, q)
+                if k and steady and self._async_standin_ok(s) and lag <= k:
+                    site_outs[s] = self._async_standin_out(s)
+                    rec.event("async:stale", cat="async", site=s,
+                              lag=lag, k=k)
+                    rec.metric(Metric.SITE_STALENESS, float(lag), site=s)
+                    continue
+                if k and lag > k:
+                    # the straggler fell past the window: the engine must
+                    # block on it — the live plane's staleness_exceeded
+                    # verdict reads this edge
+                    rec.event("async:staleness_exceeded", cat="async",
+                              site=s, lag=lag, k=k)
+                    rec.metric(Metric.SITE_STALENESS, float(lag), site=s)
+                self._async_deliver(rnd, s, rec, site_outs)
+
+            remote_out = self._remote_and_relay(rnd, site_outs, rec)
+        rec.flush()
+        self.site_inputs = {s: dict(remote_out) for s in self._alive_site_ids()}
+        self.rounds += 1
+        return site_outs, remote_out
+
+    def close(self):
+        """Release engine resources: the async invocation pool (pending
+        futures cancelled; running ones finish or fail on their own).  The
+        lockstep path never builds one, so this is a no-op there."""
+        pool, self._async_pool = self._async_pool, None
+        if pool is not None:
+            for _q, fut, _p in self._async_pending.values():
+                fut.cancel()
+            pool.shutdown(wait=False, cancel_futures=True)
+        self._async_pending = {}
 
     def run(self, max_rounds=100000, verbose=False):
         """Drive rounds until the aggregator reports SUCCESS."""
@@ -523,6 +851,10 @@ class SubprocessEngine(InProcessEngine):
     3-tier pipeline exactly once (``ARGS_CACHED`` then rides the cache).
     """
 
+    #: process-backed nodes: the pool threads only do process spawn + pipe
+    #: I/O, so concurrent site invocations are real concurrency — no cap
+    _ASYNC_POOL_CAP = None
+
     def __init__(self, workdir, n_sites, local_script, remote_script,
                  first_input=None, env=None, timeout=600, **kw):
         super().__init__(workdir, n_sites, **kw)
@@ -546,7 +878,7 @@ class SubprocessEngine(InProcessEngine):
         self.first_input = first_input
         self._first_done = set()
 
-    def _invoke(self, script, payload, target=None, rec=None):
+    def _invoke(self, script, payload, target=None, rec=None, rnd=None):
         import json
         import subprocess
         import sys
@@ -607,13 +939,16 @@ class SubprocessEngine(InProcessEngine):
 
     def _site_attempt(self, rnd, s, inp, rec):
         # a hung process produces no output until the timeout kills it —
-        # the chaos hang raises in its place
-        self.chaos.invoke_fault(rnd, s, rec)
-        with rec.span(f"invoke:{s}", cat="invoke"):
+        # the chaos hang raises in its place.  Inside the span: a slow
+        # fault's sleep is simulated compute; round pinned as a span attr
+        # (a pool-thread invocation may outlive its submission round and
+        # ambient context is only read at span end — see InProcessEngine)
+        with rec.span(f"invoke:{s}", cat="invoke", round=rnd):
+            self.chaos.invoke_fault(rnd, s, rec)
             res = self._invoke(self.local_script, {
                 "cache": self.site_caches[s], "input": inp,
                 "state": self.site_states[s],
-            }, target=s, rec=rec)
+            }, target=s, rec=rec, rnd=rnd)
         self.site_caches[s] = res.get("cache", {})
         return res["output"]
 
@@ -626,7 +961,7 @@ class SubprocessEngine(InProcessEngine):
             res = self._invoke(self.remote_script, {
                 "cache": self.remote_cache, "input": site_outs,
                 "state": self.remote_state,
-            }, target="remote", rec=rec)
+            }, target="remote", rec=rec, rnd=rnd)
         self.remote_cache = res.get("cache", {})
         self.success = bool(res.get("success"))
         return res["output"]

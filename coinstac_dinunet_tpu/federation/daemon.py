@@ -54,6 +54,7 @@ import os
 import select
 import subprocess
 import sys
+import threading
 import time
 import traceback
 
@@ -409,6 +410,14 @@ class DaemonEngine(SubprocessEngine):
         self._workers = {}
         self._worker_gen = {}
         self._worker_last_error = {}
+        # async-mode pool threads may still be driving a straggler's worker
+        # when close() runs: the flag stops the supervisor from respawning
+        # a worker for a request that is being torn down
+        self._closing = False
+        # worker bring-up/teardown is engine-side state the async pool
+        # threads share — one lock per engine keeps a concurrent restart
+        # from racing a neighbor's spawn bookkeeping
+        self._worker_lock = threading.RLock()
 
     # ---------------------------------------------------------- supervision
     def _worker_env(self):
@@ -427,42 +436,55 @@ class DaemonEngine(SubprocessEngine):
     def _ensure_worker(self, target, script, rec):
         """The live worker for ``target``, (re)spawning as needed — the
         single place a worker comes up, so ``worker:start`` vs
-        ``worker:restart`` is decided by one generation counter."""
-        w = self._workers.get(target)
-        if w is not None and w.alive():
+        ``worker:restart`` is decided by one generation counter.  Serialized
+        per engine: async-mode pool threads may restart their own targets
+        concurrently, and the spawn bookkeeping must never interleave.  A
+        closing engine refuses to respawn (non-retryable, so a torn-down
+        straggler request fails fast instead of resurrecting its worker)."""
+        with self._worker_lock:
+            if self._closing:
+                raise RuntimeError(
+                    f"engine is closing; refusing to (re)spawn a worker "
+                    f"for {target}"
+                )
+            w = self._workers.get(target)
+            if w is not None and w.alive():
+                return w
+            gen = self._worker_gen.get(target, 0)
+            if w is not None:
+                w.kill()  # reap the corpse; its log stays on disk
+                self._workers.pop(target, None)
+            w = _Worker(
+                target, script, env=self._worker_env(),
+                log_path=os.path.join(self.workdir, "daemon_logs",
+                                      f"{target}.log"),
+                start_timeout=self.start_timeout,
+            )
+            self._workers[target] = w
+            self._worker_gen[target] = gen + 1
+            last_err = self._worker_last_error.pop(target, None)
+            # ``site=`` so the live ops plane attributes the churn per site
+            # (the aggregator's worker rides as site="remote", excluded from
+            # the per-site table exactly like its heartbeat)
+            rec.event(
+                Daemon.EVENT_RESTART if gen else Daemon.EVENT_START,
+                cat="daemon", target=str(target), site=str(target), pid=w.pid,
+                generation=gen + 1, warm_s=round(w.warm_s, 3),
+                **({"error": last_err} if last_err else {}),
+            )
             return w
-        gen = self._worker_gen.get(target, 0)
-        if w is not None:
-            w.kill()  # reap the corpse; its log stays on disk
-            self._workers.pop(target, None)
-        w = _Worker(
-            target, script, env=self._worker_env(),
-            log_path=os.path.join(self.workdir, "daemon_logs",
-                                  f"{target}.log"),
-            start_timeout=self.start_timeout,
-        )
-        self._workers[target] = w
-        self._worker_gen[target] = gen + 1
-        last_err = self._worker_last_error.pop(target, None)
-        # ``site=`` so the live ops plane attributes the churn per site
-        # (the aggregator's worker rides as site="remote", excluded from
-        # the per-site table exactly like its heartbeat)
-        rec.event(
-            Daemon.EVENT_RESTART if gen else Daemon.EVENT_START,
-            cat="daemon", target=str(target), site=str(target), pid=w.pid,
-            generation=gen + 1, warm_s=round(w.warm_s, 3),
-            **({"error": last_err} if last_err else {}),
-        )
-        return w
 
     def _restart_policy(self, target):
         return RetryPolicy.for_worker(self._target_config(target))
 
     # ----------------------------------------------------------- invocation
-    def _invoke(self, script, payload, target=None, rec=None):
+    def _invoke(self, script, payload, target=None, rec=None, rnd=None):
         rec = rec if rec is not None else self._recorder()
         target = str(target)
-        rnd = self.rounds + 1
+        # async-mode pool threads may outlive the round they were submitted
+        # in — the caller pins the round so chaos worker faults stay
+        # deterministic under any completion order
+        rnd = int(rnd) if rnd is not None else self.rounds + 1
         payload = utils.clean_recursive(payload)
 
         def attempt():
@@ -537,13 +559,20 @@ class DaemonEngine(SubprocessEngine):
         return {t: w.pid for t, w in self._workers.items() if w.alive()}
 
     def close(self):
-        """Shut every worker down (orderly frame, then SIGKILL)."""
+        """Shut every worker down (orderly frame, then SIGKILL).  The
+        async invocation pool goes down FIRST (a pending straggler request
+        then fails on its dead worker and the supervisor refuses to
+        respawn under ``_closing``)."""
+        self._closing = True
+        super().close()  # the async pool (engine.py); no-op on lockstep
         rec = self._recorder()
-        for target, w in sorted(self._workers.items()):
+        with self._worker_lock:
+            workers = sorted(self._workers.items())
+            self._workers = {}
+        for target, w in workers:
             w.shutdown()
             rec.event(Daemon.EVENT_SHUTDOWN, cat="daemon",
                       target=str(target), site=str(target), pid=w.pid)
-        self._workers.clear()
         rec.flush()
 
     def __enter__(self):

@@ -87,6 +87,10 @@ _VOLATILE_CACHE_KEYS = frozenset((
     # churn the aggregator trainer's shared-bucket key (one recompile per
     # drop event); the proto-cache-volatile tier-3 rule guards this list.
     "dropped_sites",
+    # per-round async staleness record (nodes/remote.py window check →
+    # parallel/reducer.py discount): rewritten every aggregator round —
+    # host-side protocol bookkeeping, never traced
+    "site_staleness",
     # Key.* bookkeeping the nodes append per round/fold (metrics rollups,
     # serialized score blobs, one-shot flags) — all host-side, never traced
     Key.TEST_METRICS.value, Key.TRAIN_SERIALIZABLE.value,

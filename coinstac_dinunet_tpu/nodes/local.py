@@ -74,6 +74,16 @@ class COINNLocal:
         # once; frozen into shared_args so the aggregator sees it on every
         # transport
         reduce_fanin=None,
+        # opt-in staleness-bounded async rounds (Federation.ASYNC_* keys,
+        # engine.py::_step_round_async): k lets a straggler's last
+        # contribution stand in for up to k rounds; the pool bounds
+        # concurrent site invocations; the discount decays a stale
+        # contribution's reduce weight per round of lag.  Frozen into
+        # shared_args so the aggregator's window check and the reducer's
+        # weighting see the SAME bound the engine enforces
+        async_staleness=None,
+        async_invoke_pool=None,
+        async_stale_discount=None,
         # engine-specific knobs (present so they freeze into shared_args)
         matrix_approximation_rank=1,
         start_powerSGD_iter=10,

@@ -21,7 +21,17 @@ import shutil
 import traceback
 
 from .. import config, telemetry, utils
-from ..config.keys import AggEngine, GatherMode, Key, LocalWire, Mode, Phase, RemoteWire
+from ..config.keys import (
+    AggEngine,
+    Federation,
+    GatherMode,
+    Key,
+    LocalWire,
+    Metric,
+    Mode,
+    Phase,
+    RemoteWire,
+)
 from ..data import EmptyDataHandle
 from ..parallel import COINNReducer, DADReducer, PowerSGDReducer
 from ..resilience import transport as wire_transport
@@ -396,25 +406,57 @@ class COINNRemote:
         # reporting from a previous round; aggregating its payload would
         # silently double-count a stale gradient contribution.  ``None``
         # echoes are tolerated (first round; pre-ROUND peers).
+        #
+        # Staleness-bounded async rounds (``Federation.ASYNC_STALENESS``)
+        # relax the exact-stamp contract to a WINDOW: an echo lagging by
+        # ``1..k`` rounds is a straggler's in-window stand-in (the engine's
+        # ``_step_round_async``), accepted and recorded in
+        # ``cache['site_staleness']`` so the reducer down-weights it
+        # (``parallel/reducer.py::_site_weights``).  Anything older than
+        # the window — or ahead of the stamp — is still refused loudly:
+        # the window bounds the staleness the protocol tolerates, it never
+        # repeals at-most-once delivery (the ``staleness_k`` action of
+        # ``dinulint --model`` checks exactly this boundary).
         expected = self.cache.get("wire_round")
         if expected is not None:
-            behind = {
-                site: site_vars.get(LocalWire.ROUND.value)
-                for site, site_vars in self.input.items()
-                if site_vars.get(LocalWire.ROUND.value) is not None
-                and int(site_vars.get(LocalWire.ROUND.value)) != int(expected)
-            }
+            window = int(self.cache.get(Federation.ASYNC_STALENESS) or 0)
+            stale, behind = {}, {}
+            for site, site_vars in self.input.items():
+                echo = site_vars.get(LocalWire.ROUND.value)
+                if echo is None:
+                    continue
+                lag = int(expected) - int(echo)
+                if lag == 0:
+                    continue
+                if 0 < lag <= window:
+                    stale[site] = lag
+                else:
+                    behind[site] = int(echo)
             if behind:
                 telemetry.get_active().event(
                     "quorum:fail", cat="quorum", reason="stale round echo",
-                    expected=int(expected), behind=behind,
+                    expected=int(expected), behind=behind, window=window,
                 )
                 raise RuntimeError(
                     f"lockstep round violation: expected every site to echo "
-                    f"round {int(expected)} but got {behind} — a stale or "
-                    "duplicated site message; refusing to aggregate its "
-                    "payload into this round's reduce"
+                    f"round {int(expected)}"
+                    + (f" (staleness window {window})" if window else "")
+                    + f" but got {behind} — a stale or duplicated site "
+                    "message beyond the tolerated window; refusing to "
+                    "aggregate its payload into this round's reduce"
                 )
+            # per-round staleness record (volatile): the reducer's
+            # staleness discount and the health broadcast read it; an
+            # empty dict every fresh round clears the previous window
+            self.cache["site_staleness"] = stale
+            if stale:
+                rec = telemetry.get_active()
+                rec.event(
+                    "async:window", cat="async", expected=int(expected),
+                    stale=stale, window=window,
+                )
+                for site, lag in sorted(stale.items()):
+                    rec.metric(Metric.SITE_STALENESS, float(lag), site=site)
 
     # -------------------------------------------------------------- main loop
     def compute(self, mp_pool=None, trainer_cls=None, reducer_cls=None, **kw):

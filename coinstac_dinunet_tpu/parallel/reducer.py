@@ -233,12 +233,29 @@ class COINNReducer:
         round was entirely padding ships zero gradients, and including them
         at weight 1 would dilute the round by the participation fraction —
         the mesh transport has always excluded such sites (``_site_weight``);
-        this keeps the two transports byte-equivalent on unequal site sizes."""
+        this keeps the two transports byte-equivalent on unequal site sizes.
+
+        Under staleness-bounded async rounds a site whose contribution is
+        ``j`` rounds behind the aggregator's ``wire_round``
+        (``cache['site_staleness']``, recorded by the window check in
+        ``nodes/remote.py::_check_lockstep_phases``) is down-weighted by
+        ``gamma**j`` (``Federation.ASYNC_DISCOUNT``, default 0.5) — the
+        staleness discount of computation/communication-decoupled SGD
+        (arXiv:1906.12043), composing multiplicatively with the
+        participation weight here and the survivor/nonfinite/quarantine
+        weighting applied downstream."""
         sites = sorted(self.input.keys())
-        return jnp.asarray(
-            [float(self.input[s].get("grad_weight", 1.0)) for s in sites],
-            jnp.float32,
-        )
+        weights = [float(self.input[s].get("grad_weight", 1.0)) for s in sites]
+        staleness = self.cache.get("site_staleness") or {}
+        if staleness:
+            gamma = float(
+                self.cache.get(Federation.ASYNC_DISCOUNT) or 0.5
+            )
+            weights = [
+                w * (gamma ** int(staleness.get(s, 0) or 0))
+                for w, s in zip(weights, sites)
+            ]
+        return jnp.asarray(weights, jnp.float32)
 
     # ---------------------------------------------------------------- reduce
     def _average(self, site_leaves, weights=None, payload=None):

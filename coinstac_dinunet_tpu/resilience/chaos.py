@@ -229,6 +229,34 @@ def fraction_kill_plan(n_sites, fraction, round=2, seed=0, kind="crash"):
     ]}
 
 
+def slow_site_plan(site="site_0", seconds=0.25, first_round=2,
+                   last_round=64):
+    """Deterministic straggler plan: slow ONE site by ``seconds`` on every
+    engine round in ``[first_round, last_round]`` — the ISSUE-12 "one site
+    slowed Nx" scenario the async round engine exists to hide
+    (``engine.py::_step_round_async``; ``scripts/bench_federation.py
+    --async-staleness``).  Faults stay pinned per round (the schema's
+    determinism contract), so a persistent slowdown is simply one ``slow``
+    entry per round.  The sleep happens on the invoking thread, so under
+    the async engine's bounded pool it delays only the slowed site's own
+    invocation — the span-overlap property ``tests/test_async.py``
+    asserts.
+
+    Returns a plan dict in the :func:`load_fault_plan` schema (pass it as
+    ``fault_plan=`` to any engine)."""
+    first_round, last_round = int(first_round), int(last_round)
+    if not 0 < first_round <= last_round:
+        raise ValueError(
+            f"need 0 < first_round <= last_round, got "
+            f"[{first_round}, {last_round}]"
+        )
+    return {"faults": [
+        {"kind": "slow", "round": r, "site": str(site),
+         "seconds": float(seconds)}
+        for r in range(first_round, last_round + 1)
+    ]}
+
+
 def load_fault_plan(spec):
     """Fault plan (dict or JSON file path) → validated list of faults."""
     if isinstance(spec, (str, os.PathLike)):

@@ -129,6 +129,63 @@ def _node_sort_key(node):
     return (_NODE_ORDER.get(node, 2), str(node))
 
 
+# ------------------------------------------------------------ wire overlap
+def _merge_intervals(intervals):
+    """Sorted union of (start, end) intervals."""
+    merged = []
+    for start, end in sorted(intervals):
+        if merged and start <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], end))
+        else:
+            merged.append((start, end))
+    return merged
+
+
+def wire_overlap_ratio(events):
+    """Fraction of the engine's WIRE time hidden under site COMPUTE — the
+    async round engine's headline overlap metric (ISSUE 12).
+
+    Wire time is the engine lane's aggregator invocation (the reduce runs
+    inside it) plus the broadcast relay (``invoke:remote`` +
+    ``engine:relay`` spans); compute time is the site invocation spans
+    (``invoke:<site>``).  The ratio is ``|wire ∩ union(compute)| / |wire|``
+    over the merged wall-clock timeline: 0 on a strictly serial engine
+    (nothing computes while the wire runs), approaching 1 when stragglers
+    compute straight through every reduce+relay.  Returns ``None`` when
+    the events carry no wire spans (telemetry off / no engine lane)."""
+    wire, compute = [], []
+    for rec in events:
+        if rec.get("kind") != "span" or rec.get("node") != "engine":
+            continue
+        name = str(rec.get("name", ""))
+        try:
+            t0 = float(rec["t0"])
+            t1 = t0 + float(rec.get("dur", 0.0) or 0.0)
+        except (KeyError, TypeError, ValueError):
+            continue
+        if t1 <= t0:
+            continue
+        if name == "invoke:remote" or name == "engine:relay":
+            wire.append((t0, t1))
+        elif name.startswith("invoke:"):
+            compute.append((t0, t1))
+    if not wire:
+        return None
+    wire = _merge_intervals(wire)
+    compute = _merge_intervals(compute)
+    total = sum(e - s for s, e in wire)
+    overlap = 0.0
+    ci = 0
+    for ws, we in wire:
+        while ci < len(compute) and compute[ci][1] <= ws:
+            ci += 1
+        j = ci
+        while j < len(compute) and compute[j][0] < we:
+            overlap += min(we, compute[j][1]) - max(ws, compute[j][0])
+            j += 1
+    return overlap / total if total > 0 else None
+
+
 # ------------------------------------------------------------------ summary
 def new_metric_stats():
     """Empty fold state for one metric series (shared with the doctor so

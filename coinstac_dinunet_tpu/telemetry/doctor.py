@@ -14,7 +14,12 @@ spans + metric series + anomaly events into:
   (a site shipping NaNs outranks a validation stall outranks a slow round),
 - optionally a **benchmark regression check** against
   ``BENCH_HISTORY.jsonl`` (``scripts/bench_history.py``; >10% samples/sec/
-  chip drop vs the previous entry becomes a verdict).
+  chip drop vs the previous entry becomes a verdict).  The check is
+  metric-aware over mixed ledgers: EVERY metric's latest same-metric pair
+  is evaluated and the worst regression surfaces — so the per-engine
+  ``engine_*_rounds_per_sec`` series and the async round engine's
+  ``async_wire_overlap_ratio`` (wire time hidden under compute,
+  ``bench_federation.py --async-staleness``) each regress independently.
 
 Renderers: markdown (the human postmortem, uploaded as a CI artifact), JSON
 (machines), and ``--format github`` workflow annotations for CI.
@@ -52,7 +57,8 @@ def build_report(events, bench_history=None, regression_threshold=0.10):
     # resilience-layer evidence: retry pressure, recovered corruption,
     # retry-attributed site deaths, injected chaos faults
     resilience = {"wire_retries": 0, "corruption_recovered": 0,
-                  "invoke_retries": 0}
+                  "invoke_retries": 0, "stale_standins": 0,
+                  "staleness_blocks": 0}
     dead_sites = {}
     chaos = []
     # perf flight recorder evidence: the backend's roofline constants
@@ -110,6 +116,10 @@ def build_report(events, bench_history=None, regression_threshold=0.10):
                 resilience["corruption_recovered"] += 1
             elif name == "invoke:retry":
                 resilience["invoke_retries"] += 1
+            elif name == "async:stale":
+                resilience["stale_standins"] += 1
+            elif name == "async:staleness_exceeded":
+                resilience["staleness_blocks"] += 1
             elif name == "site_died" and rec.get("site") is not None:
                 dead_sites[str(rec["site"])] = {
                     "round": rec.get("round"),
@@ -598,6 +608,11 @@ def render_markdown(report):
             f"{res.get('corruption_recovered', 0)} corrupt/truncated "
             f"payload(s) recovered, {res.get('invoke_retries', 0)} "
             "invocation retry(ies)."
+            + (f" Async rounds: {res['stale_standins']} straggler "
+               f"stand-in(s) delivered, {res.get('staleness_blocks', 0)} "
+               "forced block(s) past the staleness window."
+               if res.get("stale_standins") or res.get("staleness_blocks")
+               else "")
         )
         lines.append("")
         if dead:

@@ -63,6 +63,7 @@ VERDICT_SEVERITY = {
     Live.VERDICT_ROUND_OUTLIER: "warning",
     Live.VERDICT_MFU_COLLAPSE: "warning",
     Live.VERDICT_RETRY_STORM: "warning",
+    Live.VERDICT_STALENESS: "warning",
 }
 
 
@@ -169,7 +170,8 @@ class Tailer:
 def _site_entry():
     return {"round": 0, "phase": None, "epoch": None, "last_seen": None,
             "last_heartbeat": None, "anomalies": 0, "dead": False,
-            "quarantined": False, "worker_restarts": 0}
+            "died_retries_exhausted": False, "quarantined": False,
+            "worker_restarts": 0, "staleness": None}
 
 
 class LiveState:
@@ -208,6 +210,11 @@ class LiveState:
         self.anomalies_by_kind = {}
         self.chaos = 0
         self.worker_restarts = 0
+        # async round engine: the staleness bound k (learned from the
+        # engine's async:* events) and per-site staleness gauges — the
+        # staleness_exceeded verdict judges sites against k
+        self.staleness_k = 0
+        self.stale_standins = 0
         # event-name counts (bounded by the event vocabulary): the watch
         # CLI's --assert-event gating reads this, it stays out of the
         # snapshot to keep /healthz stable
@@ -322,6 +329,32 @@ class LiveState:
                 self.site(site)["anomalies"] += 1
         elif name == "chaos:inject":
             self.chaos += 1
+        elif name in ("async:stale", "async:staleness_exceeded"):
+            # the async engine delivered a stand-in for (or was forced to
+            # block on) a straggler: per-site staleness gauge + the bound
+            # k the staleness_exceeded verdict judges against
+            try:
+                self.staleness_k = max(self.staleness_k,
+                                       int(rec.get("k", 0) or 0))
+            except (TypeError, ValueError):
+                pass
+            if name == "async:stale":
+                self.stale_standins += 1
+            if site is not None:
+                try:
+                    lag = int(rec.get("lag", 0))
+                except (TypeError, ValueError):
+                    lag = 0
+                s = self.site(site)
+                s["staleness"] = lag
+                if name == "async:staleness_exceeded":
+                    # latch the breach: the engine blocks right after this
+                    # event and the post-block fresh delivery resets the
+                    # gauge, usually inside the SAME flush batch — without
+                    # the latch check() would never see the bad state
+                    s["staleness_breach"] = max(
+                        lag, s.get("staleness_breach") or 0
+                    )
         elif name == Daemon.EVENT_RESTART:
             # the daemon engine replaced a dead/wedged worker — the site
             # SURVIVED (supervision, not quorum), but the board/metrics
@@ -336,7 +369,9 @@ class LiveState:
             self.corruption_recovered += 1
         elif name == "site_died" and site is not None:
             self.dead.add(str(site))
-            self.site(site)["dead"] = True
+            s = self.site(site)
+            s["dead"] = True
+            s["died_retries_exhausted"] = bool(rec.get("retries_exhausted"))
         elif name == "quarantine" and site is not None:
             self.quarantined.add(str(site))
             self.site(site)["quarantined"] = True
@@ -373,6 +408,10 @@ class LiveState:
                 self.mfu_ema = _EMA_DECAY * self.mfu_ema + (1 - _EMA_DECAY) * v
         elif name == Metric.SAMPLES_PER_SEC:
             self.samples_per_sec = v
+        elif name == Metric.SITE_STALENESS and rec.get("site") is not None:
+            # both the engine (per delivery/stand-in) and the aggregator's
+            # window check record the series — latest sample wins
+            self.site(rec["site"])["staleness"] = int(v)
         elif name == Metric.ROUNDS_PER_SEC:
             # the vectorized engine records the series directly; trust it
             self.rounds_per_sec = (
@@ -451,6 +490,47 @@ class LiveState:
                     fired.append(v)
             elif age <= self.silence_after or not lagging:
                 self._rearm(key)
+
+        # async staleness: a site MORE than k rounds behind means the
+        # engine had to block on it (or it died and its gauge froze past
+        # the window) — the straggler is gating the federation again.
+        # Edge-triggered per site; re-arms when the site is back inside
+        # the window.  Dead-site attribution reuses the site_died
+        # retry-exhaustion evidence (the doctor's vocabulary).
+        if self.staleness_k:
+            for name in sorted(self.sites):
+                s = self.sites[name]
+                key = f"staleness:{name}"
+                # the latched breach (consumed here) outranks the live
+                # gauge: breach + recovery can land in ONE ingest batch
+                breach = s.pop("staleness_breach", None)
+                st = s.get("staleness")
+                if breach is not None and (st is None or breach > st):
+                    st = breach
+                if st is not None and st > self.staleness_k:
+                    if s["dead"]:
+                        how = (
+                            "site declared dead ("
+                            + ("retries exhausted"
+                               if s.get("died_retries_exhausted")
+                               else "hard failure")
+                            + ") — its last contribution ages past the "
+                            "window"
+                        )
+                    else:
+                        how = ("the engine must block on it — the "
+                               "straggler gates the federation again")
+                    v = self._fire(
+                        key, Live.VERDICT_STALENESS,
+                        f"site {name} fell more than k rounds behind",
+                        f"staleness {st} > bound k={self.staleness_k} at "
+                        f"round {self.round}; {how}",
+                        now, site=name,
+                    )
+                    if v:
+                        fired.append(v)
+                elif st is not None and st <= self.staleness_k:
+                    self._rearm(key)
 
         if len(self.round_durs) >= _ROUND_MIN_SAMPLES:
             *window, last = self.round_durs
@@ -542,6 +622,7 @@ class LiveState:
                 "heartbeat_age_s": (round(now - last, 3) if last else None),
                 "anomalies": s["anomalies"],
                 "worker_restarts": s["worker_restarts"],
+                "staleness": s["staleness"],
                 "status": ("dead" if s["dead"] else
                            "quarantined" if s["quarantined"] else
                            "silent" if f"silence:{name}" in self._armed else
@@ -564,6 +645,8 @@ class LiveState:
                           "by_kind": dict(self.anomalies_by_kind)},
             "chaos_injections": self.chaos,
             "worker_restarts": self.worker_restarts,
+            "staleness_k": self.staleness_k,
+            "stale_standins": self.stale_standins,
             "wire_retries": self.wire_retries,
             "corruption_recovered": self.corruption_recovered,
             "dead_sites": sorted(self.dead),
@@ -612,25 +695,36 @@ def render_board(snap, root=""):
         f"anomalies {snap['anomalies']['total']} · "
         f"chaos {snap['chaos_injections']} · "
         f"worker restarts {snap.get('worker_restarts', 0)} · "
-        f"truncated lines {snap['truncated_lines']} · "
+        + (f"stale stand-ins {snap.get('stale_standins', 0)} "
+           f"(k={snap['staleness_k']}) · "
+           if snap.get("staleness_k") else "")
+        + f"truncated lines {snap['truncated_lines']} · "
         f"dead: {', '.join(snap['dead_sites']) or '-'} · "
         f"quarantined: {', '.join(snap['quarantined_sites']) or '-'}"
     )
     if snap["sites"]:
         width = max(len(n) for n in snap["sites"])
+        # the staleness column appears only on async runs (k learned from
+        # the engine's async:* events) — lockstep boards stay unchanged
+        k = int(snap.get("staleness_k") or 0)
+        stale_hdr = f" {'stale':>5}" if k else ""
         lines.append("")
         lines.append(
             f"  {'site'.ljust(width)}  {'round':>5} {'epoch':>5} "
-            f"{'phase':<16} {'heartbeat':>10} {'anoms':>5}  status"
+            f"{'phase':<16} {'heartbeat':>10}{stale_hdr} {'anoms':>5}  status"
         )
         for name, s in snap["sites"].items():
             age = ("-" if s["heartbeat_age_s"] is None
                    else f"{s['heartbeat_age_s']:.1f}s ago")
             status = s["status"].upper() if s["status"] != "alive" else "alive"
+            stale_col = ""
+            if k:
+                st = s.get("staleness")
+                stale_col = f" {'-' if st is None else st:>5}"
             lines.append(
                 f"  {name.ljust(width)}  {s['round']:>5} "
                 f"{'-' if s['epoch'] is None else s['epoch']:>5} "
-                f"{(s['phase'] or '-'):<16} {age:>10} "
+                f"{(s['phase'] or '-'):<16} {age:>10}{stale_col} "
                 f"{s['anomalies']:>5}  {status}"
             )
     if snap["verdicts"]:

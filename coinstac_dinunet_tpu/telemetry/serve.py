@@ -140,11 +140,22 @@ def render_prometheus(snap):
         w.counter("site_worker_restarts_total", s.get("worker_restarts"),
                   "daemon worker restarts attributed to the site",
                   labels={"site": name})
+    for name, s in sites.items():
+        # async rounds only: absent (None) outside async mode so lockstep
+        # scrapes carry no empty series
+        w.gauge("site_staleness", s.get("staleness"),
+                "rounds the site's last contribution lags the aggregator "
+                "(async staleness window)", labels={"site": name})
+    w.gauge("staleness_k", snap.get("staleness_k") or None,
+            "configured async staleness bound k (absent on lockstep runs)")
+    w.counter("stale_standins_total", snap.get("stale_standins"),
+              "straggler stand-ins delivered by the async round engine")
     by_kind = {}
     for v in snap.get("verdicts") or ():
         by_kind[v["verdict"]] = by_kind.get(v["verdict"], 0) + 1
     for kind in (Live.VERDICT_SILENCE, Live.VERDICT_ROUND_OUTLIER,
-                 Live.VERDICT_MFU_COLLAPSE, Live.VERDICT_RETRY_STORM):
+                 Live.VERDICT_MFU_COLLAPSE, Live.VERDICT_RETRY_STORM,
+                 Live.VERDICT_STALENESS):
         w.counter("verdicts_total", by_kind.get(kind, 0),
                   "in-flight stall verdicts fired, by kind",
                   labels={"kind": kind})

@@ -36,12 +36,26 @@ engine independently in the SAME ledger file).  ``--engine-assert`` gates
 the ISSUE-11 acceptance ratios (daemon within 2x of in-process, >= 10x
 the subprocess engine).
 
+``--async-staleness k`` (ISSUE 12) A/Bs **lockstep vs staleness-bounded
+async rounds** on one engine kind (default daemon) under a chaos slow-site
+plan (one site slowed ``--slow-factor``x the fair-share round, every
+round): the async arm invokes sites through a bounded pool and lets the
+straggler's last contribution stand in for up to k rounds (down-weighted
+by the reducer), so the fast sites keep their cadence.  Ledger lines:
+per-arm rounds/sec plus ``async_wire_overlap_ratio`` — the fraction of
+reduce+relay wall time hidden under site compute on the merged Perfetto
+timeline (0 on a serial engine).  ``--engine-assert`` gates the
+straggler-hiding speedup (>= 2x by default).
+
 Usage::
 
     JAX_PLATFORMS=cpu python scripts/bench_federation.py --sites 1000
     python scripts/bench_federation.py --sites 64 --smoke --workdir /tmp/fb
     python scripts/bench_federation.py --engine inprocess,subprocess,daemon \\
         --smoke | python scripts/bench_history.py append --all \\
+        --history BENCH_FEDERATION_HISTORY.jsonl
+    python scripts/bench_federation.py --engine daemon --async-staleness 2 \\
+        --engine-assert | python scripts/bench_history.py append --all \\
         --history BENCH_FEDERATION_HISTORY.jsonl
 """
 import argparse
@@ -164,7 +178,8 @@ def _bench_serial(n_sites, rounds, workdir, per_site=64, telemetry=False):
 
 
 # -------------------------------------------------------------- engine A/B
-def _build_engine(kind, n_sites, workdir, per_site):
+def _build_engine(kind, n_sites, workdir, per_site, node_extra=None,
+                  fault_plan=None):
     """One serial engine on the SAME synthetic task and node protocol —
     the process model is the only variable:
 
@@ -174,15 +189,20 @@ def _build_engine(kind, n_sites, workdir, per_site):
     - ``daemon``: one long-lived warm worker per node over the framed
       pipe (``federation/daemon.py``) — fresh-process isolation without
       the per-invocation cold start.
+
+    ``node_extra`` merges into the node args on every transport (the
+    async A/B rides ``async_staleness``/``profile`` through it);
+    ``fault_plan`` is a resilience/chaos.py plan dict.
     """
-    node_args = dict(_CACHE, persist_round_state=True)
+    node_args = dict(_CACHE, persist_round_state=True, **(node_extra or {}))
     node_args.pop("task_id", None)
     if kind == "inprocess":
         from coinstac_dinunet_tpu.engine import InProcessEngine
 
         eng = InProcessEngine(
             workdir, n_sites=n_sites, trainer_cls=_make_trainer_cls(),
-            dataset_cls=_make_dataset_cls(), **dict(_CACHE),
+            dataset_cls=_make_dataset_cls(), fault_plan=fault_plan,
+            **dict(_CACHE, **(node_extra or {})),
         )
     else:
         env = dict(os.environ)
@@ -196,6 +216,7 @@ def _build_engine(kind, n_sites, workdir, per_site):
             local_script=os.path.join(_SCRIPTS_DIR, "_fedbench_local.py"),
             remote_script=os.path.join(_SCRIPTS_DIR, "_fedbench_remote.py"),
             first_input={"fedbench_args": node_args}, env=env,
+            fault_plan=fault_plan,
         )
         if kind == "daemon":
             from coinstac_dinunet_tpu.federation.daemon import DaemonEngine
@@ -317,6 +338,176 @@ def _engine_main(args, workdir, probe):
     return 0
 
 
+# ---------------------------------------------------------- async rounds A/B
+def _bench_async_arm(kind, n_sites, workdir, warmup, rounds, plan=None,
+                     node_extra=None):
+    """Steady rounds/sec of one arm (lockstep or async) under the shared
+    slow-site plan, telemetry on (the merged engine lane feeds the
+    wire_overlap_ratio metric)."""
+    eng = _build_engine(
+        kind, n_sites, workdir, per_site=64,
+        node_extra=dict(node_extra or {}, profile=True),
+        fault_plan=plan,
+    )
+    try:
+        for _ in range(warmup):
+            eng.step_round()
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            eng.step_round()
+        dt = time.perf_counter() - t0
+    finally:
+        if hasattr(eng, "close"):
+            eng.close()
+    from coinstac_dinunet_tpu.telemetry.collect import (
+        load_events,
+        wire_overlap_ratio,
+    )
+
+    steady = [
+        e for e in load_events(workdir)
+        if int(e.get("round", 0) or 0) > warmup
+    ]
+    overlap = wire_overlap_ratio(steady)
+    site_invokes = [
+        float(e.get("dur") or 0.0) for e in steady
+        if e.get("kind") == "span" and e.get("node") == "engine"
+        and str(e.get("name", "")).startswith("invoke:")
+        and e.get("name") != "invoke:remote"
+    ]
+    return {
+        "rounds_per_sec": round(rounds / dt, 3),
+        "round_ms": round(1e3 * dt / rounds, 3),
+        "rounds_timed": rounds,
+        "wire_overlap_ratio": (None if overlap is None
+                               else round(overlap, 4)),
+        "site_invoke_ms": (
+            round(1e3 * sum(site_invokes) / len(site_invokes), 3)
+            if site_invokes else None
+        ),
+    }
+
+
+def _async_main(args, workdir, probe):
+    """``--async-staleness k``: the straggler-hiding A/B (ISSUE 12).
+
+    One engine kind (default daemon), 3 phases under telemetry:
+
+    1. a fault-free probe measures the no-straggler steady round time R;
+    2. the LOCKSTEP arm re-runs under a chaos plan slowing one site by
+       ``(slow_factor - 1) x R`` every round — the straggler's invocation
+       takes ~``slow_factor`` fair-share rounds, so lockstep collapses to
+       its rate;
+    3. the ASYNC arm runs the SAME plan with the staleness window k (and
+       the bounded invocation pool): in-window stand-ins + the collect
+       grace keep the fast sites at full cadence.
+
+    Ledger lines (``bench_history.py append --all``): per-arm rounds/sec
+    plus the ``async_wire_overlap_ratio`` metric — the fraction of
+    reduce+relay wall time hidden under site compute on the merged
+    timeline (0 on a serial engine).  ``--engine-assert`` gates the
+    straggler-hiding speedup (default >= 2x, ``--async-assert-speedup``).
+    """
+    kinds = [k.strip() for k in str(args.engine or "daemon").split(",")
+             if k.strip()]
+    if len(kinds) != 1 or kinds[0] not in ENGINE_KINDS:
+        print("--async-staleness needs exactly ONE --engine kind "
+              f"(known: {', '.join(ENGINE_KINDS)}); got {kinds}",
+              file=sys.stderr)
+        return 2
+    kind = kinds[0]
+    k = int(args.async_staleness)
+    if k < 1:
+        print(f"--async-staleness {k}: the A/B needs a window >= 1 "
+              "(0 is lockstep — nothing to compare)", file=sys.stderr)
+        return 2
+    n_sites = int(args.engine_sites)
+    warmup = 6
+    rounds = args.engine_rounds or (12 if args.smoke else 20)
+
+    from coinstac_dinunet_tpu.resilience.chaos import slow_site_plan
+
+    probe_arm = _bench_async_arm(
+        kind, n_sites, os.path.join(workdir, "async_probe"),
+        warmup, rounds,
+    )
+    # "one site slowed Nx" = that site's invocation takes N times its
+    # peers' (the slowdown is the chaos sleep on top of its own compute)
+    base_invoke_s = (
+        probe_arm["site_invoke_ms"] or probe_arm["round_ms"] / n_sites
+    ) / 1e3
+    slow_seconds = round(
+        (float(args.slow_factor) - 1.0) * base_invoke_s, 4
+    )
+    print(f"# probe ({kind}, no straggler): "
+          f"{probe_arm['rounds_per_sec']:g} rounds/s, site invoke "
+          f"{probe_arm['site_invoke_ms']}ms -> slowing site_0 by "
+          f"{slow_seconds}s/round (x{args.slow_factor:g} its peers)",
+          file=sys.stderr)
+    plan = slow_site_plan(
+        site="site_0", seconds=slow_seconds, first_round=2,
+        last_round=warmup + rounds + 4,
+    )
+    lock = _bench_async_arm(
+        kind, n_sites, os.path.join(workdir, "async_lockstep"),
+        warmup, rounds, plan=dict(plan),
+    )
+    print(f"# lockstep + straggler: {lock['rounds_per_sec']:g} rounds/s "
+          f"(wire overlap {lock['wire_overlap_ratio']})", file=sys.stderr)
+    node_extra = {"async_staleness": k}
+    if args.async_pool is not None:
+        node_extra["async_invoke_pool"] = int(args.async_pool)
+    asy = _bench_async_arm(
+        kind, n_sites, os.path.join(workdir, "async_window"),
+        warmup, rounds, plan=dict(plan), node_extra=node_extra,
+    )
+    speedup = (
+        round(asy["rounds_per_sec"] / lock["rounds_per_sec"], 3)
+        if lock["rounds_per_sec"] else None
+    )
+    print(f"# async k={k} + straggler: {asy['rounds_per_sec']:g} rounds/s "
+          f"(wire overlap {asy['wire_overlap_ratio']}) — "
+          f"{speedup}x lockstep", file=sys.stderr)
+
+    common = {
+        "sites": n_sites, "slow_site": "site_0",
+        "slow_seconds": slow_seconds,
+        "slow_factor": float(args.slow_factor),
+        "workdir": workdir, "backend_probe": probe,
+    }
+    print(json.dumps({
+        "metric": f"engine_{kind}_lockstep_slow_rounds_per_sec",
+        "value": lock["rounds_per_sec"], "unit": "rounds/sec",
+        "rounds_timed": lock["rounds_timed"], "round_ms": lock["round_ms"],
+        "wire_overlap_ratio": lock["wire_overlap_ratio"], **common,
+    }))
+    print(json.dumps({
+        "metric": f"engine_{kind}_async_rounds_per_sec",
+        "value": asy["rounds_per_sec"], "unit": "rounds/sec",
+        "rounds_timed": asy["rounds_timed"], "round_ms": asy["round_ms"],
+        "async_staleness": k, "async_vs_lockstep": speedup,
+        "no_straggler_rounds_per_sec": probe_arm["rounds_per_sec"],
+        **common,
+    }))
+    print(json.dumps({
+        "metric": "async_wire_overlap_ratio",
+        "value": asy["wire_overlap_ratio"], "unit": "ratio",
+        "lockstep_wire_overlap_ratio": lock["wire_overlap_ratio"],
+        "async_staleness": k, **common,
+    }))
+    if args.engine_assert:
+        need = float(args.async_assert_speedup)
+        if not speedup or speedup < need:
+            print(f"ASYNC ASSERT FAILED: async k={k} is {speedup}x the "
+                  f"lockstep rate under the same straggler plan "
+                  f"(need >= {need}x)", file=sys.stderr)
+            return 4
+        print(f"async assert OK: {speedup}x lockstep under a "
+              f"{args.slow_factor:g}x straggler (need >= {need}x)",
+              file=sys.stderr)
+    return 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--sites", type=int, default=1000,
@@ -357,7 +548,29 @@ def main(argv=None):
                    help="exit 4 unless the daemon's steady-state is "
                         "within 2x of the in-process engine AND >= 10x "
                         "the subprocess engine (the ISSUE-11 acceptance "
-                        "gate; requires all three kinds in --engine)")
+                        "gate; requires all three kinds in --engine).  "
+                        "With --async-staleness it instead gates the "
+                        "straggler-hiding speedup "
+                        "(--async-assert-speedup)")
+    p.add_argument("--async-staleness", type=int, default=None, metavar="K",
+                   help="A/B lockstep vs staleness-bounded async rounds "
+                        "(ISSUE 12) on ONE engine kind (--engine, default "
+                        "daemon) under a chaos slow-site plan: one site "
+                        "slowed --slow-factor x the fair-share round every "
+                        "round; ledgers per-arm rounds/sec plus the "
+                        "async_wire_overlap_ratio metric (wire time hidden "
+                        "under compute on the merged timeline)")
+    p.add_argument("--async-pool", type=int, default=None,
+                   help="bounded invocation-pool size for the async arm "
+                        "(default: n_sites)")
+    p.add_argument("--slow-factor", type=float, default=5.0,
+                   help="straggler slowdown for the async A/B: the slowed "
+                        "site's invocation takes about this many "
+                        "fair-share rounds (default 5)")
+    p.add_argument("--async-assert-speedup", type=float, default=2.0,
+                   help="minimum async-vs-lockstep speedup --engine-assert "
+                        "demands in the async A/B (default 2.0 — the "
+                        "ISSUE-12 acceptance ratio)")
     args = p.parse_args(argv)
     rounds = args.rounds or (3 if args.smoke else 10)
     serial_cap = args.serial_cap or (16 if args.smoke else 100)
@@ -392,6 +605,8 @@ def main(argv=None):
         workdir = tempfile.mkdtemp(prefix="fedbench_")
     os.makedirs(workdir, exist_ok=True)
 
+    if args.async_staleness is not None:
+        return _async_main(args, workdir, probe)
     if args.engine:
         return _engine_main(args, workdir, probe)
 

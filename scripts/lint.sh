@@ -51,6 +51,9 @@ if [ "${DINULINT_MODEL:-}" = "1" ]; then
     if [ -n "${DINULINT_MODEL_FAULTS:-}" ]; then
         extra+=(--model-faults "$DINULINT_MODEL_FAULTS")
     fi
+    if [ -n "${DINULINT_MODEL_STALENESS:-}" ]; then
+        extra+=(--model-staleness "$DINULINT_MODEL_STALENESS")
+    fi
     if [ -n "${DINULINT_MODEL_PLANS:-}" ]; then
         extra+=(--model-plans "$DINULINT_MODEL_PLANS")
     fi

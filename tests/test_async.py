@@ -1,0 +1,403 @@
+"""Staleness-bounded async rounds (ISSUE 12).
+
+The async round engine (``engine.py::_step_round_async``) invokes sites
+through a bounded pool and lets a straggler's last contribution stand in
+for up to ``k = Federation.ASYNC_STALENESS`` rounds, with the aggregator's
+lockstep stamp relaxed to a window and the reducer down-weighting stale
+contributions.  These tests pin the ISSUE-12 contract:
+
+- **parity**: async mode with ``k=0`` and pool size 1 is bit-identical to
+  the serial ``step_round`` path on the 3-site example federation;
+- **overlap**: a chaos-``slow`` straggler's invoke span does NOT delay the
+  other sites' next round (span overlap on the merged timeline, plus the
+  ``wire_overlap_ratio`` metric going positive);
+- **window**: the aggregator accepts an echo lagging by at most k (and
+  records ``cache['site_staleness']``), refuses anything older, and the
+  reducer's staleness discount composes with the participation weights;
+- **tier-4**: the ``staleness_k`` action + window-relaxed stamp pass clean
+  at the default bound, and a seeded beyond-window acceptance produces
+  exactly one ``proto-model-stale-contribution`` with a loadable plan;
+- **live plane**: per-site staleness gauges and the edge-triggered
+  ``staleness_exceeded`` verdict, exported on ``/metrics``;
+- **doctor**: the bench verdict pairs ``async_wire_overlap_ratio`` ledger
+  lines like any other metric.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu.config.keys import Live, Metric, ModelCheck
+from coinstac_dinunet_tpu.engine import InProcessEngine
+from coinstac_dinunet_tpu.nodes import COINNRemote
+from coinstac_dinunet_tpu.resilience.chaos import (
+    load_fault_plan,
+    slow_site_plan,
+)
+from coinstac_dinunet_tpu.telemetry.collect import (
+    load_events,
+    wire_overlap_ratio,
+)
+from coinstac_dinunet_tpu.telemetry.live import LiveState
+from coinstac_dinunet_tpu.telemetry.serve import render_prometheus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+EXAMPLE = os.path.join(REPO, "examples", "fsv_classification")
+
+ARGS = dict(
+    data_dir="data", split_ratio=[0.6, 0.2, 0.2], batch_size=4, epochs=2,
+    validation_epochs=1, learning_rate=5e-2, input_size=12, hidden_sizes=[8],
+    num_classes=2, seed=7, synthetic=True, verbose=False, patience=50,
+)
+N_SITES = 3
+
+
+def _fill_sites(eng, per_site=10):
+    for s in eng.site_ids:
+        d = eng.site_data_dir(s)
+        for i in range(per_site):
+            with open(os.path.join(d, f"{s}_subj{i}.txt"), "w") as f:
+                f.write("x")
+
+
+def _fsv_engine(workdir, **extra):
+    from coinstac_dinunet_tpu.models import FSVDataset, FSVTrainer
+
+    eng = InProcessEngine(
+        workdir, n_sites=N_SITES, trainer_cls=FSVTrainer,
+        dataset_cls=FSVDataset, task_id="fsv_classification",
+        **{**ARGS, **extra},
+    )
+    _fill_sites(eng)
+    return eng
+
+
+# ------------------------------------------------------------------- parity
+def test_async_k0_pool1_is_bit_identical_to_serial(tmp_path):
+    """ISSUE-12 golden parity: the async code path at k=0 with pool size 1
+    runs the exact serial schedule — scores on the 3-site example
+    federation must match the serial ``step_round`` path bit for bit."""
+    serial = _fsv_engine(tmp_path / "serial")
+    serial.run(max_rounds=200)
+    assert serial.success
+
+    eng = _fsv_engine(tmp_path / "async",
+                      async_staleness=0, async_invoke_pool=1)
+    assert eng._async_config() == {"enabled": True, "k": 0, "pool": 1}
+    try:
+        eng.run(max_rounds=200)
+        assert eng.success
+    finally:
+        eng.close()
+
+    for key in ("train_log", "validation_log", "test_metrics"):
+        got = np.asarray(eng.remote_cache[key], np.float64)
+        golden = np.asarray(serial.remote_cache[key], np.float64)
+        assert got.shape == golden.shape, key
+        assert (got == golden).all(), (key, got, golden)
+
+
+# ---------------------------------------------------- straggler span overlap
+@pytest.mark.slow
+def test_slow_site_overlaps_wire_and_next_round(tmp_path):
+    """Chaos ``slow`` composes with concurrent invocation: the slowed
+    site's invoke span must NOT delay the other sites' next-round start —
+    on the merged timeline, other sites' invoke spans (and the
+    reduce/relay wire spans) begin INSIDE the straggler's span, and the
+    ``wire_overlap_ratio`` metric goes positive (0 on a serial engine)."""
+    from coinstac_dinunet_tpu.federation.daemon import DaemonEngine
+
+    sys.path.insert(0, SCRIPTS)
+    try:
+        from _fedbench_task import CACHE, fill_site_data
+    finally:
+        sys.path.remove(SCRIPTS)
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (
+        REPO + os.pathsep + SCRIPTS + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    node_args = dict(CACHE, persist_round_state=True, profile=True,
+                     async_staleness=2)
+    node_args.pop("task_id", None)
+    slow_s = 0.4
+    plan = slow_site_plan(site="site_0", seconds=slow_s,
+                          first_round=2, last_round=40)
+    eng = DaemonEngine(
+        tmp_path / "wd", n_sites=N_SITES,
+        local_script=os.path.join(SCRIPTS, "_fedbench_local.py"),
+        remote_script=os.path.join(SCRIPTS, "_fedbench_remote.py"),
+        first_input={"fedbench_args": node_args}, env=env,
+        fault_plan=plan,
+    )
+    fill_site_data(eng, per_site=16)
+    try:
+        for _ in range(12):
+            eng.step_round()
+    finally:
+        eng.close()
+
+    events = load_events(str(tmp_path / "wd"))
+    stale = [e for e in events if e.get("name") == "async:stale"]
+    assert stale, "no stand-in was ever delivered for the straggler"
+    # the slowed site must be among the stand-ins; under CPU contention a
+    # healthy site may legitimately miss the grace window too, so do NOT
+    # assert the straggler is the ONLY one
+    assert "site_0" in {e["site"] for e in stale}
+    assert all(e["k"] == 2 for e in stale)
+    # the straggler's slowed invoke spans (>= the injected sleep)
+    slow_spans = [
+        e for e in events
+        if e.get("kind") == "span" and e.get("node") == "engine"
+        and e.get("name") == "invoke:site_0"
+        and float(e.get("dur", 0)) >= slow_s
+    ]
+    assert slow_spans, "the chaos slow sleep is not on the timeline"
+    others = [
+        e for e in events
+        if e.get("kind") == "span" and e.get("node") == "engine"
+        and e.get("name") in ("invoke:site_1", "invoke:site_2",
+                              "invoke:remote")
+    ]
+    overlapped = False
+    for span in slow_spans:
+        t0, t1 = float(span["t0"]), float(span["t0"]) + float(span["dur"])
+        inside = [o for o in others if t0 < float(o["t0"]) < t1]
+        # other sites started a NEW invocation (the next round) and the
+        # aggregator reduced while the straggler was still computing
+        if any(o["name"] != "invoke:remote" for o in inside) and any(
+            o["name"] == "invoke:remote" for o in inside
+        ):
+            overlapped = True
+    assert overlapped, "the slowed invoke span delayed everyone else"
+    ratio = wire_overlap_ratio(events)
+    assert ratio is not None and ratio > 0.0
+    # staleness telemetry fed the live plane vocabulary
+    assert any(
+        e.get("kind") == "metric" and e.get("name") == Metric.SITE_STALENESS
+        for e in events
+    )
+
+
+# ----------------------------------------------------------- window semantics
+def _remote_with_echoes(k, echoes, wire_round=5):
+    cache = {"all_sites": sorted(echoes), "wire_round": wire_round}
+    if k:
+        cache["async_staleness"] = k
+    inp = {
+        site: {"phase": "computation", "wire_round": echo}
+        for site, echo in echoes.items()
+    }
+    return COINNRemote(cache=cache, input=inp, state={})
+
+
+def test_window_accepts_in_window_and_records_staleness():
+    node = _remote_with_echoes(2, {"site_0": 5, "site_1": 4, "site_2": 3})
+    node._check_lockstep_phases()
+    assert node.cache["site_staleness"] == {"site_1": 1, "site_2": 2}
+
+
+def test_window_refuses_beyond_k_and_lockstep_refuses_any_lag():
+    node = _remote_with_echoes(2, {"site_0": 5, "site_1": 2})
+    with pytest.raises(RuntimeError, match="lockstep round violation"):
+        node._check_lockstep_phases()
+    # k unset = today's exact-stamp lockstep: any lag refused
+    node = _remote_with_echoes(0, {"site_0": 5, "site_1": 4})
+    with pytest.raises(RuntimeError, match="lockstep round violation"):
+        node._check_lockstep_phases()
+    # an echo AHEAD of the stamp is never a straggler — refused
+    node = _remote_with_echoes(2, {"site_0": 6})
+    with pytest.raises(RuntimeError, match="lockstep round violation"):
+        node._check_lockstep_phases()
+
+
+def test_reducer_staleness_discount_composes_with_grad_weight():
+    from coinstac_dinunet_tpu.parallel.reducer import COINNReducer
+
+    class _Shell:
+        cache = {
+            "site_staleness": {"site_1": 1, "site_2": 2},
+            "async_stale_discount": 0.5,
+        }
+        input = {
+            "site_0": {"grad_weight": 1.0},
+            "site_1": {"grad_weight": 1.0},
+            "site_2": {"grad_weight": 0.5},
+        }
+        state = {}
+
+    red = COINNReducer.__new__(COINNReducer)
+    red.cache = _Shell.cache
+    red.input = _Shell.input
+    red.state = _Shell.state
+    w = np.asarray(red._site_weights())
+    np.testing.assert_allclose(w, [1.0, 0.5, 0.125])
+    # no staleness record: plain participation weights (lockstep path)
+    red.cache = {}
+    np.testing.assert_allclose(np.asarray(red._site_weights()),
+                               [1.0, 1.0, 0.5])
+
+
+# --------------------------------------------------------------- fault plans
+def test_slow_site_plan_validates_and_bounds():
+    plan = slow_site_plan(site="site_1", seconds=0.2, first_round=2,
+                          last_round=5)
+    faults = load_fault_plan(plan)
+    assert [f.round for f in faults] == [2, 3, 4, 5]
+    assert all(f.kind == "slow" and f.site == "site_1"
+               and f.seconds == 0.2 for f in faults)
+    with pytest.raises(ValueError, match="first_round"):
+        slow_site_plan(first_round=4, last_round=2)
+
+
+# ------------------------------------------------------------------- tier-4
+def test_model_staleness_k_passes_clean_at_default_bound():
+    from coinstac_dinunet_tpu.analysis.model_check import (
+        FAULT_ALPHABET,
+        ModelConfig,
+        run_model_check,
+    )
+
+    assert "staleness_k" in FAULT_ALPHABET
+    assert ModelConfig().staleness == (0, ModelCheck.DEFAULT_STALENESS_K)
+    res = run_model_check(config=ModelConfig(kinds=("staleness_k",)))
+    assert res.findings == []
+
+
+def test_model_seeded_k_violation_fires_exactly_once(monkeypatch, tmp_path):
+    """A window check that accepts a contribution OLDER than k (the seeded
+    violation) produces exactly one proto-model-stale-contribution with a
+    loadable replay plan mapping to the engines' ``stale`` chaos fault."""
+    from coinstac_dinunet_tpu.analysis import model_check as mc
+
+    cfg = mc.ModelConfig(kinds=("staleness_k",), max_faults=2)
+    # real window semantics: aging past k is refused loudly — still clean
+    assert mc.run_model_check(config=cfg).findings == []
+    monkeypatch.setattr(mc, "_WINDOW_ACCEPTS_BEYOND_K", True)
+    res = mc.run_model_check(config=cfg, plans_dir=str(tmp_path))
+    assert {f.rule for f in res.findings} == {
+        ModelCheck.STALE_CONTRIBUTION
+    }
+    assert len(res.findings) == 1
+    plan = res.plans[0]
+    assert plan["scenario"]["staleness_k"] == ModelCheck.DEFAULT_STALENESS_K
+    assert {f["kind"] for f in plan["faults"]} == {"stale"}
+    # the emitted plan is loadable by the chaos schema as-is
+    assert load_fault_plan({"faults": plan["faults"]})
+    written = [p for p in os.listdir(tmp_path)
+               if p.startswith("proto-model-stale-contribution")]
+    assert len(written) == 1
+
+
+# ---------------------------------------------------------------- live plane
+def _async_event(name, site, lag, k=2, t0=100.0, rnd=5):
+    return {"kind": "event", "name": name, "cat": "async", "node": "engine",
+            "site": site, "lag": lag, "k": k, "t0": t0, "round": rnd}
+
+
+def test_live_staleness_gauge_verdict_and_prometheus():
+    live = LiveState(silence_after=30.0)
+    live.ingest([
+        {"kind": "event", "name": Live.HEARTBEAT, "cat": "engine",
+         "node": "engine", "site": "site_0", "t0": 100.0, "round": 5},
+        _async_event("async:stale", "site_1", 2),
+    ])
+    snap = live.snapshot(now=101.0)
+    assert snap["staleness_k"] == 2
+    assert snap["stale_standins"] == 1
+    assert snap["sites"]["site_1"]["staleness"] == 2
+    assert live.check(now=101.0) == []  # in-window: no verdict
+
+    live.ingest([_async_event("async:staleness_exceeded", "site_1", 3,
+                              t0=102.0, rnd=6)])
+    fired = live.check(now=102.5)
+    assert [v["verdict"] for v in fired] == [Live.VERDICT_STALENESS]
+    assert fired[0]["site"] == "site_1"
+    assert "more than k rounds behind" in fired[0]["cause"]
+    assert live.check(now=103.0) == []  # edge-triggered: no re-fire
+    # back inside the window: re-arms, a later breach fires again
+    live.ingest([_async_event("async:stale", "site_1", 1, t0=104.0, rnd=7)])
+    assert live.check(now=104.5) == []
+    # breach + recovery in ONE ingest batch (the engine blocks right after
+    # the exceeded event and flushes both samples together): the latched
+    # breach must still fire even though the gauge already recovered
+    live.ingest([
+        _async_event("async:staleness_exceeded", "site_1", 4,
+                     t0=105.0, rnd=8),
+        _async_event("async:stale", "site_1", 1, t0=105.1, rnd=9),
+    ])
+    assert [v["verdict"] for v in live.check(now=105.5)] == [
+        Live.VERDICT_STALENESS
+    ]
+    assert live.snapshot(now=105.6)["sites"]["site_1"]["staleness"] == 1
+
+    prom = render_prometheus(live.snapshot(now=106.0))
+    assert 'coinstac_dinunet_site_staleness{site="site_1"} 1.0' in prom
+    assert "coinstac_dinunet_staleness_k 2.0" in prom
+    assert ('coinstac_dinunet_verdicts_total{kind="staleness_exceeded"} 2.0'
+            in prom)
+
+
+def test_live_staleness_dead_site_reuses_retry_attribution():
+    live = LiveState()
+    live.ingest([
+        _async_event("async:stale", "site_0", 1),
+        {"kind": "event", "name": "site_died", "node": "engine",
+         "site": "site_0", "t0": 101.0, "round": 5,
+         "retries_exhausted": True, "attempts": 3},
+        _async_event("async:staleness_exceeded", "site_0", 5, t0=102.0,
+                     rnd=9),
+    ])
+    fired = live.check(now=103.0)
+    assert [v["verdict"] for v in fired] == [Live.VERDICT_STALENESS]
+    assert "retries exhausted" in fired[0]["evidence"]
+
+
+# ------------------------------------------------------------------- doctor
+def test_doctor_bench_verdict_pairs_wire_overlap_ratio():
+    from coinstac_dinunet_tpu.telemetry.doctor import build_report
+
+    history = [
+        {"metric": "engine_daemon_async_rounds_per_sec", "value": 10.0,
+         "unit": "rounds/sec"},
+        {"metric": "async_wire_overlap_ratio", "value": 0.6,
+         "unit": "ratio"},
+        {"metric": "engine_daemon_async_rounds_per_sec", "value": 9.9,
+         "unit": "rounds/sec"},
+        {"metric": "async_wire_overlap_ratio", "value": 0.2,
+         "unit": "ratio"},
+    ]
+    report = build_report([], bench_history=history)
+    bench = report["bench"]
+    # the worst same-metric regression wins: the overlap collapse (-67%)
+    # outranks the rounds/sec wiggle (-1%)
+    assert bench["regressed"]
+    assert bench["metric"] == "async_wire_overlap_ratio"
+    assert bench["unit"] == "ratio"
+    assert any(v["cause"].startswith("benchmark throughput regressed")
+               for v in report["verdicts"])
+
+
+# ------------------------------------------------------------ overlap helper
+def test_wire_overlap_ratio_interval_math():
+    def span(name, t0, dur, node="engine"):
+        return {"kind": "span", "name": name, "node": node, "t0": t0,
+                "dur": dur}
+
+    events = [
+        span("invoke:remote", 10.0, 2.0),      # wire [10, 12]
+        span("engine:relay", 12.0, 1.0),       # wire [12, 13]
+        span("invoke:site_0", 9.0, 2.5),       # compute [9, 11.5]
+        span("invoke:site_1", 12.5, 1.0),      # compute [12.5, 13.5]
+    ]
+    # overlap: [10, 11.5] + [12.5, 13] = 2.0 of 3.0 wire seconds
+    assert wire_overlap_ratio(events) == pytest.approx(2.0 / 3.0)
+    assert wire_overlap_ratio([span("invoke:site_0", 0, 1)]) is None
+    # non-engine lanes are ignored (sites' own node spans)
+    assert wire_overlap_ratio(
+        [span("invoke:remote", 0, 1, node="site_0")]
+    ) is None
