@@ -87,6 +87,22 @@ def build_parser():
                    help="write each proto-model-* counterexample as an "
                         "executable resilience/chaos.py fault plan JSON "
                         "into DIR (the CI model-check job uploads these)")
+    p.add_argument("--tier5", action="store_true",
+                   help="also run the tier-5 concurrency auditor: the "
+                        "static conc-* lock-discipline rules (pure AST) "
+                        "plus the proto-conc-* deterministic interleaving "
+                        "explorer driving the real async round loop under "
+                        "virtual time; every explorer violation ships a "
+                        "replayable schedule JSON (numpy only, no JAX; "
+                        "see docs/ANALYSIS.md 'Tier 5')")
+    p.add_argument("--schedules", default=None, metavar="DIR",
+                   help="write each proto-conc-* counterexample as a "
+                        "replayable schedule JSON into DIR (the CI lint "
+                        "job uploads these in the lint-findings artifact)")
+    p.add_argument("--schedule-bound", type=int, default=None,
+                   help="post-warmup rounds the interleaving explorer "
+                        "enumerates completion schedules over (default: "
+                        "Concurrency.DEFAULT_ROUNDS)")
     return p
 
 
@@ -96,6 +112,7 @@ TIER_PREFIXES = {
     "deep": ("deep-",),
     "tier3": ("tier3-", "perf-", "proto-flow-", "proto-cache-"),
     "model": ("proto-model-",),
+    "tier5": ("conc-", "proto-conc-"),
 }
 
 
@@ -119,13 +136,21 @@ def main(argv=None):
     if args.list_rules:
         for r in sorted(rules, key=lambda r: r.id):
             print(f"{r.id}: {r.doc}")
+        from .concurrency import TIER5_STATIC_RULE_IDS
         from .dataflow import TIER3_RULE_IDS
         from .model_check import MODEL_RULE_IDS
+        from .schedule_explorer import EXPLORER_RULE_IDS
 
         for rid in TIER3_RULE_IDS:
             print(f"{rid}: (tier-3, --tier3; see docs/ANALYSIS.md)")
         for rid in MODEL_RULE_IDS:
             print(f"{rid}: (tier-4 model checker, --model; "
+                  "see docs/ANALYSIS.md)")
+        for rid in TIER5_STATIC_RULE_IDS:
+            print(f"{rid}: (tier-5 concurrency auditor, --tier5; "
+                  "see docs/ANALYSIS.md)")
+        for rid in EXPLORER_RULE_IDS:
+            print(f"{rid}: (tier-5 interleaving explorer, --tier5; "
                   "see docs/ANALYSIS.md)")
         return 0
     if args.list_deep:
@@ -193,16 +218,29 @@ def main(argv=None):
               "window cannot be negative (0 = lockstep only)",
               file=sys.stderr)
         return 2
+    if not args.tier5 and (args.schedules is not None
+                           or args.schedule_bound is not None):
+        print("--schedules/--schedule-bound require --tier5",
+              file=sys.stderr)
+        return 2
+    if args.schedule_bound is not None and args.schedule_bound < 1:
+        print(f"--schedule-bound {args.schedule_bound}: the explorer "
+              "needs at least 1 post-warmup round (0/negative bounds "
+              "make every round-loop invariant vacuous)", file=sys.stderr)
+        return 2
     rule_ids = args.rules.split(",") if args.rules else None
     if rule_ids:
+        from .concurrency import TIER5_STATIC_RULE_IDS
         from .dataflow import TIER3_RULE_IDS
         from .model_check import MODEL_RULE_IDS
+        from .schedule_explorer import EXPLORER_RULE_IDS
 
-        # tier-3/tier-4 ids are selectable too (their findings are filtered
+        tier5_ids = set(TIER5_STATIC_RULE_IDS) | set(EXPLORER_RULE_IDS)
+        # tier-3/4/5 ids are selectable too (their findings are filtered
         # after the tier runs below)
         known = {r.id for r in rules} | set(TIER3_RULE_IDS) | set(
             MODEL_RULE_IDS
-        )
+        ) | tier5_ids
         unknown = sorted(set(rule_ids) - known)
         if unknown:
             print(f"unknown rule id(s): {', '.join(unknown)} "
@@ -219,6 +257,11 @@ def main(argv=None):
         if model_selected and not args.model:
             print(f"--rules {','.join(model_selected)} requires --model "
                   "(tier-4 rules only run under --model)", file=sys.stderr)
+            return 2
+        tier5_selected = sorted(set(rule_ids) & tier5_ids)
+        if tier5_selected and not args.tier5:
+            print(f"--rules {','.join(tier5_selected)} requires --tier5 "
+                  "(tier-5 rules only run under --tier5)", file=sys.stderr)
             return 2
     if args.write_baseline and rule_ids:
         print("--write-baseline with --rules would drop every other rule's "
@@ -325,7 +368,33 @@ def main(argv=None):
                     "site the explored model exercised without ever "
                     "violating", file=sys.stderr,
                 )
-    if args.deep or args.tier3 or args.model:
+    if args.tier5:
+        # tier-5: the static lock-discipline rules (pure AST) + the
+        # deterministic interleaving explorer (numpy only, no JAX)
+        from ..config.keys import Concurrency
+        from .concurrency import run_tier5_static
+        from .schedule_explorer import (
+            EXPLORER_RULE_IDS,
+            ScheduleConfig,
+            run_schedule_explorer,
+        )
+
+        wanted5 = set(rule_ids) if rule_ids else None
+        tier5_findings = list(run_tier5_static(paths=args.paths))
+        if wanted5 is None or not wanted5.isdisjoint(EXPLORER_RULE_IDS):
+            # skip the explorer entirely when --rules selected none of
+            # its ids: nothing it could produce would survive the filter
+            cfg = ScheduleConfig(rounds=args.schedule_bound)
+            result5 = run_schedule_explorer(
+                config=cfg, schedules_dir=args.schedules,
+            )
+            tier5_findings += result5.findings
+        if wanted5 is not None:
+            # the tier's own error channel must survive any filter
+            keep = wanted5 | {Concurrency.CONFIG}
+            tier5_findings = [f for f in tier5_findings if f.rule in keep]
+        findings = findings + tier5_findings
+    if args.deep or args.tier3 or args.model or args.tier5:
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     baseline_path = args.baseline
@@ -335,19 +404,24 @@ def main(argv=None):
     if args.write_baseline:
         out = baseline_path or DEFAULT_BASELINE
         broken = [f.rule for f in findings
-                  if f.rule in ("deep-config", "tier3-config")]
+                  if f.rule in ("deep-config", "tier3-config",
+                                "proto-model-config", "proto-conc-config")]
         if broken:
-            # an opt-in tier never actually ran — writing now would drop
-            # its accepted entries AND baseline the platform misconfig
-            print(f"--write-baseline refused: {broken[0]}: the virtual "
-                  "device platform is unavailable so the tier could not "
-                  "run — fix XLA_FLAGS or refresh without that tier",
-                  file=sys.stderr)
+            # an opt-in tier never actually ran (platform misconfig,
+            # explorer failure, or a truncated bound) — writing now would
+            # drop its accepted entries AND permanently baseline the
+            # tier's own error channel, so every later run would absorb
+            # it and exit clean with the tier never running
+            print(f"--write-baseline refused: {broken[0]}: the tier could "
+                  "not run to completion — fix the configuration "
+                  "(XLA_FLAGS / explorer bound) or refresh without that "
+                  "tier", file=sys.stderr)
             return 2
         extra = []
         missing = [t for t, ran in (("deep", args.deep),
                                     ("tier3", args.tier3),
-                                    ("model", args.model)) if not ran]
+                                    ("model", args.model),
+                                    ("tier5", args.tier5)) if not ran]
         if missing and os.path.exists(out):
             # a tier that didn't run contributes nothing to this refresh —
             # carry its accepted entries over instead of silently dropping

@@ -253,7 +253,7 @@ def write_baseline(path, findings, extra_entries=()):
     payload = {
         "comment": (
             "dinulint baseline: legacy findings that do not fail CI.  "
-            "Refresh with: dinulint <paths> --tier3 --deep --model "
+            "Refresh with: dinulint <paths> --tier3 --deep --model --tier5 "
             "--write-baseline --baseline " + os.path.basename(path)
         ),
         "findings": entries,

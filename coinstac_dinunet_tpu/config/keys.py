@@ -608,6 +608,70 @@ class ModelCheck:
     CONFIG = "proto-model-config"
 
 
+class Concurrency:
+    """Tier-5 concurrency-auditor contract (``dinulint --tier5``,
+    :mod:`coinstac_dinunet_tpu.analysis.concurrency` /
+    :mod:`coinstac_dinunet_tpu.analysis.schedule_explorer`).
+
+    Plain constants, mirroring :class:`ModelCheck`: the default explorer
+    bound plus the rule vocabulary of both tier-5 halves.  The static
+    ``conc-*`` rules audit lock discipline over the threaded modules; the
+    dynamic ``proto-conc-*`` rules are round-loop invariants checked by
+    the deterministic interleaving explorer, and every violation ships a
+    **replayable schedule JSON** (docs/ANALYSIS.md "Tier 5").
+
+    Static half (pure ``ast``, no JAX, no engine import):
+
+    - ``UNGUARDED`` — a shared mutable attribute whose every other write
+      site holds an inferred ``threading.Lock``/``RLock`` guard is
+      written from a pool-submitted callable / ``Thread`` target without
+      that guard.
+    - ``LOCK_ORDER`` — two locks are acquired in inconsistent nesting
+      order on two paths of one module (the classic ABBA deadlock shape).
+    - ``ESCAPE`` — mutable state handed into a
+      ``ThreadPoolExecutor.submit`` closure is mutated by the parent
+      between the submit and the matching ``.result()``.
+    - ``FS_RACE`` — a transfer-directory payload is written outside the
+      ``resilience/transport.py`` atomic-commit helpers from a threaded
+      context (``wire-atomic-commit``'s taint, extended across the
+      thread boundary).
+
+    Dynamic half (the schedule explorer, driving the real async round
+    loop under virtual time):
+
+    - ``TORN_STALE`` — a reduce observed a straggler stand-in whose
+      payload did not match its frozen ``.stale`` alias contribution
+      (the stand-in raced the straggler's next commit).
+    - ``LOST_COMMIT`` — a delivered site output never landed in the
+      engine's ``_last_site_outs`` replay record.
+    - ``TORN_JSONL`` — the engine telemetry lane contained a torn or
+      undecodable JSONL line after the bounded run.
+    - ``CLOSE_DEADLOCK`` — ``close()`` deadlocked against (or leaked a
+      worker to) an in-flight supervised worker restart.
+    - ``CONFIG`` — the tier's own error channel (the explorer could not
+      run); survives ``--rules`` filtering like ``tier3-config``.
+    """
+
+    #: default explorer bound: sites × post-warmup rounds × window k ×
+    #: invocation-pool width (schedules enumerate site completion
+    #: choices per round — exhaustive within the bound, deterministic)
+    DEFAULT_SITES = 2
+    DEFAULT_ROUNDS = 2
+    DEFAULT_STALENESS_K = 1
+    DEFAULT_POOL = 2
+
+    UNGUARDED = "conc-unguarded-shared-write"
+    LOCK_ORDER = "conc-lock-order"
+    ESCAPE = "conc-escape"
+    FS_RACE = "conc-fs-race"
+
+    TORN_STALE = "proto-conc-torn-stale"
+    LOST_COMMIT = "proto-conc-lost-commit"
+    TORN_JSONL = "proto-conc-torn-jsonl"
+    CLOSE_DEADLOCK = "proto-conc-close-deadlock"
+    CONFIG = "proto-conc-config"
+
+
 class AggEngine(_StrEnum):
     """Built-in gradient-aggregation engines (≙ AGG_Engine dSGD/powerSGD/rankDAD)."""
     DSGD = "dSGD"

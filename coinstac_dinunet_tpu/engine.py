@@ -20,6 +20,7 @@ import math
 import os
 import shutil
 import statistics
+import threading
 import time
 
 import numpy as np
@@ -200,9 +201,13 @@ class InProcessEngine:
         # per-site recent invoke wall-times (grace basis).  The FIRST
         # completed invocation per site is dropped: it carries the one-off
         # cold start (worker spawn, imports, first compiles) and would
-        # inflate the grace window for the whole run
+        # inflate the grace window for the whole run.  Pool threads append
+        # while the engine thread computes the grace median — the lock
+        # keeps the deques from mutating mid-iteration (dinulint tier-5
+        # conc-unguarded-shared-write discipline)
         self._async_invoke_hist = {}
         self._async_warm = set()
+        self._async_hist_lock = threading.Lock()
 
     # ------------------------------------------------------------- telemetry
     def _recorder(self):
@@ -612,14 +617,15 @@ class InProcessEngine:
         t0 = time.monotonic()
         out = self._invoke_with_retry(policy, attempt, s, rec)
         dur = time.monotonic() - t0
-        if s in self._async_warm:
-            from collections import deque
+        with self._async_hist_lock:
+            if s in self._async_warm:
+                from collections import deque
 
-            self._async_invoke_hist.setdefault(s, deque(maxlen=8)).append(
-                dur
-            )
-        else:
-            self._async_warm.add(s)
+                self._async_invoke_hist.setdefault(
+                    s, deque(maxlen=8)
+                ).append(dur)
+            else:
+                self._async_warm.add(s)
         return out
 
     def _async_grace(self):
@@ -628,10 +634,11 @@ class InProcessEngine:
         median invoke time — a double median, so neither one straggler nor
         one outlier sample can inflate everyone's wait.  None before any
         warm invocation completed (warm-up rounds block anyway)."""
-        per_site = [
-            statistics.median(hist)
-            for hist in self._async_invoke_hist.values() if hist
-        ]
+        with self._async_hist_lock:
+            per_site = [
+                statistics.median(hist)
+                for hist in self._async_invoke_hist.values() if hist
+            ]
         if not per_site:
             return None
         return self._ASYNC_GRACE_FACTOR * statistics.median(per_site)

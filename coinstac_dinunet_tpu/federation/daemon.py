@@ -543,20 +543,29 @@ class DaemonEngine(SubprocessEngine):
         if self.chaos.enabled:
             # idle-kill drill point: the worker dies BETWEEN rounds (during
             # the relay), so the next round's first request finds it dead
-            # and the supervisor restarts it
-            for target in list(self._workers):
-                if self.chaos.worker_fault(rnd, target, rec,
-                                           when="idle") is not None:
-                    self._workers[target].kill()
-                    self._worker_last_error[target] = (
-                        "chaos worker_kill (idle)"
-                    )
+            # and the supervisor restarts it.  Check AND kill under the
+            # worker lock (tier-5 audit): an async pool thread restarting
+            # its own straggler may swap the table entry concurrently, and
+            # a kill issued on a stale snapshot would consume the plan
+            # entry while the fresh worker survives — a silent no-op kill.
+            # kill() is signal + reap only; no re-entrant lock risk.
+            with self._worker_lock:
+                for target, w in sorted(self._workers.items()):
+                    if self.chaos.worker_fault(rnd, target, rec,
+                                               when="idle") is not None:
+                        w.kill()
+                        self._worker_last_error[target] = (
+                            "chaos worker_kill (idle)"
+                        )
 
     # -------------------------------------------------------------- lifetime
     def worker_pids(self):
         """{target: pid} of the currently-live workers (test/ops surface:
-        a warm run keeps one pid per target for its whole lifetime)."""
-        return {t: w.pid for t, w in self._workers.items() if w.alive()}
+        a warm run keeps one pid per target for its whole lifetime).
+        Snapshot under the worker lock — an async pool thread's restart
+        mutates the table concurrently (tier-5 audit)."""
+        with self._worker_lock:
+            return {t: w.pid for t, w in self._workers.items() if w.alive()}
 
     def close(self):
         """Shut every worker down (orderly frame, then SIGKILL).  The

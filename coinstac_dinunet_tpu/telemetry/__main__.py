@@ -276,7 +276,21 @@ def watch_main(argv=None):
         with open(args.metrics_out, "w", encoding="utf-8") as f:
             f.write(text)
     if server is not None:
-        server.close()
+        # close under a REAL recorder writing into the watched run dir:
+        # the watch process has no ambient recorder, so without this the
+        # typed telemetry:degraded event a failed join emits would land
+        # on the null recorder and leave no postmortem evidence
+        from .recorder import Recorder, activate
+
+        rec = Recorder("watch", out_dir=str(args.root))
+        with activate(rec):
+            joined = server.close()
+        rec.flush()
+        if not joined:
+            print("WARNING: ops server thread failed to join on close; "
+                  "the listener may leak until process exit (typed "
+                  "telemetry:degraded event recorded in "
+                  f"{args.root})", file=sys.stderr)
 
     for kind in args.assert_verdict or ():
         hits = [v for v in state.verdicts if v["verdict"] == kind]

@@ -25,6 +25,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..config.keys import Live
+from .recorder import get_active as _telemetry
 
 PROM_PREFIX = Live.PROM_PREFIX
 
@@ -229,7 +230,22 @@ class OpsServer:
         with urlopen(self.url(path), timeout=timeout) as resp:
             return resp.read().decode("utf-8")
 
-    def close(self):
+    def close(self, timeout=2.0):
+        """Stop serving and JOIN the serving thread.  Returns True when
+        the thread exited within ``timeout``; a thread that failed to
+        join (a scrape wedged in a handler) leaves a listener behind
+        between CI jobs, so the failure is surfaced as a typed
+        ``telemetry:degraded`` event on the ambient recorder — evidence
+        in the trace instead of a silent leak."""
         self._httpd.shutdown()
         self._httpd.server_close()
-        self._thread.join(timeout=2.0)
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            _telemetry().event(
+                "telemetry:degraded", cat="telemetry",
+                what="ops server thread failed to join on close "
+                     "(listener may leak until process exit)",
+                thread=self._thread.name, timeout_s=float(timeout),
+            )
+            return False
+        return True

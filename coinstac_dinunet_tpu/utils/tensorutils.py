@@ -16,6 +16,7 @@ re-designed for a JAX runtime:
 import json
 import os
 import struct
+import threading
 import time
 
 import numpy as np
@@ -248,14 +249,53 @@ def load_arrays(path, retry=None):
     return out
 
 
+# -------------------------------------------------------- fan-in thread pool
+# The reduce fan-in used to construct (and tear down) a fresh
+# ThreadPoolExecutor on EVERY load_arrays_many call — thread spawn +
+# join on the aggregator's hot path, N times per round.  One bounded
+# module-level pool (lazily created, capped at the host's core count)
+# amortizes that to zero; ``shutdown_fan_in_pool`` is the teardown hook
+# test harnesses and the tier-5 concurrency explorer use to account for
+# (and reclaim) the long-lived threads.
+_FAN_IN_POOL = None
+_FAN_IN_POOL_LOCK = threading.Lock()
+
+
+def fan_in_pool():
+    """The process-wide bounded fan-in executor (created on first use)."""
+    global _FAN_IN_POOL
+    with _FAN_IN_POOL_LOCK:
+        if _FAN_IN_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _FAN_IN_POOL = ThreadPoolExecutor(
+                max_workers=os.cpu_count() or 8,
+                thread_name_prefix="coinn-fan-in",
+            )
+        return _FAN_IN_POOL
+
+
+def shutdown_fan_in_pool(wait=True):
+    """Tear the shared fan-in executor down (no-op when never built).
+    The next :func:`load_arrays_many` lazily rebuilds it."""
+    global _FAN_IN_POOL
+    with _FAN_IN_POOL_LOCK:
+        pool, _FAN_IN_POOL = _FAN_IN_POOL, None
+    if pool is not None:
+        pool.shutdown(wait=wait)
+
+
 def load_arrays_many(paths, retry=None):
     """Load several payload files concurrently — the aggregator's N-site
     fan-in (≙ ref ``distrib/reducer.py:18-23`` multiprocessing pool).
 
-    Native C++ threads when available; a GIL-releasing thread pool otherwise
-    (capped at the host's core count — an unbounded pool at high site fan-in
-    thrashes instead of parallelizing).  Individual native read/verify
-    failures retry through the Python reader under ``retry``."""
+    Native C++ threads when available; the shared GIL-releasing thread
+    pool otherwise (:func:`fan_in_pool` — bounded at the host's core
+    count and reused across calls: an unbounded pool at high site fan-in
+    thrashes instead of parallelizing, and a fresh pool per call pays
+    thread spawn/join on the reduce hot path).  Individual native
+    read/verify failures retry through the Python reader under
+    ``retry``."""
     from .. import native
 
     paths = list(paths)
@@ -270,15 +310,11 @@ def load_arrays_many(paths, retry=None):
         return None if retry is None else retry.fork(i)
 
     if payloads is None:
-        from concurrent.futures import ThreadPoolExecutor
-
-        workers = min(max(len(paths), 1), os.cpu_count() or 8)
         # each load_arrays call records its own wire event
-        with ThreadPoolExecutor(max_workers=workers) as ex:
-            return list(ex.map(
-                lambda ip: load_arrays(ip[1], retry=_task_retry(ip[0])),
-                enumerate(paths),
-            ))
+        return list(fan_in_pool().map(
+            lambda ip: load_arrays(ip[1], retry=_task_retry(ip[0])),
+            enumerate(paths),
+        ))
     out = []
     for i, (p, payload) in enumerate(zip(paths, payloads)):
         if payload is None:  # transient native failure: retry via Python IO

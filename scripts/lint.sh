@@ -58,6 +58,22 @@ if [ "${DINULINT_MODEL:-}" = "1" ]; then
         extra+=(--model-plans "$DINULINT_MODEL_PLANS")
     fi
 fi
+if [ "${DINULINT_TIER5:-}" = "1" ]; then
+    # tier-5 concurrency auditor: static conc-* lock-discipline rules
+    # (pure AST) + the proto-conc-* deterministic interleaving explorer
+    # (numpy only, no JAX; docs/ANALYSIS.md "Tier 5").  Knobs:
+    # DINULINT_TIER5_BOUND overrides the explorer's post-warmup round
+    # bound; DINULINT_TIER5_SCHEDULES names a directory for the
+    # replayable violation schedules (the CI lint job uploads it in the
+    # lint-findings artifact).
+    extra+=(--tier5)
+    if [ -n "${DINULINT_TIER5_BOUND:-}" ]; then
+        extra+=(--schedule-bound "$DINULINT_TIER5_BOUND")
+    fi
+    if [ -n "${DINULINT_TIER5_SCHEDULES:-}" ]; then
+        extra+=(--schedules "$DINULINT_TIER5_SCHEDULES")
+    fi
+fi
 
 echo "== dinulint (${DINULINT[*]} ${extra[*]-}) =="
 # Under GitHub Actions, emit ::error workflow annotations so findings land

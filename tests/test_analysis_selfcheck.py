@@ -83,8 +83,8 @@ def test_protocol_rule_reports_zero_unmatched_wire_keys():
 MAX_BASELINE_FINDINGS = 0
 
 REFRESH_CMD = (
-    "dinulint coinstac_dinunet_tpu --tier3 --deep --write-baseline "
-    "--baseline dinulint_baseline.json"
+    "dinulint coinstac_dinunet_tpu --tier3 --deep --model --tier5 "
+    "--write-baseline --baseline dinulint_baseline.json"
 )
 
 
@@ -137,7 +137,23 @@ def test_baseline_ratchet_has_no_stale_suppressions():
         from coinstac_dinunet_tpu.analysis.deepcheck import run_deepcheck
 
         findings += run_deepcheck()
+    if any(e["rule"].startswith(("conc-", "proto-conc-"))
+           for e in entries):
+        from coinstac_dinunet_tpu.analysis.concurrency import (
+            run_tier5_static,
+        )
+        from coinstac_dinunet_tpu.analysis.schedule_explorer import (
+            run_schedule_explorer,
+        )
+
+        findings += run_tier5_static([PACKAGE])
+        findings += run_schedule_explorer().findings
+    if any(e["rule"].startswith("proto-model-") for e in entries):
+        from coinstac_dinunet_tpu.analysis.model_check import run_model_check
+
+        findings += run_model_check().findings
     if any(e["rule"].startswith(("perf-", "proto-", "tier3-"))
+           and not e["rule"].startswith(("proto-conc-", "proto-model-"))
            for e in entries):
         from coinstac_dinunet_tpu.analysis.dataflow import run_tier3
 

@@ -1,0 +1,526 @@
+"""dinulint tier-5: the concurrency auditor (ISSUE 13 acceptance).
+
+Three layers, mirroring the tier-4 test shape:
+
+- **static units** — seeded lock-discipline bugs in synthetic modules (an
+  unguarded threaded write, an ABBA lock-order inversion, mutable state
+  escaping into a submit closure, a threaded transfer-directory write)
+  each produce exactly one ``conc-*`` finding; the guarded versions and
+  the real repo produce none.
+- **explorer invariants** — the deterministic interleaving explorer is
+  clean on the real async round loop at the default bound,
+  deterministically, inside the CI budget; flipping each broken-semantics
+  switch (the tier-4 idiom) makes exactly its invariant fire with a
+  schedule JSON that :func:`replay_schedule` re-executes to the same
+  violation.
+- **CLI composition** — ``--tier5`` composes with the baseline, ``--rules``
+  and ``--format github``; the knobs require the tier.
+"""
+import ast
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+from coinstac_dinunet_tpu.analysis import schedule_explorer as se
+from coinstac_dinunet_tpu.analysis.__main__ import main
+from coinstac_dinunet_tpu.analysis.concurrency import (
+    TIER5_STATIC_RULE_IDS,
+    analyze_module,
+    run_tier5_static,
+)
+from coinstac_dinunet_tpu.analysis.core import Module
+from coinstac_dinunet_tpu.analysis.schedule_explorer import (
+    EXPLORER_RULE_IDS,
+    ScheduleConfig,
+    replay_schedule,
+    run_close_drill,
+    run_schedule_explorer,
+)
+from coinstac_dinunet_tpu.config.keys import Concurrency
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "coinstac_dinunet_tpu")
+BASELINE = os.path.join(REPO, "dinulint_baseline.json")
+
+
+def _findings(src, name="fx/threaded.py"):
+    src = textwrap.dedent(src)
+    return analyze_module(Module(name, src, ast.parse(src)))
+
+
+# ------------------------------------------------------------- static units
+def test_seeded_unguarded_threaded_write_fires_exactly_once():
+    src = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def start(self):
+            threading.Thread(target=self._drain).start()
+
+        def _drain(self):
+            self._items.append("threaded")
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+    """
+    found = _findings(src)
+    assert [f.rule for f in found] == [Concurrency.UNGUARDED]
+    assert "self._items" in found[0].message
+    assert "self._lock" in found[0].message
+
+
+def test_guarded_threaded_write_is_clean():
+    src = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []
+
+        def start(self):
+            threading.Thread(target=self._drain).start()
+
+        def _drain(self):
+            with self._lock:
+                self._items.append("threaded")
+
+        def add(self, x):
+            with self._lock:
+                self._items.append(x)
+    """
+    assert _findings(src) == []
+
+
+def test_no_discipline_means_no_unguarded_finding():
+    """An attribute no write site ever guards has no inferred discipline —
+    flagging it would drown real findings in noise."""
+    src = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._items = []
+
+        def start(self):
+            threading.Thread(target=self._drain).start()
+
+        def _drain(self):
+            self._items.append("threaded")
+
+        def add(self, x):
+            self._items.append(x)
+    """
+    assert _findings(src) == []
+
+
+def test_seeded_lock_order_inversion_fires_exactly_once():
+    src = """
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def forward():
+        with A:
+            with B:
+                pass
+
+    def backward():
+        with B:
+            with A:
+                pass
+    """
+    found = _findings(src)
+    assert [f.rule for f in found] == [Concurrency.LOCK_ORDER]
+    assert "ABBA" in found[0].message
+
+
+def test_lock_order_through_a_callee_is_seen():
+    """The inversion hides one call deep: f holds A and calls g which
+    takes B, while h nests them the other way."""
+    src = """
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def take_b():
+        with B:
+            pass
+
+    def f():
+        with A:
+            take_b()
+
+    def h():
+        with B:
+            with A:
+                pass
+    """
+    found = _findings(src)
+    assert [f.rule for f in found] == [Concurrency.LOCK_ORDER]
+
+
+def test_consistent_nesting_is_clean():
+    src = """
+    import threading
+
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def f():
+        with A:
+            with B:
+                pass
+
+    def g():
+        with A:
+            with B:
+                pass
+    """
+    assert _findings(src) == []
+
+
+def test_seeded_escaped_closure_state_fires_exactly_once():
+    src = """
+    def fan_out(pool, work):
+        batch = []
+        fut = pool.submit(work, batch)
+        batch.append("racing")
+        fut.result()
+    """
+    found = _findings(src)
+    assert [f.rule for f in found] == [Concurrency.ESCAPE]
+    assert "batch" in found[0].message
+
+
+def test_mutation_after_result_is_clean():
+    src = """
+    def fan_out(pool, work):
+        batch = []
+        fut = pool.submit(work, batch)
+        fut.result()
+        batch.append("safe")
+    """
+    assert _findings(src) == []
+
+
+def test_seeded_threaded_transfer_write_fires_exactly_once():
+    src = """
+    import os
+    import threading
+
+    def start(state):
+        threading.Thread(target=_writer, args=(state,)).start()
+
+    def _writer(state):
+        p = os.path.join(state["transferDirectory"], "grads.npy")
+        with open(p, "wb") as f:
+            f.write(b"partial")
+    """
+    found = _findings(src)
+    assert [f.rule for f in found] == [Concurrency.FS_RACE]
+    assert "thread" in found[0].message
+
+
+def test_unthreaded_transfer_write_is_tier1s_problem_not_tier5s():
+    """Without a thread boundary the base wire-atomic-commit rule owns the
+    finding; tier-5 must not double-report it."""
+    src = """
+    import os
+
+    def writer(state):
+        p = os.path.join(state["transferDirectory"], "grads.npy")
+        with open(p, "wb") as f:
+            f.write(b"partial")
+    """
+    assert _findings(src) == []
+
+
+def test_repo_static_is_clean_and_fast():
+    t0 = time.monotonic()
+    found = run_tier5_static([PKG])
+    elapsed = time.monotonic() - t0
+    assert [f.render() for f in found] == []
+    assert elapsed < 10.0, f"static tier-5 took {elapsed:.1f}s"
+
+
+# ------------------------------------------------------- explorer invariants
+def test_explorer_clean_at_default_bound_deterministically_under_budget():
+    """ISSUE 13 acceptance: the default bound explores every completion
+    schedule of the real async round loop, deterministically, clean,
+    well inside the 60 s CI budget — and it actually exercises the
+    stand-in and forced-block paths."""
+    t0 = time.monotonic()
+    first = run_schedule_explorer()
+    second = run_schedule_explorer()
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60.0, f"two default-bound explorations took {elapsed:.1f}s"
+    assert [f.render() for f in first.findings] == []
+    assert [f.render() for f in first.findings] == [
+        f.render() for f in second.findings
+    ]
+    assert first.report == second.report
+    assert first.report["schedules_run"] == (
+        (len(se.CHOICES) ** Concurrency.DEFAULT_SITES)
+        ** Concurrency.DEFAULT_ROUNDS
+    )
+    assert first.report["truncated"] == 0
+    assert first.report["drill_run"]
+    # the bound reached the boundary paths: some schedules forced the
+    # engine to block on a straggler (the beyond-window fallback)
+    assert first.report["forced_blocks"] > 0
+
+
+def test_standin_path_is_exercised(tmp_path):
+    """A defer schedule really delivers a stand-in (the async:stale event
+    lands on the engine lane) — the invariants are not vacuously green."""
+    from coinstac_dinunet_tpu.telemetry.collect import read_jsonl_segment
+
+    cfg = ScheduleConfig()
+    schedule = [{"site_0": "defer", "site_1": "fresh"},
+                {"site_0": "fresh", "site_1": "fresh"}]
+    violations = se._run_schedule(cfg, schedule, str(tmp_path))
+    assert violations == []
+    records, _, bad, partial = read_jsonl_segment(
+        os.path.join(str(tmp_path), "telemetry.engine.jsonl")
+    )
+    assert bad == 0 and not partial
+    names = [r.get("name") for r in records if r.get("kind") == "event"]
+    assert "async:stale" in names
+
+
+@pytest.mark.parametrize("switch,rule", [
+    ("_SNAPSHOT_DISABLED", Concurrency.TORN_STALE),
+    ("_DROP_COMMIT", Concurrency.LOST_COMMIT),
+    ("_TORN_FLUSH", Concurrency.TORN_JSONL),
+])
+def test_seeded_explorer_bug_fires_with_replayable_schedule(
+    monkeypatch, tmp_path, switch, rule
+):
+    """The tier-4 non-vacuity idiom: each broken-semantics switch makes
+    exactly its invariant fire, with a schedule JSON whose replay
+    reproduces the same violation."""
+    monkeypatch.setattr(se, switch, True)
+    out_dir = tmp_path / "schedules"
+    result = run_schedule_explorer(
+        config=ScheduleConfig(rounds=1), schedules_dir=str(out_dir),
+    )
+    assert [f.rule for f in result.findings] == [rule]
+    assert "replayable schedule" in result.findings[0].message
+    # the schedule JSON landed and validates
+    files = sorted(os.listdir(out_dir))
+    assert len(files) == 1 and files[0].startswith(rule)
+    with open(out_dir / files[0]) as f:
+        plan = json.load(f)
+    assert plan["rule"] == rule
+    assert plan["scenario"]["sites"] == Concurrency.DEFAULT_SITES
+    # replay: same broken semantics, same schedule -> same violation
+    replayed = replay_schedule(plan, workdir=str(tmp_path / "replay"))
+    assert rule in {v["rule"] for v in replayed}
+
+
+@pytest.mark.parametrize("switch,rule", [
+    ("_SNAPSHOT_DISABLED", Concurrency.TORN_STALE),
+    ("_DROP_COMMIT", Concurrency.LOST_COMMIT),
+    ("_TORN_FLUSH", Concurrency.TORN_JSONL),
+])
+def test_fixed_tree_replays_seeded_schedules_clean(
+    monkeypatch, tmp_path, switch, rule
+):
+    """Regression pin: the schedules that expose each broken semantics
+    replay CLEAN against the real (fixed) engine code paths."""
+    monkeypatch.setattr(se, switch, True)
+    result = run_schedule_explorer(config=ScheduleConfig(rounds=1))
+    plan = result.plans[0]
+    monkeypatch.setattr(se, switch, False)
+    replayed = replay_schedule(plan, workdir=str(tmp_path))
+    assert replayed == []
+
+
+def test_close_drill_clean_and_broken_supervisor_caught(monkeypatch, tmp_path):
+    """The daemon close-vs-restart interleaving: the real engine's
+    spawn-under-lock contract survives the drill; the pre-fix shape (a
+    spawn outside the worker lock) leaks the late registration and
+    fires proto-conc-close-deadlock."""
+    assert run_close_drill(str(tmp_path / "clean")) == []
+    monkeypatch.setattr(se, "_DRILL_UNSERIALIZED_SPAWN", True)
+    violations = run_close_drill(str(tmp_path / "broken"))
+    assert [v["rule"] for v in violations] == [Concurrency.CLOSE_DEADLOCK]
+
+
+def test_beyond_window_straggler_forces_block(tmp_path):
+    """A site deferred past k must be blocked on (never stood in for):
+    the engine records staleness_exceeded and the reduce still gets a
+    fresh-at-forced-delivery payload — no violation."""
+    from coinstac_dinunet_tpu.telemetry.collect import read_jsonl_segment
+
+    cfg = ScheduleConfig()
+    schedule = [{"site_0": "defer", "site_1": "fresh"},
+                {"site_0": "defer", "site_1": "fresh"}]
+    violations = se._run_schedule(cfg, schedule, str(tmp_path))
+    assert violations == []
+    records, *_ = read_jsonl_segment(
+        os.path.join(str(tmp_path), "telemetry.engine.jsonl")
+    )
+    names = [r.get("name") for r in records if r.get("kind") == "event"]
+    assert "async:staleness_exceeded" in names
+
+
+# ------------------------------------------------------------------ CLI
+def test_cli_tier5_is_clean_and_composes_with_github_format(capsys):
+    rc = main([PKG, "--baseline", BASELINE, "--tier5", "--schedule-bound",
+               "1", "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 new finding(s)" in out
+
+
+def test_cli_tier5_knobs_require_the_tier(capsys):
+    rc = main([PKG, "--schedules", "/tmp/nope"])
+    assert rc == 2
+    assert "--tier5" in capsys.readouterr().err
+    rc = main([PKG, "--schedule-bound", "2"])
+    assert rc == 2
+    assert "--tier5" in capsys.readouterr().err
+    rc = main([PKG, "--tier5", "--schedule-bound", "0"])
+    assert rc == 2
+    assert "at least 1" in capsys.readouterr().err
+
+
+def test_cli_tier5_rule_ids_require_the_tier(capsys):
+    rc = main([PKG, "--rules", "conc-lock-order"])
+    assert rc == 2
+    assert "--tier5" in capsys.readouterr().err
+
+
+def test_cli_tier5_static_only_rule_filter_skips_the_explorer(capsys):
+    """--rules with only static conc-* ids must not pay the explorer (the
+    tier-3 pure-AST shortcut idiom) — sub-second instead of seconds."""
+    t0 = time.monotonic()
+    rc = main([PKG, "--baseline", BASELINE, "--tier5",
+               "--rules", "conc-lock-order,conc-escape"])
+    elapsed = time.monotonic() - t0
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert elapsed < 3.0, f"static-only --tier5 took {elapsed:.1f}s"
+
+
+def test_cli_list_rules_includes_tier5(capsys):
+    rc = main(["--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for rid in TIER5_STATIC_RULE_IDS + EXPLORER_RULE_IDS:
+        assert rid in out
+
+
+def test_write_baseline_without_tier5_carries_conc_entries(tmp_path, capsys):
+    """A static-only --write-baseline refresh must not drop accepted
+    tier-5 findings (the TIER_PREFIXES carryover contract)."""
+    baseline = tmp_path / "baseline.json"
+    entry = {"rule": Concurrency.UNGUARDED, "path": "x.py",
+             "message": "legacy", "count": 1}
+    baseline.write_text(json.dumps({"findings": [entry]}))
+    rc = main([PKG, "--baseline", str(baseline), "--write-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    kept = json.loads(baseline.read_text())["findings"]
+    assert any(e["rule"] == Concurrency.UNGUARDED for e in kept)
+
+
+def test_explorer_ceiling_truncation_fails_loudly():
+    """No silent caps: a bound whose enumeration exceeds max_schedules
+    must surface proto-conc-config (the tier-4 MAX_STATES idiom), never
+    report a partially-explored bound as clean."""
+    result = run_schedule_explorer(
+        config=ScheduleConfig(rounds=4, max_schedules=5)
+    )
+    rules = {f.rule for f in result.findings}
+    assert Concurrency.CONFIG in rules
+    [config_finding] = [f for f in result.findings
+                        if f.rule == Concurrency.CONFIG]
+    assert "NOT explored" in config_finding.message
+    assert result.report["truncated"] > 0
+    assert result.report["schedules_run"] == 5
+
+
+def test_cli_tier5_config_rule_is_selectable(capsys):
+    """The tier's error channel is a first-class selectable rule id, like
+    tier3-config and proto-model-config."""
+    rc = main([PKG, "--baseline", BASELINE, "--tier5",
+               "--rules", "proto-conc-config", "--schedule-bound", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+
+def test_local_shadowing_a_guarded_global_is_not_flagged():
+    """Scope precision: a function-local name that shadows a lock-guarded
+    module global is not shared state and must not fire."""
+    src = """
+    import threading
+
+    LOCK = threading.Lock()
+    items = []
+
+    def add(x):
+        with LOCK:
+            items.append(x)
+
+    def start():
+        threading.Thread(target=_drain).start()
+
+    def _drain():
+        items = []          # a LOCAL list, nothing shared
+        items.append("ok")
+    """
+    assert _findings(src) == []
+
+
+def test_declared_global_threaded_write_fires():
+    """The same shape with a real `global` declaration IS a shared write
+    and keeps firing."""
+    src = """
+    import threading
+
+    LOCK = threading.Lock()
+    items = []
+
+    def add(x):
+        with LOCK:
+            items.append(x)
+
+    def start():
+        threading.Thread(target=_drain).start()
+
+    def _drain():
+        global items
+        items.append("threaded")
+    """
+    found = _findings(src)
+    assert [f.rule for f in found] == [Concurrency.UNGUARDED]
+
+
+def test_torn_jsonl_anchor_is_the_real_recorder_flush():
+    """The finding must anchor to Recorder.flush, not _NullRecorder.flush
+    (a no-op earlier in the same file)."""
+    from coinstac_dinunet_tpu.telemetry import recorder as rec_mod
+
+    path, line = se._anchor_for(Concurrency.TORN_JSONL)
+    assert path.endswith("telemetry/recorder.py")
+    tree = ast.parse(open(rec_mod.__file__).read())
+    expected = None
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "Recorder":
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef) and sub.name == "flush":
+                    expected = sub.lineno
+    assert line == expected

@@ -602,3 +602,37 @@ def test_disabled_mode_overhead_includes_heartbeats():
         rec.event(Live.HEARTBEAT, cat="engine", site="site_0")
     dt = time.perf_counter() - t0
     assert dt < 1.0, f"disabled heartbeat cost {dt:.3f}s for 200k beats"
+
+
+def test_ops_server_close_joins_or_reports_degraded(tmp_path):
+    """Tier-5 satellite: close() joins the serving thread (True on the
+    orderly path); a thread that refuses to die surfaces as a typed
+    telemetry:degraded event on the ambient recorder instead of a silent
+    listener leak between CI jobs."""
+    import threading
+
+    from coinstac_dinunet_tpu.telemetry import Recorder, activate
+    from coinstac_dinunet_tpu.telemetry.collect import read_jsonl_segment
+
+    st = LiveState(silence_after=30.0)
+    server = OpsServer(lambda: st.snapshot(now=100.0))
+    assert server.close() is True
+
+    server2 = OpsServer(lambda: st.snapshot(now=100.0))
+    wedge = threading.Event()
+    stuck = threading.Thread(target=wedge.wait, daemon=True,
+                             name="wedged-scrape")
+    stuck.start()
+    server2._thread = stuck  # model a handler wedged mid-scrape
+    rec = Recorder("engine", out_dir=str(tmp_path))
+    try:
+        with activate(rec):
+            ok = server2.close(timeout=0.1)
+    finally:
+        wedge.set()
+    assert ok is False
+    rec.flush()
+    records, _, bad, _ = read_jsonl_segment(rec.path())
+    assert bad == 0
+    degraded = [r for r in records if r.get("name") == "telemetry:degraded"]
+    assert any("ops server" in str(r.get("what", "")) for r in degraded)

@@ -229,10 +229,10 @@ def test_load_arrays_retry_recovers_truncated_payload(tmp_path):
 
 
 def test_load_arrays_many_caps_thread_pool(tmp_path, monkeypatch):
-    """The unbounded-executor fix: fan-in over many payloads uses at most
-    cpu_count workers (and still loads everything correctly)."""
-    import concurrent.futures as cf
-
+    """The unbounded-executor fix, updated for the shared module-level
+    pool (tier-5 satellite): fan-in over many payloads runs on ONE
+    lazily-built executor bounded at cpu_count workers — never a pool
+    sized to the payload count — and still loads everything correctly."""
     from coinstac_dinunet_tpu import native
 
     paths = []
@@ -240,18 +240,16 @@ def test_load_arrays_many_caps_thread_pool(tmp_path, monkeypatch):
         p = str(tmp_path / f"p{i}.npy")
         tensorutils.save_arrays(p, [np.full(4, i, np.float32)])
         paths.append(p)
-    seen = {}
-    real = cf.ThreadPoolExecutor
-
-    class Spy(real):
-        def __init__(self, max_workers=None, **kw):
-            seen["max_workers"] = max_workers
-            super().__init__(max_workers=max_workers, **kw)
-
-    monkeypatch.setattr(cf, "ThreadPoolExecutor", Spy)
     monkeypatch.setattr(native, "available", lambda: False)
-    out = tensorutils.load_arrays_many(paths)
-    assert seen["max_workers"] == min(33, os.cpu_count() or 8)
+    tensorutils.shutdown_fan_in_pool()
+    try:
+        out = tensorutils.load_arrays_many(paths)
+        pool = tensorutils.fan_in_pool()
+        # the cap is the host's core count, independent of payload count
+        assert pool._max_workers == (os.cpu_count() or 8)
+        assert tensorutils.fan_in_pool() is pool
+    finally:
+        tensorutils.shutdown_fan_in_pool()
     assert [int(o[0][0]) for o in out] == list(range(33))
 
 

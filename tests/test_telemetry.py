@@ -354,3 +354,57 @@ def test_cli_merges_and_exports(tmp_path, capsys):
     empty = tmp_path / "empty"
     empty.mkdir()
     assert main([str(empty)]) == 1
+
+
+# ------------------------------------------------- concurrency (ISSUE 13)
+def test_recorder_concurrent_emission_keeps_jsonl_whole(tmp_path):
+    """Tier-5 satellite: N threads emitting spans/events/metrics/counters
+    through ONE enabled Recorder while the wall-clock autoflush fires
+    (interval cranked down so it triggers constantly) and explicit
+    flushes race it — collect.read_jsonl_segment (the live tailer's
+    arbiter) must see zero torn/undecodable lines and no lost records."""
+    import threading
+
+    from coinstac_dinunet_tpu.telemetry.collect import read_jsonl_segment
+
+    cache = {"profile": True, "telemetry_flush_interval_s": 0.01}
+    rec = Recorder("site_0", cache=cache, out_dir=str(tmp_path))
+    n_threads, per_thread = 8, 200
+    start = threading.Barrier(n_threads)
+
+    def emit(tid):
+        start.wait()
+        for i in range(per_thread):
+            rec.event("conc:probe", cat="test", tid=tid, i=i)
+            rec.metric("conc_metric", float(i), site=f"site_{tid}")
+            with rec.span("conc:span", cat="test", tid=tid, i=i):
+                pass
+            rec.count("conc_counter")
+            if i % 50 == 0:
+                rec.flush()  # explicit flushes race the autoflush timer
+
+    threads = [threading.Thread(target=emit, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rec.flush()
+
+    records, _, bad, partial = read_jsonl_segment(rec.path())
+    assert bad == 0, f"{bad} undecodable JSONL line(s)"
+    assert not partial, "torn unterminated tail after final flush"
+    probes = {(r["tid"], r["i"]) for r in records
+              if r.get("kind") == "event" and r.get("name") == "conc:probe"}
+    assert len(probes) == n_threads * per_thread, "lost event records"
+    spans = [r for r in records
+             if r.get("kind") == "span" and r.get("name") == "conc:span"]
+    metrics = [r for r in records
+               if r.get("kind") == "metric" and r.get("name") == "conc_metric"]
+    assert len(spans) == n_threads * per_thread, "lost span records"
+    assert len(metrics) == n_threads * per_thread, "lost metric records"
+    counters = [r for r in records
+                if r.get("kind") == "counter" and r.get("name") == "conc_counter"]
+    assert sum(int(r["n"]) for r in counters) == n_threads * per_thread, (
+        "lost counter increments across concurrent flush drains"
+    )

@@ -207,3 +207,34 @@ def test_parse_shape_accepts_lists_and_comma_strings():
     assert parse_shape((8.0, 8.0)) == (8, 8)
     assert parse_shape(None, (32, 32, 32)) == (32, 32, 32)
     assert parse_shape(None) == ()
+
+
+def test_fan_in_pool_is_shared_bounded_and_torn_down(tmp_path):
+    """Tier-5 satellite: load_arrays_many reuses ONE bounded module-level
+    executor across calls (no per-call pool construction on the reduce
+    fan-in hot path) and shutdown_fan_in_pool() is the teardown hook —
+    the next call lazily rebuilds."""
+    from coinstac_dinunet_tpu.utils import tensorutils as tu
+    from coinstac_dinunet_tpu.utils.tensorutils import load_arrays_many
+
+    paths = []
+    for i in range(4):
+        p = tmp_path / f"payload_{i}.npy"
+        save_arrays(str(p), [np.full((3,), i, np.float32)])
+        paths.append(str(p))
+
+    tu.shutdown_fan_in_pool()
+    out1 = load_arrays_many(paths)
+    pool = tu.fan_in_pool()
+    assert pool._max_workers <= (os.cpu_count() or 8)
+    out2 = load_arrays_many(paths)
+    assert tu.fan_in_pool() is pool, "fan-in executor must be reused"
+    for i, arrs in enumerate(out2):
+        assert np.allclose(arrs[0], i)
+    assert len(out1) == len(out2) == 4
+
+    tu.shutdown_fan_in_pool()
+    assert tu._FAN_IN_POOL is None
+    out3 = load_arrays_many(paths)  # lazily rebuilt after teardown
+    assert len(out3) == 4 and np.allclose(out3[2][0], 2)
+    tu.shutdown_fan_in_pool()
