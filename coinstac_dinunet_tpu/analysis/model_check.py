@@ -73,6 +73,16 @@ FAULT_ALPHABET = (
     # refuse anything older.  Repeated firings age the stand-in past the
     # window, which is how the seeded k-violation reaches the boundary.
     "staleness_k",
+    # ISSUE 14: run-ahead pipelining — the site delivers a FRESH
+    # contribution computed against the broadcast its PREVIOUS output
+    # consumed (the engine re-submitted it while the reduce tail was
+    # still in flight on the reducer worker).  The wire_round echo lags
+    # by the pipeline depth and ages one more round per consecutive
+    # firing; the aggregator must ACCEPT it while the lag is at most
+    # k + d (the reducer's gamma**lag discount covers it — not modeled)
+    # and refuse anything deeper.  Scheduled only in scenarios whose
+    # run-ahead depth d is positive.
+    "run_ahead",
 )
 
 #: model action -> replayable chaos fault-plan kind (worker actions map to
@@ -100,6 +110,17 @@ _RESTART_REDELIVERS_LAST_OUTPUT = False
 #: with the flip, STALE_CONTRIBUTION fires with a replayable ``stale``
 #: chaos plan.
 _WINDOW_ACCEPTS_BEYOND_K = False
+
+#: broken-horizon semantics switch (tests only): a mis-implemented
+#: run-ahead window might accept a FRESH contribution whose broadcast lag
+#: exceeds ``k + d`` into the reduce — the broadcast-lag boundary the
+#: widened window patrols (``nodes/remote.py`` reads
+#: ``Federation.RUN_AHEAD`` into the window).  ``tests/test_async.py``
+#: flips this to prove the ``run_ahead`` action is checkable, not
+#: vacuous: with the real window a beyond-``k+d`` echo is refused loudly
+#: (clean); with the flip, STALE_CONTRIBUTION fires with a replayable
+#: ``stale`` chaos plan.
+_WINDOW_ACCEPTS_BEYOND_RUN_AHEAD = False
 
 #: broadcast-channel components a relay fault can target
 _COMPONENTS = ("payload", "manifest")
@@ -133,6 +154,9 @@ class ModelConfig:
     quorums: tuple = (None, 1)
     pretrain: tuple = (False, True)
     staleness: tuple = (0, ModelCheck.DEFAULT_STALENESS_K)
+    #: run-ahead depth dimension (ISSUE 14): d=0 is the blocking wire
+    #: tail; d>0 widens the window to k + d and schedules ``run_ahead``
+    run_ahead: tuple = (0, ModelCheck.DEFAULT_RUN_AHEAD)
 
     @property
     def engine_rounds(self):
@@ -215,9 +239,11 @@ def _plan_faults(trace, avg_file, manifest_file):
             # worker_kill fault at the matching kill point
             entry["kind"] = "worker_kill"
             entry["when"] = _WORKER_ACTIONS[kind]
-        elif kind == "staleness_k":
+        elif kind in ("staleness_k", "run_ahead"):
             # the executable counterpart is the engines' stale replay
             # fault: skip the invocation, redeliver the previous output
+            # (for run_ahead it approximates the lagging echo — the
+            # delayed-broadcast class the window refuses either way)
             entry["kind"] = "stale"
         elif kind in ("truncate_payload", "corrupt_payload"):
             entry["file"] = "grads.npy"
@@ -316,6 +342,7 @@ class _Explorer:
                 "site_quorum": scenario[0],
                 "pretrain": bool(scenario[1]),
                 "staleness_k": int(scenario[2]) if len(scenario) > 2 else 0,
+                "run_ahead": int(scenario[3]) if len(scenario) > 3 else 0,
                 "engine_rounds": self.config.engine_rounds,
             },
             "faults": _plan_faults(trace, "avg_grads.npy",
@@ -323,9 +350,11 @@ class _Explorer:
         }
         quorum = scenario[0]
         k = int(scenario[2]) if len(scenario) > 2 else 0
+        d_ra = int(scenario[3]) if len(scenario) > 3 else 0
         msg = (
             f"{message} — counterexample: site_quorum={quorum}, "
             f"pretrain={bool(scenario[1])}, staleness_k={k}, "
+            f"run_ahead={d_ra}, "
             f"faults=[{trace.describe()}] "
             f"(bound: {self.config.sites} sites x {self.config.rounds} "
             f"rounds, budget {self.config.max_faults}); replayable chaos "
@@ -527,7 +556,16 @@ class _Explorer:
 
         had_comp = had_comp or "computation" in executed
         contrib = rnd if "reduce" in produced else 0
-        out = (out_phase, frozenset(produced), contrib, True, rnd)
+        if "run_ahead" in my_faults and last is not None:
+            # run-ahead pipelining (ISSUE 14): the invocation ran in full
+            # and its contribution is FRESH (contrib == rnd), but it was
+            # computed against the broadcast the PREVIOUS output consumed
+            # — the echo stays pinned at the previous made-round and ages
+            # one more round per consecutive firing, which is how the
+            # seeded trace reaches the k + d boundary
+            out = (out_phase, frozenset(produced), contrib, False, last[4])
+        else:
+            out = (out_phase, frozenset(produced), contrib, True, rnd)
         site = (alive, redeliver, applied, cache, any_w, had_comp, out)
         return site, chan, out, None
 
@@ -589,6 +627,12 @@ class _Explorer:
             if facts.round_lockstep_guard and facts.round_lockstep_window
             else 0
         )
+        if (facts.round_lockstep_guard and facts.round_lockstep_window
+                and facts.round_lockstep_run_ahead and len(scenario) > 3):
+            # run-ahead pipelining widens the window to k + d — the
+            # broadcast-lag allowance the real guard reads from
+            # Federation.RUN_AHEAD (nodes/remote.py)
+            window += int(scenario[3])
         stale_in = {i for i in filtered if stale_flags.get(i)}
         if stale_in and facts.round_lockstep_guard:
             beyond = {
@@ -596,8 +640,28 @@ class _Explorer:
                 if rnd - (filtered[i][4] if len(filtered[i]) > 4 else rnd)
                 > window
             }
-            if beyond and not _WINDOW_ACCEPTS_BEYOND_K:
+            # a FRESH contribution with a lagging echo (contrib == rnd)
+            # is a run-ahead delivery; a stale redelivery carries an older
+            # contrib — each has its own broken-window test switch
+            ra_beyond = {i for i in beyond if filtered[i][2] == rnd}
+            stale_beyond = beyond - ra_beyond
+            if stale_beyond and not _WINDOW_ACCEPTS_BEYOND_K:
                 return remote, None, "stale round echo refused", False
+            if ra_beyond and not _WINDOW_ACCEPTS_BEYOND_RUN_AHEAD:
+                return remote, None, "stale round echo refused", False
+            if ra_beyond and _WINDOW_ACCEPTS_BEYOND_RUN_AHEAD:
+                for i in sorted(ra_beyond):
+                    lag = rnd - filtered[i][4]
+                    self._emit(
+                        ModelCheck.STALE_CONTRIBUTION,
+                        self._anchor("lockstep", self.ir.remote),
+                        f"the reduce consumes site_{i}'s fresh round-{rnd} "
+                        f"contribution computed {lag} broadcasts behind the "
+                        f"stamp — beyond the combined k + d window: the "
+                        "run-ahead horizon is unbounded and the staleness "
+                        "discount no longer covers the broadcast lag",
+                        scenario, trace, "bounded broadcast lag",
+                    )
 
         if phase not in self.ir.remote.tested_phases:
             fallthrough = self.ir.remote.phase_fallthrough
@@ -724,6 +788,15 @@ class _Explorer:
                     if kind == "staleness_k" and not scenario[2]:
                         continue
                     actions.append((kind, i))
+                elif kind == "run_ahead":
+                    # only in scenarios with a positive pipeline depth,
+                    # and only once the site has an output whose consumed
+                    # broadcast the echo can stay pinned at
+                    if site[6] is None:
+                        continue
+                    if len(scenario) < 4 or not scenario[3]:
+                        continue
+                    actions.append((kind, i))
                 else:
                     actions.append((kind, i))
         return sorted(actions)
@@ -809,7 +882,10 @@ class _Explorer:
         for quorum in self.config.quorums:
             for pretrain in self.config.pretrain:
                 for k in self.config.staleness:
-                    self._explore_scenario((quorum, pretrain, int(k)))
+                    for d_ra in self.config.run_ahead:
+                        self._explore_scenario(
+                            (quorum, pretrain, int(k), int(d_ra))
+                        )
         findings = [f for f, _ in self.findings.values()]
         plans = [p for _, p in self.findings.values()]
         order = sorted(

@@ -134,6 +134,11 @@ class SemanticFacts:
     # accepted instead of refused — the relaxation the staleness_k model
     # action exercises (ISSUE 12)
     round_lockstep_window: bool = True
+    # the window additionally honors the run-ahead pipelining depth
+    # (Federation.RUN_AHEAD): a FRESH contribution's echo may lag by up
+    # to k + d — the widening the run_ahead model action exercises
+    # (ISSUE 14)
+    round_lockstep_run_ahead: bool = True
     heal_bridges_manifest: bool = True
     anchors: dict = dataclasses.field(default_factory=dict)
 
@@ -411,6 +416,7 @@ def extract_remote_facts(remote_module, facts):
     # "async_staleness" inside the guard method is the marker)
     facts.round_lockstep_guard = False
     facts.round_lockstep_window = False
+    facts.round_lockstep_run_ahead = False
     for name in lockstep_names:
         body = methods.get(name)
         if body is None:
@@ -425,6 +431,8 @@ def extract_remote_facts(remote_module, facts):
                 facts.round_lockstep_guard = True
             if marker in ("ASYNC_STALENESS", "async_staleness"):
                 facts.round_lockstep_window = True
+            if marker in ("RUN_AHEAD", "run_ahead"):
+                facts.round_lockstep_run_ahead = True
     if snapshot_line is not None:
         facts.anchors["reduce_input"] = (remote_module.path, snapshot_line)
     if quorum_line is not None:

@@ -222,10 +222,10 @@ def _make_engine(workdir, config):
                 return
             super()._async_snapshot_payloads(s, out)
 
-        def _finish_site_outputs(self, rnd, site_outs, rec):
+        def _finish_site_outputs(self, rnd, site_outs, rec, record=True):
             if _DROP_COMMIT:
                 return  # broken semantics: the replay record loses them
-            super()._finish_site_outputs(rnd, site_outs, rec)
+            super()._finish_site_outputs(rnd, site_outs, rec, record=record)
 
         # ---- stub node invocations -----------------------------------
         def _site_attempt(self, rnd, s, inp, rec):

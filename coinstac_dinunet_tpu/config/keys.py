@@ -208,8 +208,16 @@ class Metric:
       engine at every delivery/stand-in and by the aggregator's window
       check; the live board/Prometheus per-site staleness gauge and the
       ``staleness_exceeded`` verdict read it.
+    - ``SITE_RUN_AHEAD`` — per-site run-ahead depth under the pipelined
+      async engine (``Federation.RUN_AHEAD``): 0 = the site's pending
+      invocation consumed the newest broadcast, ``j`` = it is computing
+      ``j`` broadcasts ahead of the last one it applied (the engine's
+      bounded-delay horizon).  Recorded at every re-submission; the live
+      board's run-ahead column and the Prometheus
+      ``site_run_ahead`` gauge read it.
     """
 
+    SITE_RUN_AHEAD = "site_run_ahead"
     GRAD_NORM = "grad_norm"
     GRAD_NORM_EMA = "grad_norm_ema"
     UPDATE_NORM = "update_norm"
@@ -345,6 +353,32 @@ class Federation:
       ``j`` rounds behind enters the participation-weighted mean at
       ``grad_weight * gamma**j``, composing with the survivor/nonfinite
       weighting.  Default 0.5.
+    - ``RUN_AHEAD`` — run-ahead pipelining depth ``d`` of the async round
+      engine (``engine.py::_step_round_async``; ISSUE 14).  ``0``/unset
+      keeps the PR-12 async schedule (the engine blocks on the
+      aggregator's reduce+relay tail every round); ``d >= 1`` decouples
+      compute from the wire: the reduce+relay runs on a dedicated
+      long-lived reducer worker while every site whose payload has
+      committed is immediately re-submitted — against the newest
+      unconsumed broadcast when one exists, else up to ``d`` rounds deep
+      against the last committed broadcast (the update keys stripped, so
+      no broadcast is ever applied twice).  The broadcast lag shows up as
+      the site's ``wire_round`` echo lag, so the aggregator's window
+      check widens from ``k`` to ``k + d``
+      (``nodes/remote.py::_check_lockstep_phases``) and the reducer's
+      ``gamma**lag`` staleness discount covers it with no new knob.
+      Confined to the COMPUTATION/TRAIN steady state: any barrier signal
+      drains the pipeline back to lockstep.  Clamped to 0 on the
+      in-process engine (``InProcessEngine._RUN_AHEAD_CAP`` — its nodes
+      share the process-global ambient telemetry stack); the
+      process-backed engines are the payoff.  Frozen into
+      ``shared_args`` so the aggregator sees the same horizon the engine
+      enforces.
+    - ``WIRE_MMAP`` — memory-map the aggregator fan-in's payload loads
+      (``parallel/reducer.py`` via ``tensorutils.load_arrays(mmap=)``):
+      the k-ary tree reduce streams partial sums from CRC-verified mapped
+      views instead of materializing a heap copy of every site payload.
+      Default ON for the reducer fan-in; set false to force heap reads.
     """
 
     REDUCE_FANIN = "reduce_fanin"
@@ -352,6 +386,8 @@ class Federation:
     ASYNC_STALENESS = "async_staleness"
     ASYNC_POOL = "async_invoke_pool"
     ASYNC_DISCOUNT = "async_stale_discount"
+    RUN_AHEAD = "run_ahead"
+    WIRE_MMAP = "wire_mmap"
 
 
 class Perf:
@@ -435,6 +471,13 @@ class Live:
       behind: the engine had to block on it (or it died — the evidence
       reuses the dead-site retry-exhaustion attribution), so the
       straggler is gating the federation again.
+    - ``VERDICT_PIPELINE`` — under run-ahead pipelining
+      (``Federation.RUN_AHEAD``) the reducer worker fell behind the
+      run-ahead horizon: a site exhausted its depth ``d`` and the engine
+      had to block on the oldest in-flight reduce (the engine's
+      ``pipeline:stall`` event), so the wire tail is gating compute
+      again.  Re-arms when a later round's reduce completes concurrently
+      with site compute.
 
     ``PROM_PREFIX`` is the stable prefix of every exported Prometheus
     metric name (``coinstac_dinunet_<series>``); renaming it breaks every
@@ -454,6 +497,7 @@ class Live:
     VERDICT_MFU_COLLAPSE = "mfu_collapse"
     VERDICT_RETRY_STORM = "wire_retry_storm"
     VERDICT_STALENESS = "staleness_exceeded"
+    VERDICT_PIPELINE = "pipeline_stall"
 
 
 class Daemon:
@@ -594,6 +638,12 @@ class ModelCheck:
     # runs at k=0 (exact stamp) AND k=DEFAULT_STALENESS_K (window stamp +
     # the staleness_k action) — the relaxed protocol is checked by default
     DEFAULT_STALENESS_K = 1
+    # run-ahead pipelining depth explored alongside the blocking wire
+    # tail: every scenario runs at d=0 AND d=DEFAULT_RUN_AHEAD, where a
+    # positive d widens the window to k + d and schedules the
+    # ``run_ahead`` action (a FRESH contribution whose wire_round echo
+    # lags by the pipeline depth)
+    DEFAULT_RUN_AHEAD = 1
 
     DEADLOCK = "proto-model-deadlock"
     PHASE_RESET = "proto-model-phase-reset"

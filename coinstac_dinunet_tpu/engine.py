@@ -26,7 +26,15 @@ import time
 import numpy as np
 
 from . import config, telemetry, utils
-from .config.keys import Federation, Key, Live, Metric, Mode, Phase
+from .config.keys import (
+    Federation,
+    Key,
+    Live,
+    Metric,
+    Mode,
+    Phase,
+    RemoteWire,
+)
 from .telemetry import capture as _capture
 from .data import COINNDataHandle
 from .nodes import COINNLocal, COINNRemote
@@ -44,6 +52,47 @@ class InvokeTimeout(RuntimeError):
     engine's ``timeout``.  Typed so the retry/quorum machinery and
     ``telemetry doctor`` can attribute the failure; the message carries the
     partial stderr the process wrote before it was killed."""
+
+
+#: test-only switch (ISSUE 14): force the run-ahead pipeline to drain its
+#: reducer worker inside every round, right after the reduce is submitted —
+#: every re-submission then sees the freshest broadcast and the schedule is
+#: exactly the d=0 async one.  ``tests/test_async.py`` flips this to pin
+#: that the pipeline machinery (reducer-worker offload, harvest, the
+#: ``.stale`` alias rewrite of fresh outputs) is semantically transparent:
+#: a d>=1 run under the switch must be score-identical to d=0 — which is
+#: exactly the drain contract a barrier round relies on.
+_PIPELINE_FORCE_DRAIN = False
+
+#: broadcast keys a run-ahead re-submission strips: each is one-shot
+#: round state (the update payload, barrier/broadcast side effects) that
+#: the site already consumed when it first received this broadcast —
+#: re-delivering them would double-apply the update.  Everything else
+#: (phase, global_modes, the wire_round stamp the lag accounting rides on)
+#: is carried verbatim.
+_RUN_AHEAD_STRIP = (
+    RemoteWire.UPDATE.value,
+    RemoteWire.AVG_GRADS_FILE.value,
+    RemoteWire.SAVE_CURRENT_AS_BEST.value,
+    RemoteWire.PRETRAINED_WEIGHTS.value,
+    RemoteWire.HEALTH.value,
+)
+
+#: broadcast keys that make a round ineligible for run-ahead: multi-
+#: invocation sync protocols (powerSGD's P/Q phases, rankDAD payloads) and
+#: run-level transitions count broadcasts exactly once by construction —
+#: the engine falls back to blocking on the reducer instead of running
+#: ahead of them.
+_RUN_AHEAD_BLOCKERS = (
+    RemoteWire.POWERSGD_PHASE.value,
+    RemoteWire.POWERSGD_P_FILE.value,
+    RemoteWire.POWERSGD_Q_FILE.value,
+    RemoteWire.RANK1_FILE.value,
+    RemoteWire.DAD_DATA_FILE.value,
+    RemoteWire.DAD_REST_FILE.value,
+    RemoteWire.GLOBAL_RUNS.value,
+    RemoteWire.RESULTS_ZIP.value,
+)
 
 
 def load_inputspec(path, site_index=None):
@@ -198,6 +247,21 @@ class InProcessEngine:
         self._async_pending = {}   # site -> (submit_round, future, policy)
         self._async_last_sub = {}  # site -> submit round of last fresh out
         self._async_snapshots = {}  # site -> {output file key -> snapshot}
+        # run-ahead pipelining state (ISSUE 14, Federation.RUN_AHEAD):
+        # the dedicated reducer worker + its in-flight reduce futures
+        # (FIFO; harvested opportunistically, drained at barriers), the
+        # broadcast stamp each site last CONSUMED (a full input re-delivers
+        # a broadcast exactly once — re-submitting the same stamp would
+        # double-apply its update, so it is stripped instead), the per-site
+        # run-ahead depth, round-tagged snapshot generations, and the set
+        # of sites delivered fresh this round (the re-submission roster)
+        self._reduce_pool = None
+        self._reduce_pending = []  # [(reduce_round, future, submit_t)]
+        self._async_consumed = {}  # site -> wire_round stamp last consumed
+        self._run_ahead_depth = {}  # site -> consecutive run-ahead submits
+        self._async_snap_gen = {}   # site -> snapshot generation counter
+        self._async_snap_files = {}  # site -> {gen: [alias paths]}
+        self._async_fresh = set()
         # per-site recent invoke wall-times (grace basis).  The FIRST
         # completed invocation per site is dropped: it carries the one-off
         # cold start (worker spawn, imports, first compiles) and would
@@ -359,17 +423,24 @@ class InProcessEngine:
             return None
         return dict(prev)
 
-    def _finish_site_outputs(self, rnd, site_outs, rec):
+    def _finish_site_outputs(self, rnd, site_outs, rec, record=True):
         """Round barrier after the site loop, shared by both engines (the
         ordering is load-bearing and must not diverge between them):
         record every fresh output for future replay faults FIRST, then
         deliver the stale last output of sites whose ``reappear`` fault
         died one round earlier — the dropped-site-reappears scenario the
         aggregator's roster filtering must reject
-        (``COINNRemote._check_quorum``)."""
-        self._last_site_outs.update(
-            {s: dict(o) for s, o in site_outs.items()}
-        )
+        (``COINNRemote._check_quorum``).
+
+        ``record=False`` (the run-ahead pipelined reduce, which runs this
+        from the reducer worker thread) skips the replay-record update:
+        the engine thread already recorded each fresh output at delivery,
+        and a deferred reduce writing the table later could regress it
+        below a newer delivery."""
+        if record:
+            self._last_site_outs.update(
+                {s: dict(o) for s, o in site_outs.items()}
+            )
         if not self.chaos.enabled:
             return
         for s in self.chaos.reappear_deliveries(rnd, rec):
@@ -451,12 +522,14 @@ class InProcessEngine:
 
     def _remote_attempt(self, rnd, site_outs, rec):
         """ONE aggregator invocation attempt; returns its output dict and
-        records ``success``."""
+        records ``success``.  Round pinned as a span attr: under run-ahead
+        pipelining this runs on the reducer worker thread one round behind
+        the engine's ambient round context."""
         self.chaos.invoke_fault(rnd, "remote", rec)
         remote = COINNRemote(
             cache=self.remote_cache, input=site_outs, state=self.remote_state,
         )
-        with rec.span("invoke:remote", cat="invoke"):
+        with rec.span("invoke:remote", cat="invoke", round=rnd):
             result = remote(
                 trainer_cls=self.remote_trainer_cls,
                 reducer_cls=self.reducer_cls,
@@ -464,12 +537,14 @@ class InProcessEngine:
         self.success = bool(result.get("success"))
         return result["output"]
 
-    def _remote_and_relay(self, rnd, site_outs, rec):
+    def _remote_and_relay(self, rnd, site_outs, rec, record_outs=True):
         """The round's wire half, shared by the lockstep and async paths:
         replay-fault bookkeeping barrier, aggregator invocation (under its
         retry policy), and the broadcast relay.  Returns the aggregator's
-        output dict."""
-        self._finish_site_outputs(rnd, site_outs, rec)
+        output dict.  The run-ahead pipeline runs this whole tail on the
+        dedicated reducer worker (``record_outs=False`` — the replay
+        record was already written at delivery on the engine thread)."""
+        self._finish_site_outputs(rnd, site_outs, rec, record=record_outs)
         if not site_outs:
             raise RuntimeError(
                 "every site died; nothing to aggregate — failures: "
@@ -484,7 +559,7 @@ class InProcessEngine:
         rec.event(Live.HEARTBEAT, cat="engine", site="remote")
         self.last_remote_out = remote_out
 
-        with rec.span("engine:relay", cat="relay"):
+        with rec.span("engine:relay", cat="relay", round=rnd):
             self._relay_broadcast(rnd, rec)
         return remote_out
 
@@ -564,27 +639,67 @@ class InProcessEngine:
     #: threads only do pipe/process I/O)
     _ASYNC_POOL_CAP = 1
 
+    #: run-ahead depth ceiling (ISSUE 14): the in-process engine pins 0 —
+    #: its aggregator node activates the process-global ambient telemetry
+    #: stack, so the reduce tail cannot leave the engine thread; the
+    #: process-backed engines (where the reduce is a pipe request to the
+    #: warm aggregator worker) lift the cap
+    _RUN_AHEAD_CAP = 0
+
     def _async_config(self):
         """Resolve the async round configuration once per engine, over the
         same arg channels as the quorum/retry knobs (``_target_config``):
-        async mode is ON when either ``Federation`` key is configured
+        async mode is ON when any ``Federation`` async key is configured
         anywhere; ``k=0`` with pool 1 runs the async path in strict serial
         order (score-identical to the lockstep template — the parity
-        contract of ``tests/test_async.py``)."""
+        contract of ``tests/test_async.py``).  ``run_ahead=0`` keeps the
+        blocking wire tail bit-identical to the PR-12 schedule; ``d >= 1``
+        (process-backed engines) decouples it onto the reducer worker."""
         if self._async_cfg is not None:
             return self._async_cfg
         cfg = self._target_config("remote")
         k_raw = cfg.get(Federation.ASYNC_STALENESS)
         pool_raw = cfg.get(Federation.ASYNC_POOL)
-        enabled = k_raw is not None or pool_raw is not None
+        ra_raw = cfg.get(Federation.RUN_AHEAD)
+        enabled = (
+            k_raw is not None or pool_raw is not None or ra_raw is not None
+        )
         k = max(int(k_raw or 0), 0)
+        d = max(int(ra_raw or 0), 0)
+        if self._RUN_AHEAD_CAP is not None and d > self._RUN_AHEAD_CAP:
+            # the aggregator's k + d window must mirror the horizon this
+            # engine actually ENFORCES, not the raw configuration: clamp
+            # the depth on every arg channel this engine feeds its nodes
+            # from (resolved before any invocation, so the first round
+            # freezes the clamped value into shared_args) — otherwise an
+            # in-process run with run_ahead=1 would widen the refusal
+            # boundary for a staleness its engine can never produce
+            d = self._RUN_AHEAD_CAP
+            for chan in (self.args, *self.site_args.values(),
+                         *self.site_spec.values()):
+                if not isinstance(chan, dict):
+                    continue
+                if Federation.RUN_AHEAD in chan:
+                    chan[Federation.RUN_AHEAD] = d
+                for kk, vv in chan.items():
+                    if (isinstance(vv, dict) and str(kk).endswith("_args")
+                            and Federation.RUN_AHEAD in vv):
+                        vv[Federation.RUN_AHEAD] = d
+            logger.warn(
+                f"run_ahead={int(ra_raw or 0)} clamped to {d} on this "
+                "engine (the in-process aggregator shares the ambient "
+                "telemetry stack; run-ahead needs a process-backed "
+                "engine) — the clamped depth is what shared_args freeze"
+            )
         if pool_raw is not None:
             pool = max(int(pool_raw), 1)
         else:
             pool = self.n_sites if enabled else 1
         if self._ASYNC_POOL_CAP is not None:
             pool = min(pool, self._ASYNC_POOL_CAP)
-        self._async_cfg = {"enabled": bool(enabled), "k": k, "pool": pool}
+        self._async_cfg = {
+            "enabled": bool(enabled), "k": k, "pool": pool, "run_ahead": d,
+        }
         return self._async_cfg
 
     def _ensure_async_pool(self, size):
@@ -595,6 +710,21 @@ class InProcessEngine:
                 max_workers=int(size), thread_name_prefix="coinn-async"
             )
         return self._async_pool
+
+    def _ensure_reduce_pool(self):
+        """The dedicated long-lived reducer worker (ISSUE 14): ONE thread
+        that serializes the aggregator's reduce+relay tails in submission
+        order while the engine thread keeps collecting and re-submitting
+        site invocations.  For the daemon engine this thread only drives
+        the frame pipe — the k-ary tree reduce itself streams inside the
+        warm aggregator worker process."""
+        if self._reduce_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._reduce_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="coinn-reducer"
+            )
+        return self._reduce_pool
 
     #: collect-phase grace: a round waits up to this multiple of the
     #: federation's TYPICAL invoke duration (median of per-site EMAs) for
@@ -630,13 +760,24 @@ class InProcessEngine:
 
     def _async_grace(self):
         """Seconds the collect phase waits for in-flight invocations: the
-        grace factor times the cross-site median of each site's recent
-        median invoke time — a double median, so neither one straggler nor
-        one outlier sample can inflate everyone's wait.  None before any
-        warm invocation completed (warm-up rounds block anyway)."""
+        grace factor times the cross-site median of each site's BEST
+        recent invoke time.  The cross-site median keeps one straggler
+        from inflating everyone's wait (its own slow samples only shape
+        its own series); the within-site minimum estimates the site's
+        UNCONTENDED compute — which is what the grace wants to measure.
+        A site's recent-median would ratchet up under transient
+        contention (under run-ahead pipelining the reduce tail overlaps
+        site compute, and a handful of contended samples in every site's
+        window once inflated the grace until the straggler never missed
+        collect and the stand-in machinery silently disarmed — each round
+        then paid the full straggler latency again); the best-recent
+        basis washes a spike out with the first clean sample, while a
+        sustained slowdown still raises every site's floor and keeps the
+        wait adapting to genuine load.  None before any warm invocation
+        completed (warm-up rounds block anyway)."""
         with self._async_hist_lock:
             per_site = [
-                statistics.median(hist)
+                min(hist)
                 for hist in self._async_invoke_hist.values() if hist
             ]
         if not per_site:
@@ -690,14 +831,23 @@ class InProcessEngine:
             return
         site_outs[s] = out
         self._async_last_sub[s] = q
+        self._async_fresh.add(s)
         rec.event(Live.HEARTBEAT, cat="engine", site=s)
         if self._async_cfg and self._async_cfg["k"]:
             rec.metric(Metric.SITE_STALENESS, float(rnd - q), site=s)
         self.chaos.payload_faults(
             rnd, s, self.site_states[s]["transferDirectory"], rec
         )
-        if self._async_cfg and self._async_cfg["k"]:
+        if self._async_cfg and (
+            self._async_cfg["k"] or self._async_cfg["run_ahead"]
+        ):
             self._async_snapshot_payloads(s, out)
+        if self._async_cfg and self._async_cfg["run_ahead"]:
+            # the replay/stand-in record commits at delivery, on the
+            # ENGINE thread: the deferred reduce job skips it
+            # (_finish_site_outputs record=False), so a reduce harvested
+            # late can never regress the table below a newer delivery
+            self._last_site_outs[s] = dict(out)
 
     def _async_snapshot_payloads(self, s, out):
         """Freeze a fresh contribution's payload files under stable
@@ -709,9 +859,22 @@ class InProcessEngine:
         mismatch → retry backoff on the round's critical path).  Alias
         copies carry the embedded v2 checksum and sit outside the
         directory manifest — 'no expectation', exactly like a not-yet-
-        relayed file."""
+        relayed file.
+
+        Under run-ahead pipelining the aliases are GENERATION-tagged
+        (``<name>.stale<g>``): the reduce consuming round r's alias may
+        still be in flight on the reducer worker when round r+1's fresh
+        delivery snapshots — an untagged alias would be overwritten under
+        the mid-reduce read.  Generations older than the combined
+        ``k + d`` horizon (plus slack) can no longer be referenced by any
+        in-flight reduce or stand-in and are pruned."""
         xfer = self.site_states[s]["transferDirectory"]
-        snaps = {}
+        d = (self._async_cfg or {}).get("run_ahead", 0)
+        gen = None
+        if d:
+            gen = self._async_snap_gen.get(s, 0) + 1
+            self._async_snap_gen[s] = gen
+        snaps, paths = {}, []
         for key, val in out.items():
             if not (isinstance(key, str) and key.endswith("_file")):
                 continue
@@ -720,35 +883,224 @@ class InProcessEngine:
             src = os.path.join(xfer, val)
             if not os.path.exists(src):
                 continue
-            alias = f"{val}.stale"
+            alias = f"{val}.stale" if gen is None else f"{val}.stale{gen}"
             wire_transport.atomic_copy(src, os.path.join(xfer, alias))
             snaps[key] = alias
+            paths.append(os.path.join(xfer, alias))
         self._async_snapshots[s] = snaps
+        if gen is not None:
+            files = self._async_snap_files.setdefault(s, {})
+            files[gen] = paths
+            horizon = self._async_cfg["k"] + d + 2
+            for old in [g for g in files if g <= gen - horizon]:
+                for p in files.pop(old):
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
 
-    def _async_standin_out(self, s):
-        """The stand-in output dict for a straggling site: its last
-        contribution with every payload reference rewritten to the frozen
-        ``.stale`` alias (see :meth:`_async_snapshot_payloads`)."""
-        out = dict(self._last_site_outs[s])
+    def _async_alias_out(self, s, out):
+        """``out`` with every payload reference rewritten to the frozen
+        alias of site ``s``'s last snapshot (idempotent — an already-
+        aliased reference maps to itself)."""
+        out = dict(out)
         for key, alias in self._async_snapshots.get(s, {}).items():
             if key in out:
                 out[key] = alias
         return out
 
+    def _async_standin_out(self, s):
+        """The stand-in output dict for a straggling site: its last
+        contribution with every payload reference rewritten to the frozen
+        ``.stale`` alias (see :meth:`_async_snapshot_payloads`)."""
+        return self._async_alias_out(s, self._last_site_outs[s])
+
+    # --------------------------------------------- run-ahead pipeline (ISSUE 14)
+    # Federation.RUN_AHEAD = d >= 1 decouples compute from the wire: the
+    # reduce+relay tail of round r runs on the dedicated reducer worker
+    # (:meth:`_ensure_reduce_pool`) while every site whose round-r payload
+    # committed is immediately re-submitted — with the newest unconsumed
+    # broadcast when one has been harvested, else (up to d deep) against
+    # the last committed broadcast with the one-shot update keys stripped,
+    # so no broadcast is ever applied twice.  The broadcast lag surfaces
+    # as the site's wire_round echo lag, bounded by d; the aggregator's
+    # window check accepts k + d and the reducer's gamma**lag discount
+    # covers it (nodes/remote.py, parallel/reducer.py).  Barriers and any
+    # non-steady round drain the pipeline and run the inline d=0 tail.
+
+    def _pipeline_input(self, s):
+        """The full input for site ``s`` when a broadcast it has not yet
+        consumed is available (records the consumption and resets the
+        run-ahead depth); None when the newest harvested broadcast was
+        already delivered to this site."""
+        cur = self.site_inputs.get(s) or {}
+        stamp = cur.get(RemoteWire.ROUND.value)
+        if stamp is not None and stamp == self._async_consumed.get(s):
+            return None
+        if stamp is not None:
+            self._async_consumed[s] = stamp
+        self._run_ahead_depth[s] = 0
+        return self._site_input(s)
+
+    def _run_ahead_eligible(self, inp):
+        """True when the broadcast is a plain steady-state dSGD update the
+        site may compute ahead of; multi-invocation sync protocols and
+        run-level transitions block run-ahead (the engine waits on the
+        reducer instead)."""
+        return bool(inp.get(RemoteWire.UPDATE.value)) and not any(
+            key in inp for key in _RUN_AHEAD_BLOCKERS
+        )
+
+    def _run_ahead_strip(self, inp):
+        return {k: v for k, v in inp.items() if k not in _RUN_AHEAD_STRIP}
+
+    def _reduce_job(self, rnd, site_outs, rec):
+        """The reducer worker's unit of work: one round's whole wire tail.
+        Samples whether site invocations were in flight while it ran (at
+        entry AND at exit — the engine re-submits sites right after
+        handing the job over, so the overlap usually begins mid-job) for
+        the ``pipeline:reduce_concurrent`` telemetry counter."""
+        pending = bool(self._async_pending)
+        t0 = time.monotonic()
+        out = self._remote_and_relay(rnd, site_outs, rec, record_outs=False)
+        pending = pending or bool(self._async_pending)
+        return out, time.monotonic() - t0, pending
+
+    def _pipeline_submit_reduce(self, rnd, site_outs, rec):
+        fut = self._ensure_reduce_pool().submit(
+            self._reduce_job, rnd, dict(site_outs), rec
+        )
+        self._reduce_pending.append((rnd, fut, time.monotonic()))
+
+    def _pipeline_harvest(self, rec, stall_site=None):
+        """Harvest the OLDEST in-flight reduce (blocking if it has not
+        finished), apply its broadcast to ``site_inputs``, and land the
+        pipeline telemetry.  ``stall_site`` marks a forced harvest — a
+        site exhausted its run-ahead horizon and the engine must block on
+        the reducer worker (the ``pipeline:stall`` event the live plane's
+        ``pipeline_stall`` verdict reads)."""
+        if not self._reduce_pending:
+            return None
+        red_rnd, fut, _t_sub = self._reduce_pending.pop(0)
+        blocked = not fut.done()
+        t0 = time.monotonic()
+        remote_out, dur, pending_at_start = fut.result()
+        if blocked and stall_site is not None:
+            rec.event(
+                "pipeline:stall", cat="async", site=stall_site,
+                reduce_round=red_rnd,
+                waited_s=round(time.monotonic() - t0, 4),
+                d=(self._async_cfg or {}).get("run_ahead", 0),
+            )
+        if pending_at_start and dur > 0:
+            # seconds the reduce+relay tail ran while at least one site
+            # invocation was in flight — the decoupling win, measurable
+            rec.event(
+                "pipeline:reduce_concurrent", cat="async",
+                reduce_round=red_rnd, secs=round(dur, 4),
+            )
+        self.last_remote_out = remote_out
+        self.site_inputs = {
+            s: dict(remote_out) for s in self._alive_site_ids()
+        }
+        return remote_out
+
+    def _pipeline_poll(self, rec):
+        """Harvest every COMPLETED in-flight reduce, oldest first (non-
+        blocking) — idle sites must only ever be handed the newest
+        harvested broadcast."""
+        out = None
+        while self._reduce_pending and self._reduce_pending[0][1].done():
+            out = self._pipeline_harvest(rec)
+        return out
+
+    def _pipeline_drain(self, rec, reason=None):
+        """Block until every in-flight reduce has been harvested — the
+        barrier contract: from here on the round runs the exact inline
+        (d=0) schedule."""
+        if not self._reduce_pending:
+            return None
+        n = len(self._reduce_pending)
+        out = None
+        while self._reduce_pending:
+            out = self._pipeline_harvest(rec)
+        if reason:
+            rec.event("pipeline:drain", cat="async", reason=str(reason),
+                      pending=n)
+        return out
+
+    def _pipeline_resubmit(self, rnd, s, rec, d):
+        """Re-submit a site whose round-``rnd`` payload just committed:
+        full input when an unconsumed broadcast exists, a depth-bounded
+        run-ahead submission otherwise; depth exhaustion blocks on the
+        reducer worker (stall) instead of running further ahead."""
+        inp = self._pipeline_input(s)
+        if inp is None:
+            depth = self._run_ahead_depth.get(s, 0)
+            base = self.site_inputs.get(s) or {}
+            if depth >= d or not self._run_ahead_eligible(base):
+                self._pipeline_harvest(rec, stall_site=s)
+                inp = self._pipeline_input(s)
+                if inp is None:
+                    return  # no broadcast even after the harvest: stay idle
+            else:
+                self._run_ahead_depth[s] = depth + 1
+                inp = self._run_ahead_strip(base)
+                rec.event("async:run_ahead", cat="async", site=s,
+                          depth=depth + 1, d=d)
+        rec.metric(Metric.SITE_RUN_AHEAD,
+                   float(self._run_ahead_depth.get(s, 0)), site=s)
+        policy = self._invoke_policy(s)
+        fut = self._async_pool.submit(
+            self._async_attempt, policy, rnd + 1, s, inp, rec
+        )
+        self._async_pending[s] = (rnd + 1, fut, policy)
+
+    def _pipeline_round(self, rnd, site_outs, rec, d):
+        """The steady-state pipelined wire tail: freeze this round's fresh
+        payloads behind their aliases, hand the reduce+relay to the
+        reducer worker, then immediately re-submit every delivered site —
+        compute for round ``rnd + 1`` overlaps the round-``rnd`` wire."""
+        for s in sorted(site_outs):
+            # a re-submitted site's next commit overwrites the live payload
+            # names at an arbitrary moment while the deferred reduce reads
+            # them — the reduce must consume the frozen generation-tagged
+            # aliases instead.  EVERY delivered out is rewritten, not just
+            # this round's fresh set: a chaos replay/stand-in redelivers
+            # the last output, whose live names the site's next invocation
+            # clobbers just the same (idempotent for already-aliased refs;
+            # a no-op for sites with no snapshot yet)
+            site_outs[s] = self._async_alias_out(s, site_outs[s])
+        self._pipeline_submit_reduce(rnd, site_outs, rec)
+        if _PIPELINE_FORCE_DRAIN:
+            self._pipeline_drain(rec, reason="forced")
+        self._pipeline_poll(rec)
+        for s in sorted(self._async_fresh):
+            if s in self.dead_sites or s in self._async_pending:
+                continue
+            self._pipeline_resubmit(rnd, s, rec, d)
+
     def _step_round_async(self, ac):
         """One engine round of the async mode: submit every idle site to
         the bounded pool, collect completed invocations, let in-window
         stragglers be represented by their last contribution, then run the
-        shared remote+relay tail while the stragglers keep computing."""
+        shared remote+relay tail while the stragglers keep computing.
+        With run-ahead configured (``ac['run_ahead'] >= 1``) the wire tail
+        is pipelined instead (:meth:`_pipeline_round`)."""
         rec = self._recorder()
         rnd = self.rounds + 1
         rec.set_context(round=rnd)
-        k = ac["k"]
+        k, d = ac["k"], ac["run_ahead"]
         site_outs = {}
+        self._async_fresh = set()
         with self.chaos.activate(rec), rec.span(
             "engine:round", cat="engine", mode="async"
         ):
             pool = self._ensure_async_pool(ac["pool"])
+            if d:
+                # harvest completed reduces first: an idle site must never
+                # be handed a broadcast it already consumed
+                self._pipeline_poll(rec)
             # ---- submit: every alive site without a pending invocation
             # computes this round, against the latest broadcast
             for s in self._alive_site_ids():
@@ -758,8 +1110,19 @@ class InProcessEngine:
                 if replay is not None:
                     site_outs[s] = replay
                     continue
+                if d:
+                    inp = self._pipeline_input(s)
+                    if inp is None:
+                        # the newest harvested broadcast was already
+                        # consumed (a round that could not run ahead):
+                        # the reducer worker is behind — block on it
+                        self._pipeline_harvest(rec, stall_site=s)
+                        inp = self._pipeline_input(s)
+                    if inp is None:
+                        inp = self._site_input(s)  # first rounds: no stamp
+                else:
+                    inp = self._site_input(s)
                 policy = self._invoke_policy(s)
-                inp = self._site_input(s)
                 fut = pool.submit(
                     self._async_attempt, policy, rnd, s, inp, rec
                 )
@@ -769,7 +1132,16 @@ class InProcessEngine:
             # first (a healthy site's fresh contribution beats its
             # stand-in; a straggler's older pending would eat the full
             # timeout every round), then deliver what completed — the
-            # completed phases/modes decide whether stand-ins are allowed
+            # completed phases/modes decide whether stand-ins are allowed.
+            # The grace is ANCHORED at the round's fastest fresh
+            # completion, not at collect entry: the peers define the
+            # round's baseline, so a straggler is "this factor behind its
+            # peers THIS round" regardless of how contention (the
+            # pipelined reduce overlapping compute, a loaded host) shifts
+            # everyone's absolute latency — an entry-anchored window
+            # either expired before any healthy site landed (an all-
+            # blocking round) or stretched until the straggler landed too
+            # (the stand-in machinery silently disarmed)
             fresh_futs = [
                 pend[1] for s in self._alive_site_ids()
                 for pend in (self._async_pending.get(s),)
@@ -778,9 +1150,12 @@ class InProcessEngine:
             if fresh_futs and not all(f.done() for f in fresh_futs):
                 grace = self._async_grace()
                 if grace:
+                    from concurrent.futures import FIRST_COMPLETED
                     from concurrent.futures import wait as _futures_wait
 
-                    _futures_wait(fresh_futs, timeout=grace)
+                    _futures_wait(fresh_futs, return_when=FIRST_COMPLETED)
+                    if not all(f.done() for f in fresh_futs):
+                        _futures_wait(fresh_futs, timeout=grace)
             waiting = []
             for s in self._alive_site_ids():
                 if s not in self._async_pending:
@@ -811,22 +1186,50 @@ class InProcessEngine:
                     rec.metric(Metric.SITE_STALENESS, float(lag), site=s)
                 self._async_deliver(rnd, s, rec, site_outs)
 
-            remote_out = self._remote_and_relay(rnd, site_outs, rec)
+            # the pipeline decision re-judges steadiness over the COMPLETE
+            # delivered set: ``steady`` above was computed on the fresh-
+            # only outs to gate stand-ins, so a round where every site
+            # merely missed the grace window (empty fresh set, no barrier
+            # signal anywhere) would otherwise drain the pipeline into a
+            # needless lockstep round
+            pipelined = (
+                bool(d) and bool(self._async_fresh)
+                and self._async_steady(site_outs)
+            )
+            if pipelined:
+                self._pipeline_round(rnd, site_outs, rec, d)
+                remote_out = dict(self.last_remote_out)
+            else:
+                if d:
+                    # any barrier/transition signal drains the pipeline:
+                    # the round below runs the exact inline (d=0) tail
+                    self._pipeline_drain(rec, reason="barrier")
+                remote_out = self._remote_and_relay(rnd, site_outs, rec)
         rec.flush()
-        self.site_inputs = {s: dict(remote_out) for s in self._alive_site_ids()}
+        if not pipelined:
+            self.site_inputs = {
+                s: dict(remote_out) for s in self._alive_site_ids()
+            }
         self.rounds += 1
         return site_outs, remote_out
 
     def close(self):
         """Release engine resources: the async invocation pool (pending
-        futures cancelled; running ones finish or fail on their own).  The
-        lockstep path never builds one, so this is a no-op there."""
+        futures cancelled; running ones finish or fail on their own) and
+        the run-ahead reducer worker (in-flight reduces abandoned).  The
+        lockstep path never builds either, so this is a no-op there."""
         pool, self._async_pool = self._async_pool, None
         if pool is not None:
             for _q, fut, _p in self._async_pending.values():
                 fut.cancel()
             pool.shutdown(wait=False, cancel_futures=True)
         self._async_pending = {}
+        rpool, self._reduce_pool = self._reduce_pool, None
+        if rpool is not None:
+            for _rnd, fut, _t in self._reduce_pending:
+                fut.cancel()
+            rpool.shutdown(wait=False, cancel_futures=True)
+        self._reduce_pending = []
 
     def run(self, max_rounds=100000, verbose=False):
         """Drive rounds until the aggregator reports SUCCESS."""
@@ -861,6 +1264,9 @@ class SubprocessEngine(InProcessEngine):
     #: process-backed nodes: the pool threads only do process spawn + pipe
     #: I/O, so concurrent site invocations are real concurrency — no cap
     _ASYNC_POOL_CAP = None
+    #: …and the reduce tail is a pipe/process request too, so the reducer
+    #: worker genuinely overlaps site compute — run-ahead uncapped
+    _RUN_AHEAD_CAP = None
 
     def __init__(self, workdir, n_sites, local_script, remote_script,
                  first_input=None, env=None, timeout=600, **kw):
@@ -962,9 +1368,11 @@ class SubprocessEngine(InProcessEngine):
     def _remote_attempt(self, rnd, site_outs, rec):
         # fresh-process nodes load payloads OUTSIDE this process, so a
         # corrupt payload fails the whole invocation: the retry (which
-        # first heals pending chaos damage) is the recovery
+        # first heals pending chaos damage) is the recovery.  Round pinned
+        # as a span attr: the run-ahead reducer worker runs this one round
+        # behind the engine's ambient round context.
         self.chaos.invoke_fault(rnd, "remote", rec)
-        with rec.span("invoke:remote", cat="invoke"):
+        with rec.span("invoke:remote", cat="invoke", round=rnd):
             res = self._invoke(self.remote_script, {
                 "cache": self.remote_cache, "input": site_outs,
                 "state": self.remote_state,

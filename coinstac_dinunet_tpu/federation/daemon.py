@@ -26,6 +26,19 @@ The worker's fd 1 is reserved for frames at startup (stray ``print`` from
 node code is rerouted to stderr, which lands in the per-worker log under
 ``<workdir>/daemon_logs/``).
 
+Steady-state frames skip the same-host copy tax (ISSUE 14): the worker's
+ready frame advertises ``delta: true``, after which (a) the engine OMITS
+the inbound JSON cache once it has confirmed the worker warm at the
+current generation (the worker owns the live cache and ignored the copy
+anyway), and (b) warm responses replace the full ``"cache"`` re-dump with
+``"cache_delta": {"set": {...}, "del": [...]}`` — the dirty keys since
+the last shipped cache — which the engine folds into its mirror so every
+caller still sees the full JSON cache.  A restarted worker always drops
+back to full-cache frames (exactly what it resumes from).  Frames are not
+key-sorted (determinism belongs in tests, not the steady-state pipe), and
+every invocation lands a ``daemon:frame`` event with its tx/rx byte
+counts so the delta win is measurable on the live plane.
+
 Supervision (the part that makes a long-lived process deployable): a
 crashed or wedged worker is killed and **restarted** — not declared a dead
 site — under :meth:`~..resilience.retry.RetryPolicy.for_worker`
@@ -90,12 +103,19 @@ class WorkerTimeout(WorkerUnavailable):
 
 # ------------------------------------------------------------------ framing
 def write_frame(stream, obj):
-    """One length-prefixed JSON frame; flushes (the peer blocks on it)."""
-    data = json.dumps(obj, sort_keys=True).encode("utf-8")
+    """One length-prefixed JSON frame; flushes (the peer blocks on it).
+    Returns the frame size in bytes (the hot-path wire-telemetry counter).
+
+    No ``sort_keys``: key order is not part of the frame contract (the
+    peer decodes to a dict), and sorting every per-invoke frame taxes the
+    steady-state pipe for a determinism only tests want — a test that
+    needs canonical bytes sorts its own ``json.dumps``."""
+    data = json.dumps(obj).encode("utf-8")
     stream.write(MAGIC + b" %d\n" % len(data))
     stream.write(data)
     stream.write(b"\n")
     stream.flush()
+    return len(MAGIC) + len(data) + len(b" %d\n" % len(data)) + 1
 
 
 def read_frame(stream):
@@ -129,6 +149,9 @@ class _FrameReader:
     def __init__(self, stream):
         self._fd = stream.fileno()
         self._buf = b""
+        #: cumulative frame bytes consumed — the engine samples it around
+        #: each request for the per-invoke wire telemetry
+        self.bytes_read = 0
 
     def _parse(self):
         """(frame, consumed) — frame is None while incomplete."""
@@ -147,6 +170,7 @@ class _FrameReader:
             return None
         data = self._buf[nl + 1:nl + 1 + n]
         self._buf = self._buf[end:]
+        self.bytes_read += end
         return json.loads(data.decode("utf-8"))
 
     def read_frame(self, timeout):
@@ -224,7 +248,12 @@ def worker_main(argv=None):
         write_frame(out, {"ok": False, "op": "ready",
                           "error": traceback.format_exc()[-2000:]})
         return 2
-    write_frame(out, {"ok": True, "op": "ready", "pid": os.getpid()})
+    # ``delta: True`` advertises the dirty-key cache protocol: a warm
+    # engine may omit the inbound JSON cache (this worker owns the live
+    # one), and warm responses carry a ``cache_delta`` of changed/removed
+    # keys instead of re-serializing the full JSON cache every invocation
+    write_frame(out, {"ok": True, "op": "ready", "pid": os.getpid(),
+                      "delta": True})
 
     stdin = sys.stdin.buffer
     # the warm heart of the daemon: the live cache dict (holding the
@@ -233,6 +262,12 @@ def worker_main(argv=None):
     # engine's JSON copy is only the durable fallback a RESTARTED worker
     # rebuilds from (via persist_round_state)
     live_cache = None
+    # the JSON-clean cache this worker last shipped (and the engine
+    # acknowledged by not restarting us): the base the next response's
+    # dirty-key delta is computed against.  Only updates when a cache
+    # actually ships — a node-error response carries none, so the
+    # engine's copy and this base stay in lockstep.
+    last_clean_cache = None
     while True:
         msg = read_frame(stdin)  # ValueError on desync: die; be restarted
         if msg is None or msg.get("op") == "shutdown":
@@ -252,10 +287,30 @@ def worker_main(argv=None):
         try:
             result = compute(payload)
             live_cache = payload["cache"]
-            write_frame(out, {
+            resp = {
                 "ok": True, "pid": os.getpid(), "warm": warm,
                 "result": utils.clean_recursive(result),
-            })
+            }
+            clean = resp["result"]
+            cc = clean.get("cache") if isinstance(clean, dict) else None
+            if isinstance(cc, dict):
+                if isinstance(last_clean_cache, dict):
+                    # dirty-key delta vs the last shipped cache: the
+                    # steady state re-serializes only what changed (the
+                    # logs that grew, the cursor) instead of the whole
+                    # cache — the same-host copy-tax teardown of ISSUE 14
+                    changed = {
+                        k: v for k, v in cc.items()
+                        if k not in last_clean_cache
+                        or last_clean_cache[k] != v
+                    }
+                    removed = [k for k in last_clean_cache if k not in cc]
+                    clean = dict(clean)
+                    clean.pop("cache", None)
+                    clean["cache_delta"] = {"set": changed, "del": removed}
+                    resp["result"] = clean
+                last_clean_cache = cc
+            write_frame(out, resp)
         except BaseException as exc:  # noqa: BLE001 — node error → response
             traceback.print_exc()
             # keep the (possibly half-mutated) cache for a retry — the
@@ -302,6 +357,13 @@ class _Worker:
             )
         self.pid = int(ready.get("pid") or self.proc.pid)
         self.warm_s = time.monotonic() - t0
+        #: the worker speaks the dirty-key cache-delta protocol (always
+        #: true for in-tree workers; the flag keeps a handshake-level
+        #: opt-out for out-of-tree worker loops)
+        self.delta = bool(ready.get("delta"))
+        #: frame bytes of the last request/response pair (wire telemetry)
+        self.last_tx = 0
+        self.last_rx = 0
 
     def alive(self):
         return self.proc.poll() is None
@@ -323,13 +385,16 @@ class _Worker:
 
     def request(self, obj, timeout):
         try:
-            write_frame(self.proc.stdin, obj)
+            self.last_tx = write_frame(self.proc.stdin, obj)
         except (BrokenPipeError, OSError, ValueError) as exc:
             raise WorkerCrashed(
                 f"worker {self.target} (pid {self.proc.pid}) pipe closed: "
                 f"{exc}\n--- stderr tail ---\n{self.stderr_tail()}"
             ) from exc
-        return self._read(timeout)
+        before = self._reader.bytes_read
+        frame = self._read(timeout)
+        self.last_rx = self._reader.bytes_read - before
+        return frame
 
     def stderr_tail(self, nbytes=4000):
         try:
@@ -410,6 +475,15 @@ class DaemonEngine(SubprocessEngine):
         self._workers = {}
         self._worker_gen = {}
         self._worker_last_error = {}
+        # dirty-key cache-delta protocol state, per target (each target is
+        # driven by exactly one thread at a time — the async pool pins one
+        # pending invocation per site; the aggregator rides the reducer
+        # worker): the worker generation whose live cache the engine has
+        # confirmed warm (matching gen => the inbound JSON cache may be
+        # omitted), and the engine-side mirror of the worker's last
+        # shipped clean cache that response deltas are applied to
+        self._warm_gen = {}
+        self._delta_base = {}
         # async-mode pool threads may still be driving a straggler's worker
         # when close() runs: the flag stops the supervisor from respawning
         # a worker for a request that is being torn down
@@ -494,11 +568,22 @@ class DaemonEngine(SubprocessEngine):
                 # the supervision drill: SIGKILL the live worker right as
                 # the round reaches it — the request below finds a corpse
                 worker.kill()
+            # hot-path copy-tax cut (ISSUE 14): a worker the engine has
+            # confirmed warm at this generation owns the live cache and
+            # ignores the inbound JSON copy anyway — omit it from the
+            # frame.  A restart (generation bump) always goes back to the
+            # full cache, which is exactly what the fresh worker resumes
+            # from.
+            req = payload
+            if (worker.delta and self._warm_gen.get(target)
+                    == self._worker_gen.get(target)):
+                req = {k: v for k, v in payload.items() if k != "cache"}
             try:
-                return worker.request(
-                    {"op": "invoke", "round": rnd, "payload": payload},
+                res = worker.request(
+                    {"op": "invoke", "round": rnd, "payload": req},
                     timeout=self.timeout,
                 )
+                return res, worker
             except WorkerTimeout as exc:
                 # same typed attribution as the fresh-process engine's
                 # TimeoutExpired mapping; the wedged process is killed so
@@ -523,7 +608,7 @@ class DaemonEngine(SubprocessEngine):
                 f"{type(exc).__name__}: {exc}"[:300]
             )
 
-        res = self._restart_policy(target).run(
+        res, worker = self._restart_policy(target).run(
             attempt, retryable=(WorkerUnavailable,),
             describe=f"daemon worker {target}", on_retry=on_retry,
         )
@@ -536,7 +621,31 @@ class DaemonEngine(SubprocessEngine):
                 f"{res.get('error')}\n--- traceback ---\n"
                 f"{str(res.get('traceback', ''))[-4000:]}"
             )
-        return res["result"]
+        result = res["result"]
+        delta = None
+        if isinstance(result, dict) and "cache_delta" in result:
+            # warm response: apply the worker's dirty-key delta to the
+            # engine-side mirror of its last shipped clean cache — the
+            # caller still sees a full "cache" dict (the fresh-process
+            # contract at the boundary), without the full re-serialization
+            # ever having crossed the pipe
+            delta = result.pop("cache_delta") or {}
+            base = dict(self._delta_base.get(target) or {})
+            base.update(delta.get("set") or {})
+            for k in delta.get("del") or ():
+                base.pop(k, None)
+            result["cache"] = base
+            self._delta_base[target] = dict(base)
+        elif isinstance(result, dict) and isinstance(
+                result.get("cache"), dict):
+            self._delta_base[target] = dict(result["cache"])
+        self._warm_gen[target] = self._worker_gen.get(target)
+        rec.event(
+            "daemon:frame", cat="daemon", target=target, site=target,
+            tx_bytes=worker.last_tx, rx_bytes=worker.last_rx,
+            delta=delta is not None,
+        )
+        return result
 
     def _relay_broadcast(self, rnd, rec):
         super()._relay_broadcast(rnd, rec)

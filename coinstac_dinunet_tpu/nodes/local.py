@@ -84,6 +84,12 @@ class COINNLocal:
         async_staleness=None,
         async_invoke_pool=None,
         async_stale_discount=None,
+        # opt-in run-ahead pipelining depth d (Federation.RUN_AHEAD,
+        # engine.py::_step_round_async): the reduce+relay tail runs on a
+        # dedicated reducer worker while committed sites are immediately
+        # re-submitted up to d broadcasts deep; frozen into shared_args so
+        # the aggregator's window check widens to k + d
+        run_ahead=None,
         # engine-specific knobs (present so they freeze into shared_args)
         matrix_approximation_rank=1,
         start_powerSGD_iter=10,

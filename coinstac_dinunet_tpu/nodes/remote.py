@@ -412,14 +412,21 @@ class COINNRemote:
         # ``1..k`` rounds is a straggler's in-window stand-in (the engine's
         # ``_step_round_async``), accepted and recorded in
         # ``cache['site_staleness']`` so the reducer down-weights it
-        # (``parallel/reducer.py::_site_weights``).  Anything older than
-        # the window — or ahead of the stamp — is still refused loudly:
-        # the window bounds the staleness the protocol tolerates, it never
-        # repeals at-most-once delivery (the ``staleness_k`` action of
-        # ``dinulint --model`` checks exactly this boundary).
+        # (``parallel/reducer.py::_site_weights``).  Run-ahead pipelining
+        # (``Federation.RUN_AHEAD``) widens the window to ``k + d``: a
+        # FRESH contribution computed while the reduce tail was still in
+        # flight echoes the broadcast it consumed, up to ``d`` behind the
+        # stamp — the same ``site_staleness`` record folds that broadcast
+        # lag into the reducer's ``gamma**lag`` discount.  Anything older
+        # than the combined window — or ahead of the stamp — is still
+        # refused loudly: the window bounds the staleness the protocol
+        # tolerates, it never repeals at-most-once delivery (the
+        # ``staleness_k``/``run_ahead`` actions of ``dinulint --model``
+        # check exactly this boundary).
         expected = self.cache.get("wire_round")
         if expected is not None:
             window = int(self.cache.get(Federation.ASYNC_STALENESS) or 0)
+            window += int(self.cache.get(Federation.RUN_AHEAD) or 0)
             stale, behind = {}, {}
             for site, site_vars in self.input.items():
                 echo = site_vars.get(LocalWire.ROUND.value)

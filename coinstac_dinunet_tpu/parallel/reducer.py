@@ -188,6 +188,15 @@ class COINNReducer:
         ``reducer.py:12``)."""
         return os.path.join(self.state.get("baseDirectory", "."), str(site), fname)
 
+    def _wire_mmap(self):
+        """Memory-map fan-in loads (``Federation.WIRE_MMAP``, default ON):
+        every site payload is consumed as a CRC-verified zero-copy view
+        into the mapped file instead of a heap copy — at high fan-in the
+        reduce stops paying a full same-host copy of every gradient
+        payload before the first partial sum (ISSUE 14)."""
+        v = self.cache.get(Federation.WIRE_MMAP)
+        return True if v is None else bool(v)
+
     def _load(self, file_key):
         """Concurrently load one payload per site; returns list-of-lists
         (site → leaves), site order fixed by sorted site id.  Loads run
@@ -199,7 +208,8 @@ class COINNReducer:
             self._site_path(site, self.input[site][file_key]) for site in sites
         ]
         return tensorutils.load_arrays_many(
-            paths, retry=RetryPolicy.for_wire(self.cache)
+            paths, retry=RetryPolicy.for_wire(self.cache),
+            mmap=self._wire_mmap(),
         )
 
     def _save_out(self, fname, arrays):
@@ -371,6 +381,7 @@ class COINNReducer:
         )
         retry = RetryPolicy.for_wire(self.cache)
         guard = bool(self.cache.get("guard_nonfinite", True))
+        use_mmap = self._wire_mmap()
         rec = _telemetry()
         spill = os.path.join(
             self.state.get("outputDirectory", "."), ".tree_reduce"
@@ -380,8 +391,12 @@ class COINNReducer:
         try:
             entries = []
             for g in range(0, len(paths), k):
+                # mmap'd group loads: each site's payload streams into the
+                # partial sum as a CRC-verified view — the group is the
+                # only thing materialized (as device buffers), never the
+                # full n_sites payload set and never heap copies
                 site_leaves = tensorutils.load_arrays_many(
-                    paths[g:g + k], retry=retry
+                    paths[g:g + k], retry=retry, mmap=use_mmap
                 )
                 n_leaves = len(site_leaves[0])
                 if n_leaves == 0:  # e.g. a payload with no matching params
@@ -419,7 +434,7 @@ class COINNReducer:
                     partials = [
                         [jnp.asarray(x, jnp.float32) for x in p]
                         for p in tensorutils.load_arrays_many(
-                            chunk, retry=retry
+                            chunk, retry=retry, mmap=use_mmap
                         )
                     ]
                     part = os.path.join(spill, f"l{levels}_{g // k}.npy")
@@ -431,7 +446,8 @@ class COINNReducer:
                     nxt.append(part)
                 entries = nxt
                 levels += 1
-            root = tensorutils.load_arrays(entries[0], retry=retry)
+            root = tensorutils.load_arrays(entries[0], retry=retry,
+                                           mmap=use_mmap)
             denom = max(float(np.asarray(root[-1]).ravel()[0]), 1.0)
             means = [jnp.asarray(x, jnp.float32) / denom for x in root[:-1]]
             if rec.enabled:
@@ -455,7 +471,7 @@ class COINNReducer:
         cos = np.empty(len(sites), np.float32)
         for g in range(0, len(paths), k):
             site_leaves = tensorutils.load_arrays_many(
-                paths[g:g + k], retry=retry
+                paths[g:g + k], retry=retry, mmap=self._wire_mmap()
             )
             stacked = [
                 jnp.stack([

@@ -64,6 +64,7 @@ VERDICT_SEVERITY = {
     Live.VERDICT_MFU_COLLAPSE: "warning",
     Live.VERDICT_RETRY_STORM: "warning",
     Live.VERDICT_STALENESS: "warning",
+    Live.VERDICT_PIPELINE: "warning",
 }
 
 
@@ -171,7 +172,7 @@ def _site_entry():
     return {"round": 0, "phase": None, "epoch": None, "last_seen": None,
             "last_heartbeat": None, "anomalies": 0, "dead": False,
             "died_retries_exhausted": False, "quarantined": False,
-            "worker_restarts": 0, "staleness": None}
+            "worker_restarts": 0, "staleness": None, "run_ahead": None}
 
 
 class LiveState:
@@ -215,6 +216,19 @@ class LiveState:
         # staleness_exceeded verdict judges sites against k
         self.staleness_k = 0
         self.stale_standins = 0
+        # run-ahead pipeline (Federation.RUN_AHEAD): the configured depth
+        # d (learned from the engine's async:run_ahead/pipeline:* events),
+        # per-site run-ahead gauges, the reducer-concurrency counter the
+        # decoupling win is measured by, stall counts, and the
+        # edge-trigger latches of the pipeline_stall verdict
+        self.run_ahead_d = 0
+        self.pipeline_stalls = 0
+        self.reduce_concurrent_s = 0.0
+        self._pipeline_breach = None
+        self._pipeline_flowed = False
+        # daemon frame-pipe byte counters (daemon:frame events) — the
+        # delta-cache win is the tx/rx trend across a run
+        self.frame_bytes = {"tx": 0, "rx": 0, "frames": 0}
         # event-name counts (bounded by the event vocabulary): the watch
         # CLI's --assert-event gating reads this, it stays out of the
         # snapshot to keep /healthz stable
@@ -355,6 +369,53 @@ class LiveState:
                     s["staleness_breach"] = max(
                         lag, s.get("staleness_breach") or 0
                     )
+        elif name == "async:run_ahead":
+            # a site was re-submitted ahead of the un-harvested broadcast:
+            # per-site depth gauge + the configured horizon d, and a
+            # flowing-pipeline signal that re-arms the stall verdict
+            try:
+                self.run_ahead_d = max(self.run_ahead_d,
+                                       int(rec.get("d", 0) or 0))
+            except (TypeError, ValueError):
+                pass
+            if site is not None:
+                try:
+                    self.site(site)["run_ahead"] = int(rec.get("depth", 0))
+                except (TypeError, ValueError):
+                    pass
+            self._pipeline_flowed = True
+        elif name == "pipeline:stall":
+            # the reducer worker fell behind the run-ahead horizon: the
+            # engine blocked on the oldest in-flight reduce.  Latched —
+            # the very next harvest usually clears the gauge inside the
+            # same flush batch, and check() must still see the breach.
+            self.pipeline_stalls += 1
+            try:
+                self.run_ahead_d = max(self.run_ahead_d,
+                                       int(rec.get("d", 0) or 0))
+            except (TypeError, ValueError):
+                pass
+            self._pipeline_breach = {
+                "site": site,
+                "reduce_round": rec.get("reduce_round"),
+                "waited_s": rec.get("waited_s"),
+                "d": rec.get("d"),
+            }
+        elif name == "pipeline:reduce_concurrent":
+            # seconds the reduce+relay ran while site invocations were in
+            # flight — the decoupling the pipeline exists to create
+            try:
+                self.reduce_concurrent_s += float(rec.get("secs", 0) or 0)
+            except (TypeError, ValueError):
+                pass
+            self._pipeline_flowed = True
+        elif name == "daemon:frame":
+            try:
+                self.frame_bytes["tx"] += int(rec.get("tx_bytes", 0) or 0)
+                self.frame_bytes["rx"] += int(rec.get("rx_bytes", 0) or 0)
+                self.frame_bytes["frames"] += 1
+            except (TypeError, ValueError):
+                pass
         elif name == Daemon.EVENT_RESTART:
             # the daemon engine replaced a dead/wedged worker — the site
             # SURVIVED (supervision, not quorum), but the board/metrics
@@ -412,6 +473,10 @@ class LiveState:
             # both the engine (per delivery/stand-in) and the aggregator's
             # window check record the series — latest sample wins
             self.site(rec["site"])["staleness"] = int(v)
+        elif name == Metric.SITE_RUN_AHEAD and rec.get("site") is not None:
+            # recorded at every pipelined re-submission (0 = consumed the
+            # newest broadcast; j = running j broadcasts ahead)
+            self.site(rec["site"])["run_ahead"] = int(v)
         elif name == Metric.ROUNDS_PER_SEC:
             # the vectorized engine records the series directly; trust it
             self.rounds_per_sec = (
@@ -532,6 +597,33 @@ class LiveState:
                 elif st is not None and st <= self.staleness_k:
                     self._rearm(key)
 
+        # run-ahead pipeline: the reducer worker fell behind the horizon
+        # (a pipeline:stall latched since the last check) — edge-triggered
+        # federation-wide; re-arms once the pipeline visibly flows again
+        # (a concurrent reduce completed or a run-ahead re-submission
+        # happened after the breach).
+        breach, self._pipeline_breach = self._pipeline_breach, None
+        if breach is not None:
+            where = (f" (site {breach['site']} hit the horizon)"
+                     if breach.get("site") else "")
+            waited = breach.get("waited_s")
+            v = self._fire(
+                "pipeline_stall", Live.VERDICT_PIPELINE,
+                "reducer worker fell behind the run-ahead horizon",
+                f"the engine blocked {waited if waited is not None else '?'}s"
+                f" on the round-{breach.get('reduce_round')} reduce at "
+                f"run-ahead depth d={breach.get('d')}{where}; the wire "
+                f"tail is gating compute again "
+                f"({self.pipeline_stalls} stall(s) so far)",
+                now, site=breach.get("site"),
+            )
+            if v:
+                fired.append(v)
+            self._pipeline_flowed = False
+        elif self._pipeline_flowed:
+            self._rearm("pipeline_stall")
+            self._pipeline_flowed = False
+
         if len(self.round_durs) >= _ROUND_MIN_SAMPLES:
             *window, last = self.round_durs
             med = statistics.median(window)
@@ -623,6 +715,7 @@ class LiveState:
                 "anomalies": s["anomalies"],
                 "worker_restarts": s["worker_restarts"],
                 "staleness": s["staleness"],
+                "run_ahead": s["run_ahead"],
                 "status": ("dead" if s["dead"] else
                            "quarantined" if s["quarantined"] else
                            "silent" if f"silence:{name}" in self._armed else
@@ -647,6 +740,10 @@ class LiveState:
             "worker_restarts": self.worker_restarts,
             "staleness_k": self.staleness_k,
             "stale_standins": self.stale_standins,
+            "run_ahead_d": self.run_ahead_d,
+            "pipeline_stalls": self.pipeline_stalls,
+            "reduce_concurrent_s": round(self.reduce_concurrent_s, 4),
+            "frame_bytes": dict(self.frame_bytes),
             "wire_retries": self.wire_retries,
             "corruption_recovered": self.corruption_recovered,
             "dead_sites": sorted(self.dead),
@@ -698,20 +795,34 @@ def render_board(snap, root=""):
         + (f"stale stand-ins {snap.get('stale_standins', 0)} "
            f"(k={snap['staleness_k']}) · "
            if snap.get("staleness_k") else "")
+        + (f"run-ahead d={snap['run_ahead_d']} "
+           f"(reduce overlap {snap.get('reduce_concurrent_s', 0):.1f}s, "
+           f"{snap.get('pipeline_stalls', 0)} stall(s)) · "
+           if snap.get("run_ahead_d") else "")
         + f"truncated lines {snap['truncated_lines']} · "
         f"dead: {', '.join(snap['dead_sites']) or '-'} · "
         f"quarantined: {', '.join(snap['quarantined_sites']) or '-'}"
     )
+    fb = snap.get("frame_bytes") or {}
+    if fb.get("frames"):
+        lines.append(
+            f"daemon frames {fb['frames']} · tx {_fmt_bytes(fb['tx'])} · "
+            f"rx {_fmt_bytes(fb['rx'])}"
+        )
     if snap["sites"]:
         width = max(len(n) for n in snap["sites"])
-        # the staleness column appears only on async runs (k learned from
-        # the engine's async:* events) — lockstep boards stay unchanged
+        # the staleness/run-ahead columns appear only on async/pipelined
+        # runs (k and d learned from the engine's async:*/pipeline:*
+        # events) — lockstep boards stay unchanged
         k = int(snap.get("staleness_k") or 0)
+        d = int(snap.get("run_ahead_d") or 0)
         stale_hdr = f" {'stale':>5}" if k else ""
+        ahead_hdr = f" {'ahead':>5}" if d else ""
         lines.append("")
         lines.append(
             f"  {'site'.ljust(width)}  {'round':>5} {'epoch':>5} "
-            f"{'phase':<16} {'heartbeat':>10}{stale_hdr} {'anoms':>5}  status"
+            f"{'phase':<16} {'heartbeat':>10}{stale_hdr}{ahead_hdr} "
+            f"{'anoms':>5}  status"
         )
         for name, s in snap["sites"].items():
             age = ("-" if s["heartbeat_age_s"] is None
@@ -721,10 +832,14 @@ def render_board(snap, root=""):
             if k:
                 st = s.get("staleness")
                 stale_col = f" {'-' if st is None else st:>5}"
+            ahead_col = ""
+            if d:
+                ra = s.get("run_ahead")
+                ahead_col = f" {'-' if ra is None else ra:>5}"
             lines.append(
                 f"  {name.ljust(width)}  {s['round']:>5} "
                 f"{'-' if s['epoch'] is None else s['epoch']:>5} "
-                f"{(s['phase'] or '-'):<16} {age:>10}{stale_col} "
+                f"{(s['phase'] or '-'):<16} {age:>10}{stale_col}{ahead_col} "
                 f"{s['anomalies']:>5}  {status}"
             )
     if snap["verdicts"]:

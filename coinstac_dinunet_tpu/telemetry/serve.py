@@ -151,12 +151,34 @@ def render_prometheus(snap):
             "configured async staleness bound k (absent on lockstep runs)")
     w.counter("stale_standins_total", snap.get("stale_standins"),
               "straggler stand-ins delivered by the async round engine")
+    for name, s in sites.items():
+        # run-ahead pipelining only: absent (None) otherwise, so
+        # non-pipelined scrapes carry no empty series
+        w.gauge("site_run_ahead", s.get("run_ahead"),
+                "broadcasts the site's pending invocation is running "
+                "ahead of the last one it consumed (run-ahead pipeline)",
+                labels={"site": name})
+    w.gauge("run_ahead_d", snap.get("run_ahead_d") or None,
+            "configured run-ahead pipelining depth d (absent when off)")
+    w.counter("reduce_concurrent_seconds_total",
+              snap.get("reduce_concurrent_s"),
+              "seconds the reduce+relay tail ran while site invocations "
+              "were in flight (the pipelining win)")
+    w.counter("pipeline_stalls_total", snap.get("pipeline_stalls"),
+              "times the engine blocked on the reducer worker at the "
+              "run-ahead horizon")
+    fb = snap.get("frame_bytes") or {}
+    for direction in ("tx", "rx"):
+        w.counter("daemon_frame_bytes_total", fb.get(direction),
+                  "daemon frame-pipe bytes by direction (the delta-cache "
+                  "win is the per-invoke trend)",
+                  labels={"dir": direction})
     by_kind = {}
     for v in snap.get("verdicts") or ():
         by_kind[v["verdict"]] = by_kind.get(v["verdict"], 0) + 1
     for kind in (Live.VERDICT_SILENCE, Live.VERDICT_ROUND_OUTLIER,
                  Live.VERDICT_MFU_COLLAPSE, Live.VERDICT_RETRY_STORM,
-                 Live.VERDICT_STALENESS):
+                 Live.VERDICT_STALENESS, Live.VERDICT_PIPELINE):
         w.counter("verdicts_total", by_kind.get(kind, 0),
                   "in-flight stall verdicts fired, by kind",
                   labels={"kind": kind})

@@ -176,9 +176,24 @@ def save_arrays(path, arrays, codec=None, seed=0):
     return _transport.commit_bytes(path, header, blobs, crc=crc)
 
 
-def _read_payload(path):
+def _read_payload(path, use_mmap=False):
     from .. import native
 
+    if use_mmap:
+        # memory-map instead of materializing a heap copy: the payload's
+        # manifest/CRC verification and the np.frombuffer views all run
+        # over the mapped pages (``unpack_arrays`` takes any buffer), so
+        # the only full pass over the data is the CRC — no second copy
+        # until a consumer actually casts a leaf.  The arrays returned by
+        # unpack_arrays keep the mmap object alive via their .base chain;
+        # unlinking a mapped file is safe on POSIX (the transport commits
+        # by rename, so a reader's inode stays consistent).
+        import mmap as _mmap
+
+        with open(path, "rb") as f:
+            if os.fstat(f.fileno()).st_size == 0:
+                return b""  # mmap refuses empty files; empty = truncated
+            return _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
     payload = native.load_file(path) if native.available() else None
     if payload is None:
         with open(path, "rb") as f:
@@ -186,7 +201,7 @@ def _read_payload(path):
     return payload
 
 
-def load_arrays(path, retry=None):
+def load_arrays(path, retry=None, mmap=False):
     """Read back the list written by :func:`save_arrays` (native bulk read
     when available), verifying the embedded checksum.
 
@@ -195,7 +210,13 @@ def load_arrays(path, retry=None):
     payloads with backoff — a payload mid-relay is a transient, and the
     quorum machinery must only ever see failures that survived the retry
     budget.  A recovery after a corruption/truncation failure emits a
-    ``wire:corruption_recovered`` telemetry event."""
+    ``wire:corruption_recovered`` telemetry event.
+
+    ``mmap=True`` maps the file read-only instead of reading it into a
+    heap buffer; integrity (embedded CRC32 + the directory manifest's
+    expected CRC) is verified over the mapped view and the returned
+    arrays are zero-copy views into it — the aggregator fan-in's
+    copy-tax teardown (ISSUE 14; ``Federation.WIRE_MMAP``)."""
     rec = _telemetry()
     t0 = time.perf_counter() if rec.enabled else 0.0
     # inline loop rather than RetryPolicy.run: exhaustion must re-raise the
@@ -208,7 +229,7 @@ def load_arrays(path, retry=None):
     while True:
         attempt += 1
         try:
-            payload = _read_payload(path)
+            payload = _read_payload(path, use_mmap=mmap)
             entry = _transport.manifest_entry(path)
             out = unpack_arrays(
                 payload,
@@ -285,7 +306,7 @@ def shutdown_fan_in_pool(wait=True):
         pool.shutdown(wait=wait)
 
 
-def load_arrays_many(paths, retry=None):
+def load_arrays_many(paths, retry=None, mmap=False):
     """Load several payload files concurrently — the aggregator's N-site
     fan-in (≙ ref ``distrib/reducer.py:18-23`` multiprocessing pool).
 
@@ -295,13 +316,22 @@ def load_arrays_many(paths, retry=None):
     thrashes instead of parallelizing, and a fresh pool per call pays
     thread spawn/join on the reduce hot path).  Individual native
     read/verify failures retry through the Python reader under
-    ``retry``."""
+    ``retry``.
+
+    ``mmap=True`` (the reducer fan-in's default, ``Federation.WIRE_MMAP``)
+    maps each payload read-only instead of materializing heap copies —
+    the native bulk read (which returns owned buffers) is bypassed, CRC
+    is verified over the mapped views, and the streamed k-ary partial
+    sums consume zero-copy views (ISSUE 14)."""
     from .. import native
 
     paths = list(paths)
     rec = _telemetry()
     t0 = time.perf_counter() if rec.enabled else 0.0
-    payloads = native.load_many(paths) if native.available() else None
+    payloads = (
+        native.load_many(paths)
+        if native.available() and not mmap else None
+    )
 
     def _task_retry(i):
         # per-task fork: concurrent loads never share a jitter RNG (draw
@@ -312,7 +342,8 @@ def load_arrays_many(paths, retry=None):
     if payloads is None:
         # each load_arrays call records its own wire event
         return list(fan_in_pool().map(
-            lambda ip: load_arrays(ip[1], retry=_task_retry(ip[0])),
+            lambda ip: load_arrays(ip[1], retry=_task_retry(ip[0]),
+                                   mmap=mmap),
             enumerate(paths),
         ))
     out = []

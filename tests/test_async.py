@@ -85,7 +85,9 @@ def test_async_k0_pool1_is_bit_identical_to_serial(tmp_path):
 
     eng = _fsv_engine(tmp_path / "async",
                       async_staleness=0, async_invoke_pool=1)
-    assert eng._async_config() == {"enabled": True, "k": 0, "pool": 1}
+    assert eng._async_config() == {
+        "enabled": True, "k": 0, "pool": 1, "run_ahead": 0,
+    }
     try:
         eng.run(max_rounds=200)
         assert eng.success
@@ -181,6 +183,136 @@ def test_slow_site_overlaps_wire_and_next_round(tmp_path):
         e.get("kind") == "metric" and e.get("name") == Metric.SITE_STALENESS
         for e in events
     )
+
+
+# ------------------------------------------- run-ahead e2e (daemon, ISSUE 14)
+def _fedbench_daemon(tmp_path, tag, node_extra=None, fault_plan=None,
+                     per_site=16):
+    from coinstac_dinunet_tpu.federation.daemon import DaemonEngine
+
+    sys.path.insert(0, SCRIPTS)
+    try:
+        from _fedbench_task import CACHE, fill_site_data
+    finally:
+        sys.path.remove(SCRIPTS)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (
+        REPO + os.pathsep + SCRIPTS + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    node_args = dict(CACHE, persist_round_state=True, profile=True,
+                     **(node_extra or {}))
+    node_args.pop("task_id", None)
+    eng = DaemonEngine(
+        tmp_path / tag, n_sites=N_SITES,
+        local_script=os.path.join(SCRIPTS, "_fedbench_local.py"),
+        remote_script=os.path.join(SCRIPTS, "_fedbench_remote.py"),
+        first_input={"fedbench_args": node_args}, env=env,
+        fault_plan=fault_plan,
+    )
+    fill_site_data(eng, per_site=per_site)
+    return eng
+
+
+@pytest.mark.slow
+def test_run_ahead_pipelines_reduce_and_drain_matches_d0(tmp_path,
+                                                         monkeypatch):
+    """ISSUE-14 drain contract, both halves, on the daemon engine:
+
+    (a) a normal d=1 run pipelines — run-ahead re-submissions and
+        reduce-concurrent telemetry land, the reduce tail overlaps site
+        compute on the merged timeline, and the relaxed window accepts
+        every delivery;
+    (b) under the _PIPELINE_FORCE_DRAIN switch (every round drains right
+        after the reduce is submitted — exactly the schedule a barrier
+        forces) the SAME machinery (reducer worker, alias rewrite,
+        harvest) produces scores bit-identical to the d=0 async run:
+        the drain path IS the lockstep path."""
+    from coinstac_dinunet_tpu import engine as eng_mod
+
+    from coinstac_dinunet_tpu.utils import tensorutils
+
+    def run(tag, node_extra):
+        eng = _fedbench_daemon(tmp_path, tag, node_extra=node_extra)
+        try:
+            for _ in range(10):
+                eng.step_round()
+            # the round-10 averaged-update broadcast is a digest of the
+            # whole training trajectory: bit-equal payloads => bit-equal
+            # schedules
+            avg = tensorutils.load_arrays(os.path.join(
+                str(tmp_path / tag), "remote_xfer", "avg_grads.npy"
+            ))
+            cursors = {s: (c.get("cursor"), c.get("epoch"))
+                       for s, c in eng.site_caches.items()}
+            return avg, cursors
+        finally:
+            eng.close()
+
+    # (a) pipelined run: the wire tail visibly leaves the round's
+    # critical path
+    run("pipelined", {"async_staleness": 1, "run_ahead": 1})
+    events = load_events(str(tmp_path / "pipelined"))
+    names = {e.get("name") for e in events if e.get("kind") == "event"}
+    assert "pipeline:reduce_concurrent" in names
+    concurrent = sum(
+        float(e.get("secs") or 0) for e in events
+        if e.get("name") == "pipeline:reduce_concurrent"
+    )
+    assert concurrent > 0.0
+    assert any(
+        e.get("kind") == "metric" and e.get("name") == Metric.SITE_RUN_AHEAD
+        for e in events
+    )
+
+    # (b) force-drain d=1 vs plain d=0: bit-identical training trajectory
+    monkeypatch.setattr(eng_mod, "_PIPELINE_FORCE_DRAIN", True)
+    avg_drained, cur_drained = run(
+        "drained",
+        {"async_staleness": 0, "async_invoke_pool": 3, "run_ahead": 1},
+    )
+    monkeypatch.setattr(eng_mod, "_PIPELINE_FORCE_DRAIN", False)
+    avg_d0, cur_d0 = run("d0", {"async_staleness": 0,
+                                "async_invoke_pool": 3})
+    assert cur_drained == cur_d0
+    assert len(avg_drained) == len(avg_d0) > 0
+    for a, b in zip(avg_drained, avg_d0):
+        assert np.asarray(a).shape == np.asarray(b).shape
+        assert (np.asarray(a) == np.asarray(b)).all()
+
+
+@pytest.mark.slow
+def test_reducer_worker_crash_supervised_without_losing_a_round(tmp_path):
+    """ISSUE-14 supervision satellite: SIGKILL the AGGREGATOR's worker
+    mid-reduce while the reduce runs on the reducer worker thread — the
+    supervisor restarts it under RetryPolicy.for_worker, the round's
+    reduce completes on the fresh worker, and no round is lost (the
+    wire_round stamp advances once per round)."""
+    plan = {"faults": [
+        {"kind": "worker_kill", "round": 6, "site": "remote"},
+    ]}
+    eng = _fedbench_daemon(
+        tmp_path, "redkill",
+        node_extra={"async_staleness": 1, "run_ahead": 1},
+        fault_plan=plan,
+    )
+    try:
+        for _ in range(10):
+            eng.step_round()
+        assert eng.rounds == 10
+        assert eng.dead_sites == set()
+        # every round's reduce landed exactly once: the monotonic stamp
+        # the relaxed window still enforces
+        assert int(eng.remote_cache.get("wire_round") or 0) == 10
+    finally:
+        eng.close()
+    events = load_events(str(tmp_path / "redkill"))
+    restarts = [e for e in events if e.get("name") == "worker:restart"]
+    assert any(e.get("target") == "remote" for e in restarts)
+    kills = [e for e in events if e.get("name") == "chaos:inject"
+             and e.get("fault") == "worker_kill"]
+    assert len(kills) == 1
 
 
 # ----------------------------------------------------------- window semantics
@@ -380,6 +512,214 @@ def test_doctor_bench_verdict_pairs_wire_overlap_ratio():
     assert bench["unit"] == "ratio"
     assert any(v["cause"].startswith("benchmark throughput regressed")
                for v in report["verdicts"])
+
+
+# ------------------------------------------------- run-ahead pipelining (ISSUE 14)
+def test_run_ahead_0_bit_identical_and_in_process_clamps(tmp_path):
+    """ISSUE-14 parity: run_ahead=0 keeps the async path bit-identical to
+    the PR-12 schedule (which is itself bit-identical to serial at k=0 /
+    pool 1), and the IN-PROCESS engine clamps any configured depth to 0
+    (its aggregator activates the process-global ambient telemetry stack,
+    so the reduce tail must stay on the engine thread) — so even
+    run_ahead=1 in-process stays score-identical to serial."""
+    from coinstac_dinunet_tpu.engine import SubprocessEngine
+
+    serial = _fsv_engine(tmp_path / "serial")
+    serial.run(max_rounds=200)
+    assert serial.success
+
+    for tag, extra in (
+        ("ra0", dict(async_staleness=0, async_invoke_pool=1, run_ahead=0)),
+        ("ra1", dict(async_staleness=0, async_invoke_pool=1, run_ahead=1)),
+    ):
+        eng = _fsv_engine(tmp_path / tag, **extra)
+        assert eng._async_config()["run_ahead"] == 0  # in-process cap
+        try:
+            eng.run(max_rounds=200)
+            assert eng.success
+        finally:
+            eng.close()
+        # the CLAMPED depth is what shared_args froze: the aggregator's
+        # k + d window mirrors the horizon this engine enforces, so a
+        # stale echo is refused exactly as loudly as before the clamp
+        assert int(eng.remote_cache.get("run_ahead") or 0) == 0
+        for key in ("train_log", "validation_log", "test_metrics"):
+            got = np.asarray(eng.remote_cache[key], np.float64)
+            golden = np.asarray(serial.remote_cache[key], np.float64)
+            assert got.shape == golden.shape, (tag, key)
+            assert (got == golden).all(), (tag, key)
+    # the process-backed engines lift the cap: run-ahead is real there
+    assert SubprocessEngine._RUN_AHEAD_CAP is None
+
+
+def test_run_ahead_input_consumption_strip_and_eligibility(tmp_path):
+    """The pipeline's double-apply guard: a broadcast is delivered in full
+    exactly once per site (the consumed stamp), later re-submissions strip
+    the one-shot update keys but keep the wire_round echo, and multi-
+    invocation sync protocols refuse to run ahead at all."""
+    eng = _fsv_engine(tmp_path / "wd")
+    eng._async_cfg = {"enabled": True, "k": 1, "pool": 1, "run_ahead": 1}
+    bcast = {"wire_round": 5, "phase": "computation", "update": True,
+             "avg_grads_file": "avg_grads.npy",
+             "global_modes": {"site_0": "train"}, "health": {"counts": {}}}
+    eng.site_inputs = {s: dict(bcast) for s in eng.site_ids}
+
+    inp = eng._pipeline_input("site_0")
+    assert inp["update"] and inp["wire_round"] == 5
+    assert eng._async_consumed["site_0"] == 5
+    assert eng._run_ahead_depth["site_0"] == 0
+    # same stamp again: consumed — a full re-delivery would double-apply
+    assert eng._pipeline_input("site_0") is None
+    # a NEW broadcast resets the depth and delivers in full
+    eng.site_inputs["site_0"] = dict(bcast, wire_round=6)
+    eng._run_ahead_depth["site_0"] = 1
+    assert eng._pipeline_input("site_0")["wire_round"] == 6
+    assert eng._run_ahead_depth["site_0"] == 0
+
+    stripped = eng._run_ahead_strip(bcast)
+    assert "update" not in stripped
+    assert "avg_grads_file" not in stripped
+    assert "health" not in stripped
+    assert stripped["wire_round"] == 5  # the lag accounting rides on it
+    assert stripped["phase"] == "computation"
+    assert stripped["global_modes"] == {"site_0": "train"}
+
+    assert eng._run_ahead_eligible(bcast)
+    assert not eng._run_ahead_eligible({"phase": "computation"})  # no update
+    assert not eng._run_ahead_eligible(dict(bcast, powerSGD_phase="P"))
+    assert not eng._run_ahead_eligible(dict(bcast, dad_data_file="d.npy"))
+    assert not eng._run_ahead_eligible(
+        dict(bcast, global_runs={"site_0": {}})
+    )
+
+
+def test_window_widens_to_k_plus_d_and_refuses_beyond():
+    """The aggregator accepts an echo lagging by at most k + d (run-ahead
+    broadcast lag folds into the SAME site_staleness record the reducer
+    discounts) and refuses anything deeper, exactly as loudly as before."""
+    def remote(k, d, echoes):
+        cache = {"all_sites": sorted(echoes), "wire_round": 5}
+        if k:
+            cache["async_staleness"] = k
+        if d:
+            cache["run_ahead"] = d
+        inp = {site: {"phase": "computation", "wire_round": echo}
+               for site, echo in echoes.items()}
+        return COINNRemote(cache=cache, input=inp, state={})
+
+    node = remote(0, 1, {"site_0": 5, "site_1": 4})
+    node._check_lockstep_phases()
+    assert node.cache["site_staleness"] == {"site_1": 1}
+    with pytest.raises(RuntimeError, match="lockstep round violation"):
+        remote(0, 1, {"site_0": 5, "site_1": 3})._check_lockstep_phases()
+    node = remote(1, 1, {"site_0": 5, "site_1": 3})
+    node._check_lockstep_phases()
+    assert node.cache["site_staleness"] == {"site_1": 2}
+    with pytest.raises(RuntimeError, match="lockstep round violation"):
+        remote(1, 1, {"site_0": 5, "site_1": 2})._check_lockstep_phases()
+
+
+# ------------------------------------------------------------ tier-4 run_ahead
+def test_model_run_ahead_passes_clean_at_default_bound():
+    from coinstac_dinunet_tpu.analysis.model_check import (
+        FAULT_ALPHABET,
+        ModelConfig,
+        run_model_check,
+    )
+
+    assert "run_ahead" in FAULT_ALPHABET
+    assert ModelConfig().run_ahead == (0, ModelCheck.DEFAULT_RUN_AHEAD)
+    res = run_model_check(config=ModelConfig(kinds=("run_ahead",)))
+    assert res.findings == []
+
+
+def test_model_seeded_run_ahead_violation_fires_exactly_once(
+        monkeypatch, tmp_path):
+    """A window that accepts a FRESH contribution lagging beyond k + d
+    (the seeded broken horizon) produces exactly one
+    proto-model-stale-contribution with a loadable replay plan."""
+    from coinstac_dinunet_tpu.analysis import model_check as mc
+
+    cfg = mc.ModelConfig(kinds=("run_ahead",), max_faults=2)
+    assert mc.run_model_check(config=cfg).findings == []  # real semantics
+    monkeypatch.setattr(mc, "_WINDOW_ACCEPTS_BEYOND_RUN_AHEAD", True)
+    res = mc.run_model_check(config=cfg, plans_dir=str(tmp_path))
+    assert {f.rule for f in res.findings} == {ModelCheck.STALE_CONTRIBUTION}
+    assert len(res.findings) == 1
+    assert "broadcasts behind" in res.findings[0].message
+    plan = res.plans[0]
+    assert plan["scenario"]["run_ahead"] == ModelCheck.DEFAULT_RUN_AHEAD
+    assert {f["kind"] for f in plan["faults"]} == {"stale"}
+    assert load_fault_plan({"faults": plan["faults"]})
+    written = [p for p in os.listdir(tmp_path)
+               if p.startswith("proto-model-stale-contribution")]
+    assert len(written) == 1
+
+
+# --------------------------------------------------------- live plane (ISSUE 14)
+def _pipe_event(name, t0, **attrs):
+    return {"kind": "event", "name": name, "cat": "async", "node": "engine",
+            "t0": t0, **attrs}
+
+
+def test_live_run_ahead_gauges_and_pipeline_stall_verdict():
+    live = LiveState()
+    live.ingest([
+        _pipe_event("async:run_ahead", 100.0, site="site_1", depth=1, d=1),
+        _pipe_event("pipeline:reduce_concurrent", 100.1, reduce_round=5,
+                    secs=0.25),
+        {"kind": "metric", "name": Metric.SITE_RUN_AHEAD, "value": 0.0,
+         "node": "engine", "site": "site_0", "t0": 100.2},
+    ])
+    snap = live.snapshot(now=101.0)
+    assert snap["run_ahead_d"] == 1
+    assert snap["sites"]["site_1"]["run_ahead"] == 1
+    assert snap["sites"]["site_0"]["run_ahead"] == 0
+    assert snap["reduce_concurrent_s"] == 0.25
+    assert live.check(now=101.0) == []  # flowing pipeline: no verdict
+
+    live.ingest([_pipe_event("pipeline:stall", 102.0, site="site_1",
+                             reduce_round=6, waited_s=0.41, d=1)])
+    fired = live.check(now=102.5)
+    assert [v["verdict"] for v in fired] == [Live.VERDICT_PIPELINE]
+    assert fired[0]["site"] == "site_1"
+    assert "behind the run-ahead horizon" in fired[0]["cause"]
+    assert live.check(now=103.0) == []  # edge-triggered: no re-fire
+    # a later concurrent reduce re-arms; the next stall fires again
+    live.ingest([_pipe_event("pipeline:reduce_concurrent", 104.0,
+                             reduce_round=7, secs=0.1)])
+    assert live.check(now=104.5) == []
+    live.ingest([_pipe_event("pipeline:stall", 105.0, site="site_2",
+                             reduce_round=8, waited_s=0.2, d=1)])
+    assert [v["verdict"] for v in live.check(now=105.5)] == [
+        Live.VERDICT_PIPELINE
+    ]
+    assert live.snapshot(now=106.0)["pipeline_stalls"] == 2
+
+    prom = render_prometheus(live.snapshot(now=106.0))
+    assert 'coinstac_dinunet_site_run_ahead{site="site_1"} 1.0' in prom
+    assert "coinstac_dinunet_run_ahead_d 1.0" in prom
+    assert "coinstac_dinunet_reduce_concurrent_seconds_total 0.35" in prom
+    assert "coinstac_dinunet_pipeline_stalls_total 2.0" in prom
+    assert ('coinstac_dinunet_verdicts_total{kind="pipeline_stall"} 2.0'
+            in prom)
+
+
+def test_live_daemon_frame_byte_counters():
+    live = LiveState()
+    live.ingest([
+        _pipe_event("daemon:frame", 100.0, target="site_0", site="site_0",
+                    tx_bytes=4000, rx_bytes=2000, delta=False),
+        _pipe_event("daemon:frame", 100.1, target="site_0", site="site_0",
+                    tx_bytes=300, rx_bytes=150, delta=True),
+    ])
+    snap = live.snapshot(now=101.0)
+    assert snap["frame_bytes"] == {"tx": 4300, "rx": 2150, "frames": 2}
+    prom = render_prometheus(snap)
+    assert ('coinstac_dinunet_daemon_frame_bytes_total{dir="tx"} 4300.0'
+            in prom)
+    assert ('coinstac_dinunet_daemon_frame_bytes_total{dir="rx"} 2150.0'
+            in prom)
 
 
 # ------------------------------------------------------------ overlap helper

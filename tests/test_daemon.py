@@ -257,6 +257,65 @@ def test_node_error_is_not_a_worker_failure(tmp_path):
         eng.close()
 
 
+def test_frame_delta_cache_cuts_steady_state_bytes(tmp_path):
+    """ISSUE-14 copy-tax teardown on the frame pipe: the first invocation
+    ships the full cache both ways; once the engine has confirmed the
+    worker warm it OMITS the inbound JSON cache and the worker answers
+    with a dirty-key delta — the engine's merged view stays exactly the
+    full cache, while the per-invoke frame bytes collapse.  A worker
+    restart drops back to full-cache frames and resumes from the
+    engine-side mirror."""
+    from coinstac_dinunet_tpu.resilience.retry import RetryExhausted
+
+    eng, script = _echo_engine(tmp_path)
+    rec = _engine_rec(eng)
+    try:
+        blob = {"blob": "x" * 4000}
+        outs = [eng._invoke(script, {"cache": dict(blob), "input": {},
+                                     "state": {}},
+                            target="site_0", rec=rec)]
+        for _ in range(2):
+            outs.append(eng._invoke(
+                script, {"cache": outs[-1]["cache"], "input": {},
+                         "state": {}}, target="site_0", rec=rec,
+            ))
+        # the merged caches are FULL despite the delta frames
+        assert [o["cache"]["n"] for o in outs] == [1, 2, 3]
+        assert all(o["cache"]["blob"] == blob["blob"] for o in outs)
+        rec.flush()
+        frames = [e for e in load_events(eng.workdir)
+                  if e.get("name") == "daemon:frame"]
+        assert [bool(f["delta"]) for f in frames] == [False, True, True]
+        # warm requests omit the 4KB cache; warm responses ship only the
+        # dirty keys — both directions collapse by an order of magnitude
+        assert frames[1]["tx_bytes"] < frames[0]["tx_bytes"] / 5
+        assert frames[1]["rx_bytes"] < frames[0]["rx_bytes"] / 5
+
+        # restart: full cache resent, state resumed from the mirror
+        with pytest.raises(RetryExhausted):
+            eng._invoke(script, {"cache": outs[-1]["cache"],
+                                 "input": {"cmd": "die"}, "state": {}},
+                        target="site_0", rec=rec)
+        out = eng._invoke(script, {"cache": outs[-1]["cache"], "input": {},
+                                   "state": {}}, target="site_0", rec=rec)
+        assert out["cache"]["n"] == 4
+        assert out["cache"]["blob"] == blob["blob"]
+        rec.flush()
+        frames = [e for e in load_events(eng.workdir)
+                  if e.get("name") == "daemon:frame"]
+        # the post-restart invocation went back to a full-cache frame
+        assert bool(frames[-1]["delta"]) is False
+        assert frames[-1]["tx_bytes"] > frames[1]["tx_bytes"] * 5
+    finally:
+        eng.close()
+
+
+def test_write_frame_returns_byte_count():
+    buf = io.BytesIO()
+    n = write_frame(buf, {"op": "ping"})
+    assert n == len(buf.getvalue())
+
+
 # --------------------------------------------- fresh-process timeout satellite
 def test_subprocess_timeout_is_typed_with_partial_stderr(tmp_path):
     """SubprocessEngine._invoke maps subprocess.TimeoutExpired to the typed

@@ -55,6 +55,56 @@ def test_save_load_arrays(tmp_path):
     np.testing.assert_allclose(out[0], arrays[0])
 
 
+def test_load_arrays_mmap_zero_copy_and_crc(tmp_path):
+    """ISSUE-14 copy-tax teardown: ``mmap=True`` returns CRC-verified
+    views into the mapped file (no heap copy of the data section), equal
+    to the heap-read path; corruption and truncation still surface as the
+    typed wire errors — the CRC runs over the mapped view."""
+    from coinstac_dinunet_tpu.utils.tensorutils import (
+        WireCorruption,
+        WireIncomplete,
+        load_arrays_many,
+    )
+
+    p = str(tmp_path / "grads.npy")
+    arrays = [np.random.randn(64, 8).astype(np.float32),
+              np.arange(11, dtype=np.int64)]
+    save_arrays(p, arrays)
+    heap = load_arrays(p)
+    mapped = load_arrays(p, mmap=True)
+    for a, b, c in zip(arrays, heap, mapped):
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(a, c)
+        assert a.dtype == c.dtype
+    # views into the map, not heap copies: read-only with a buffer base
+    assert not mapped[0].flags.writeable
+    assert mapped[0].base is not None
+
+    many = load_arrays_many([p, p], mmap=True)
+    np.testing.assert_array_equal(many[0][0], arrays[0])
+    np.testing.assert_array_equal(many[1][1], arrays[1])
+
+    # bit-flip inside the data section -> WireCorruption over the view
+    corrupt = str(tmp_path / "bad.npy")
+    save_arrays(corrupt, arrays)
+    raw = bytearray(open(corrupt, "rb").read())
+    raw[-5] ^= 0xFF
+    with open(corrupt, "wb") as f:
+        f.write(raw)
+    with pytest.raises(WireCorruption):
+        load_arrays(corrupt, mmap=True)
+    # truncation -> WireIncomplete (incl. the empty-file mmap edge)
+    trunc = str(tmp_path / "short.npy")
+    with open(trunc, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    with pytest.raises(WireIncomplete):
+        load_arrays(trunc, mmap=True)
+    empty = str(tmp_path / "empty.npy")
+    open(empty, "wb").close()
+    with pytest.raises(WireIncomplete):
+        load_arrays(empty, mmap=True)
+
+
 def test_extract_grads_roundtrip_pytree():
     tree = {"dense": {"w": np.random.randn(4, 3), "b": np.zeros(3)}}
     flat = extract_grads(tree, precision_bits=32)
