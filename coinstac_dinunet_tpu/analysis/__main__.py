@@ -83,6 +83,13 @@ def build_parser():
                         "stamp + the staleness_k action; 0 disables the "
                         "async dimension entirely; default: "
                         "ModelCheck.DEFAULT_STALENESS_K)")
+    p.add_argument("--model-elastic", type=int, default=None,
+                   choices=(0, 1),
+                   help="explore the elastic-membership dimension (one "
+                        "spare non-member slot + the join/leave/rejoin "
+                        "roster transitions) ALONGSIDE the fixed roster; "
+                        "0 disables it (default: "
+                        "ModelCheck.DEFAULT_ELASTIC)")
     p.add_argument("--model-plans", default=None, metavar="DIR",
                    help="write each proto-model-* counterexample as an "
                         "executable resilience/chaos.py fault plan JSON "
@@ -194,10 +201,11 @@ def main(argv=None):
     if not args.model and any(
         v is not None for v in (args.model_sites, args.model_rounds,
                                 args.model_faults, args.model_plans,
-                                args.model_staleness)
+                                args.model_staleness, args.model_elastic)
     ):
         print("--model-sites/--model-rounds/--model-faults/--model-plans/"
-              "--model-staleness require --model", file=sys.stderr)
+              "--model-staleness/--model-elastic require --model",
+              file=sys.stderr)
         return 2
     if args.model_sites is not None and args.model_sites < 1:
         print(f"--model-sites {args.model_sites}: need at least 1 site",
@@ -325,6 +333,9 @@ def main(argv=None):
             staleness = (
                 (0, args.model_staleness) if args.model_staleness else (0,)
             )
+        elastic = defaults.elastic
+        if args.model_elastic is not None:
+            elastic = (False, True) if args.model_elastic else (False,)
         cfg = ModelConfig(
             sites=(args.model_sites if args.model_sites is not None
                    else defaults.sites),
@@ -333,6 +344,7 @@ def main(argv=None):
             max_faults=(args.model_faults if args.model_faults is not None
                         else defaults.max_faults),
             staleness=staleness,
+            elastic=elastic,
         )
         result = run_model_check(config=cfg, plans_dir=args.model_plans)
         model_findings = result.findings

@@ -83,7 +83,25 @@ FAULT_ALPHABET = (
     # and refuse anything deeper.  Scheduled only in scenarios whose
     # run-ahead depth d is positive.
     "run_ahead",
+    # ISSUE 15: elastic membership — deterministic ROSTER transitions,
+    # not faults (they spend the separate membership budget): ``join``
+    # admits the scenario's spare non-member slot mid-run (the joiner's
+    # first invocation executes the local join entry and its first
+    # contribution is due the FOLLOWING round, exactly once), ``leave``
+    # retires a member gracefully (its flagged final contribution still
+    # counts, then the roster shrinks — never a dropped site), and
+    # ``rejoin`` re-admits a dead or left site with a fresh incarnation
+    # whose epoch bump is the refusal boundary for any payload out of the
+    # previous life.  Scheduled only in elastic scenarios on trees whose
+    # aggregator runs the membership round step (facts.membership_checked).
+    "join", "leave", "rejoin",
 )
+
+#: elastic-membership roster transitions (ISSUE 15): scheduled from the
+#: separate ``max_membership`` budget so churn composes with the fault
+#: alphabet at the default fault budget (a death + a rejoin must fit in
+#: one trace)
+_MEMBERSHIP_ACTIONS = ("join", "leave", "rejoin")
 
 #: model action -> replayable chaos fault-plan kind (worker actions map to
 #: the daemon engine's worker_kill fault with the matching kill point;
@@ -122,13 +140,37 @@ _WINDOW_ACCEPTS_BEYOND_K = False
 #: ``stale`` chaos plan.
 _WINDOW_ACCEPTS_BEYOND_RUN_AHEAD = False
 
+#: broken-roster semantics switches (tests only, ISSUE 15) — each pins one
+#: elastic-membership invariant as checkable, not vacuous:
+#:
+#: - ``_ROSTER_ACCEPTS_STALE_EPOCH``: a mis-implemented membership filter
+#:   accepts a payload from a NON-MEMBER (a gracefully left site's late
+#:   duplicate) or one whose roster-epoch echo predates the site's current
+#:   admission — the redelivery-out-of-a-dead-incarnation class only the
+#:   epoch refusal can catch (quorum's reappeared-site filter never sees a
+#:   graceful leaver: it was never dropped).  Flipping it makes
+#:   ROSTER fire with a replayable leave+stale churn plan.
+#: - ``_QUORUM_AGAINST_INIT_ROSTER``: quorum judged against the frozen
+#:   founding roster instead of the live member list — a graceful
+#:   retirement then counts as a death and erodes (or falsely fails) the
+#:   quorum.  Flipping it makes ROSTER fire with a replayable leave plan.
+#: - ``_JOIN_CONTRIBUTES_IN_ADMISSION_ROUND``: a mis-implemented engine
+#:   invokes the joiner in the SAME round its admission is processed — the
+#:   contribution lands twice around the r/r+1 boundary instead of exactly
+#:   once at r+1.  Flipping it makes ADMISSION fire with a replayable join
+#:   plan.
+_ROSTER_ACCEPTS_STALE_EPOCH = False
+_QUORUM_AGAINST_INIT_ROSTER = False
+_JOIN_CONTRIBUTES_IN_ADMISSION_ROUND = False
+
 #: broadcast-channel components a relay fault can target
 _COMPONENTS = ("payload", "manifest")
 
 MODEL_RULE_IDS = (
-    ModelCheck.CACHE, ModelCheck.CONFIG, ModelCheck.DEADLOCK,
+    ModelCheck.ADMISSION, ModelCheck.CACHE, ModelCheck.CONFIG,
+    ModelCheck.DEADLOCK,
     ModelCheck.LOST_CONTRIBUTION, ModelCheck.LOST_UPDATE,
-    ModelCheck.PHASE_RESET, ModelCheck.QUORUM,
+    ModelCheck.PHASE_RESET, ModelCheck.QUORUM, ModelCheck.ROSTER,
     ModelCheck.STALE_CONTRIBUTION, ModelCheck.UNRECOVERABLE,
     ModelCheck.VOLATILE, ModelCheck.WIRE,
 )
@@ -157,6 +199,13 @@ class ModelConfig:
     #: run-ahead depth dimension (ISSUE 14): d=0 is the blocking wire
     #: tail; d>0 widens the window to k + d and schedules ``run_ahead``
     run_ahead: tuple = (0, ModelCheck.DEFAULT_RUN_AHEAD)
+    #: elastic-membership dimension (ISSUE 15, ModelCheck.DEFAULT_ELASTIC):
+    #: elastic scenarios grow one spare non-member slot and schedule the
+    #: join/leave/rejoin roster transitions from ``max_membership``
+    elastic: tuple = (False, True)
+    #: roster-transition budget per trace, separate from ``max_faults`` —
+    #: a death (1 fault) + a rejoin (1 transition) must fit in one trace
+    max_membership: int = 2
 
     @property
     def engine_rounds(self):
@@ -175,30 +224,43 @@ class ModelResult:
 # All state is plain hashable tuples.
 #
 # site:   (alive, redeliver_rnd, applied_tag, cache_keys, any_write,
-#          had_comp, last_out)
-#         last_out = (phase, keys, contrib, echo_ok, made_rnd) — made_rnd
-#         is the engine round the output was produced in, so a stale
-#         delivery's echo lag (rnd - made_rnd) is judged against the
-#         scenario's staleness window
+#          had_comp, last_out, adm, first_rnd)
+#         last_out = (phase, keys, contrib, echo_ok, made_rnd, epoch_echo)
+#         — made_rnd is the engine round the output was produced in, so a
+#         stale delivery's echo lag (rnd - made_rnd) is judged against the
+#         scenario's staleness window; epoch_echo is the roster epoch the
+#         consumed broadcast carried (the membership filter's refusal
+#         basis, ISSUE 15).  adm is the roster epoch the site was (last)
+#         admitted at, or None while it is not a member (never admitted,
+#         or gracefully retired); first_rnd is the round a mid-run
+#         joiner's first contribution is due (0 for founding members).
 # chan:   (payload_tag, manifest_tag, repairs)   repairs ⊆ {components}
-# remote: (cache_keys, any_write, dropped)
-# bcast:  (phase, keys, update_tag)
-# state:  (rnd, budget, sites, chans, remote, bcast, reduces)
-# scenario: (site_quorum, pretrain, staleness_k)
+# remote: (cache_keys, any_write, dropped, roster_epoch)
+# bcast:  (phase, keys, update_tag, roster_epoch)
+# state:  (rnd, budget, sites, chans, remote, bcast, reduces, mem_budget)
+# scenario: (site_quorum, pretrain, staleness_k, run_ahead, elastic)
 
-_FRESH_SITE = (True, 0, 0, frozenset(), False, False, None)
+_FRESH_SITE = (True, 0, 0, frozenset(), False, False, None, 1, 0)
+#: the elastic scenarios' spare slot: alive but never admitted — only the
+#: ``join`` action can make it a member
+_SPARE_SITE = (True, 0, 0, frozenset(), False, False, None, None, 0)
 _FRESH_CHAN = (0, 0, frozenset())
 
 
-def _initial_state(config):
-    n = int(config.sites)
+def _initial_state(config, elastic=False):
+    n = int(config.sites) + (1 if elastic else 0)
+    sites = tuple(
+        _SPARE_SITE if elastic and i == int(config.sites) else _FRESH_SITE
+        for i in range(n)
+    )
     return (
         1, int(config.max_faults),
-        tuple(_FRESH_SITE for _ in range(n)),
+        sites,
         tuple(_FRESH_CHAN for _ in range(n)),
-        (frozenset(), False, frozenset()),
+        (frozenset(), False, frozenset(), 1),
         None,
         0,
+        int(config.max_membership) if elastic else 0,
     )
 
 
@@ -343,6 +405,7 @@ class _Explorer:
                 "pretrain": bool(scenario[1]),
                 "staleness_k": int(scenario[2]) if len(scenario) > 2 else 0,
                 "run_ahead": int(scenario[3]) if len(scenario) > 3 else 0,
+                "elastic": bool(scenario[4]) if len(scenario) > 4 else False,
                 "engine_rounds": self.config.engine_rounds,
             },
             "faults": _plan_faults(trace, "avg_grads.npy",
@@ -351,10 +414,11 @@ class _Explorer:
         quorum = scenario[0]
         k = int(scenario[2]) if len(scenario) > 2 else 0
         d_ra = int(scenario[3]) if len(scenario) > 3 else 0
+        elastic = bool(scenario[4]) if len(scenario) > 4 else False
         msg = (
             f"{message} — counterexample: site_quorum={quorum}, "
             f"pretrain={bool(scenario[1])}, staleness_k={k}, "
-            f"run_ahead={d_ra}, "
+            f"run_ahead={d_ra}, elastic={elastic}, "
             f"faults=[{trace.describe()}] "
             f"(bound: {self.config.sites} sites x {self.config.rounds} "
             f"rounds, budget {self.config.max_faults}); replayable chaos "
@@ -385,7 +449,8 @@ class _Explorer:
                      msg_keys, steady, scenario, trace):
         """Run a node invocation's IR events: cache lifecycle checks, wire
         bookkeeping.  Returns (produced keys, new cache, new any_write)."""
-        alive, redeliver, applied, cache, any_w, had_comp, last = state_site
+        alive, redeliver, applied, cache, any_w, had_comp, last = \
+            state_site[:7]
         produced = set()
         writers = node_ir.static_cache_writers()
         cache = set(cache)
@@ -445,27 +510,53 @@ class _Explorer:
                     rnd, quorum):
         """One site's turn.  Returns (site', chan', out or None,
         loud or None, violations already emitted)."""
-        alive, redeliver, applied, cache, any_w, had_comp, last = site
+        alive, redeliver, applied, cache, any_w, had_comp, last = site[:7]
+        adm, first_rnd = site[7], site[8]
+        tail = site[7:]
         my_faults = {a[0] for a in faults if a[1] == i}
         if not alive:
+            return site, chan, None, None
+        if adm is None:
+            # not a roster member (ISSUE 15): a retired or never-admitted
+            # site is not invoked — only a late duplicate of a LEFT
+            # site's final payload can still arrive (the stale action),
+            # which the aggregator's membership filter must refuse as a
+            # non-member contribution
+            if (my_faults & set(_STALE_ACTIONS)) and last is not None:
+                phase, keys, contrib, _, made = last[:5]
+                echo_e = last[5] if len(last) > 5 else None
+                return site, chan, (phase, keys, contrib, False, made,
+                                    echo_e), None
+            return site, chan, None, None
+        if first_rnd > rnd:
+            # admitted this very round: the joiner's first invocation
+            # (and first contribution) is due NEXT round — its absence
+            # from this round's input is the joining grace, not a drop
             return site, chan, None, None
         if my_faults & {"crash", "hang", "reappear"}:
             if quorum is None:
                 return site, chan, None, "site failure without quorum"
             redeliver_rnd = rnd + 1 if "reappear" in my_faults else 0
             return ((False, redeliver_rnd, applied, cache, any_w, had_comp,
-                     last), chan, None, None)
+                     last) + tail, chan, None, None)
         if (my_faults & set(_STALE_ACTIONS)) and last is not None:
             # delayed duplicate / async stand-in: previous output
             # redelivered, cache frozen — the echo lag (rnd - made_rnd)
             # grows with every repeated firing
-            phase, keys, contrib, _, made = last
-            return site, chan, (phase, keys, contrib, False, made), None
+            phase, keys, contrib, _, made = last[:5]
+            echo_e = last[5] if len(last) > 5 else None
+            return site, chan, (phase, keys, contrib, False, made,
+                                echo_e), None
 
         incoming = bcast[0] if bcast else "init_runs"
         executed, out_phase = _local_dispatch(
             self.ir.local, incoming, scenario[1]
         )
+        if first_rnd == rnd and "join" in self.ir.local.blocks:
+            # a mid-run joiner's FIRST invocation: the local join entry
+            # (the carved JOIN_BLOCK — fold adoption, warm start) executes
+            # before the steady-state dispatch, exactly once
+            executed = ["join"] + list(executed)
         msg_keys = bcast[1] if bcast else frozenset()
         steady = had_comp and incoming == "computation"
         # worker_crash / worker_restart (ISSUE 11): the site's DAEMON
@@ -484,16 +575,17 @@ class _Explorer:
         if "worker_crash" in my_faults:
             if _RESTART_REDELIVERS_LAST_OUTPUT:
                 if last is not None:
-                    phase, keys, contrib, _, made = last
+                    phase, keys, contrib, _, made = last[:5]
+                    echo_e = last[5] if len(last) > 5 else None
                     return site, chan, (phase, keys, contrib, False,
-                                        made), None
+                                        made, echo_e), None
             else:
                 _, cache_crash, anyw_crash = self._exec_events(
                     self.ir.local, site, executed, incoming, msg_keys,
                     steady, scenario, trace,
                 )
                 site = (alive, redeliver, applied, cache_crash, anyw_crash,
-                        had_comp, last)
+                        had_comp, last) + tail
         produced, cache, any_w = self._exec_events(
             self.ir.local, site, executed, incoming, msg_keys, steady,
             scenario, trace,
@@ -538,7 +630,7 @@ class _Explorer:
                     if quorum is None:
                         return site, chan, None, "wire failure without quorum"
                     return ((False, 0, applied, cache, any_w, had_comp,
-                             last), chan, None, None)
+                             last) + tail, chan, None, None)
             if payload < update_tag:
                 self._emit(
                     ModelCheck.LOST_UPDATE, self._anchor(
@@ -556,6 +648,7 @@ class _Explorer:
 
         had_comp = had_comp or "computation" in executed
         contrib = rnd if "reduce" in produced else 0
+        epoch_now = bcast[3] if bcast and len(bcast) > 3 else 1
         if "run_ahead" in my_faults and last is not None:
             # run-ahead pipelining (ISSUE 14): the invocation ran in full
             # and its contribution is FRESH (contrib == rnd), but it was
@@ -563,29 +656,103 @@ class _Explorer:
             # — the echo stays pinned at the previous made-round and ages
             # one more round per consecutive firing, which is how the
             # seeded trace reaches the k + d boundary
-            out = (out_phase, frozenset(produced), contrib, False, last[4])
+            out = (out_phase, frozenset(produced), contrib, False, last[4],
+                   last[5] if len(last) > 5 else None)
         else:
-            out = (out_phase, frozenset(produced), contrib, True, rnd)
-        site = (alive, redeliver, applied, cache, any_w, had_comp, out)
+            out = (out_phase, frozenset(produced), contrib, True, rnd,
+                   epoch_now)
+        site = (alive, redeliver, applied, cache, any_w, had_comp,
+                out) + tail
         return site, chan, out, None
 
     def _remote_round(self, state, site_outs, stale_flags, scenario, trace):
-        """The aggregator's turn: quorum, lockstep guards, dispatch,
-        reduce bookkeeping.  Returns (remote', bcast or None, loud,
-        reduced)."""
-        rnd, budget, sites, chans, remote, bcast, reduces = state
+        """The aggregator's turn: membership filter, quorum, lockstep
+        guards, dispatch, reduce bookkeeping.  Returns (remote', bcast or
+        None, loud, reduced)."""
+        rnd, budget, sites, chans, remote, bcast, reduces = state[:7]
         quorum = scenario[0]
-        r_cache, r_any, dropped = remote
+        r_cache, r_any, dropped = remote[:3]
+        epoch = remote[3] if len(remote) > 3 else 1
         facts = self.ir.facts
-        roster = set(range(self.config.sites))
+        elastic = len(scenario) > 4 and bool(scenario[4])
+
+        # elastic membership (ISSUE 15): this round's roster transitions
+        # (trace entries carry them), the per-site ADMISSION epoch as the
+        # aggregator sees it after processing admissions at the TOP of its
+        # round (process_admissions runs before the payload filter), and
+        # the broadcast epoch (admission bumps ride this round's
+        # broadcast; graceful-leave bumps land after it)
+        mem_now = [(e[1], e[2]) for e in trace.entries
+                   if e[0] == rnd and e[1] in _MEMBERSHIP_ACTIONS]
+        adm_eff = {i: s[7] for i, s in enumerate(sites)}
+        pos = 0
+        for kind, i in mem_now:
+            if kind in ("join", "rejoin"):
+                pos += 1
+                adm_eff[i] = epoch + pos
+        epoch_out = epoch + pos
+        admitted_now = {i for kind, i in mem_now if kind != "leave"}
 
         filtered = dict(site_outs)
+        # ---- the membership filter (federation/membership.py): refuse
+        # payloads BY ROSTER EPOCH before quorum/lockstep/reduce see them
+        # — non-member outputs (a left site's late duplicate) and echoes
+        # older than the site's current admission (a redelivery out of a
+        # previous, dead incarnation racing its rejoin)
+        mem_refused = set()
+        if elastic and facts.membership_checked:
+            for i, out in sorted(site_outs.items()):
+                adm_i = adm_eff.get(i)
+                echo = out[5] if len(out) > 5 else None
+                nonmember = adm_i is None
+                stale_epoch = (
+                    adm_i is not None and echo is not None
+                    and int(echo) < int(adm_i)
+                )
+                if not (nonmember or stale_epoch):
+                    continue
+                refuses = (
+                    not _ROSTER_ACCEPTS_STALE_EPOCH
+                    and (nonmember or facts.roster_epoch_refusal)
+                )
+                if refuses:
+                    mem_refused.add(i)
+                    filtered.pop(i, None)
+
+        # quorum is judged against the LIVE roster: a gracefully retired
+        # site is gone from it (never a death), a never-admitted spare
+        # was never in it.  _QUORUM_AGAINST_INIT_ROSTER (tests only)
+        # models the grow-only bug: the founding roster stays frozen and
+        # a retirement erodes quorum like a death.
+        members = {i for i, s in enumerate(sites) if s[7] is not None}
+        retired = {
+            i for i, s in enumerate(sites)
+            if s[7] is None and s[0] and (s[5] or s[6] is not None)
+        }
+        roster = (
+            set(range(self.config.sites)) if _QUORUM_AGAINST_INIT_ROSTER
+            else set(members)
+        )
         if facts.quorum_checked:
             returned = dropped & set(site_outs)
             if returned and facts.quorum_filters_reappeared:
                 for i in returned:
                     filtered.pop(i, None)
-            missing = (roster - set(site_outs)) | dropped
+            delivered = set(site_outs) - {
+                i for i in mem_refused if adm_eff.get(i) is not None
+            }
+            missing = (roster - delivered) | dropped
+            if quorum and (missing & retired):
+                self._emit(
+                    ModelCheck.ROSTER,
+                    self._anchor("membership", self.ir.remote),
+                    "quorum is computed against a stale roster: a "
+                    "gracefully retired site is still counted as a "
+                    "missing member, so its leave erodes quorum exactly "
+                    "like a death (the roster the quorum policy reads "
+                    "was frozen at INIT)",
+                    scenario, trace, "quorum against the live roster",
+                )
             new_drops = missing - dropped
             if new_drops:
                 if not quorum:
@@ -593,10 +760,19 @@ class _Explorer:
                 if len(filtered) < max(int(quorum), 1):
                     return remote, None, "quorum unmet", False
                 dropped = frozenset(dropped | new_drops)
+        # the reducer/trainer input snapshot: membership refusals reach it
+        # only when the membership step ran BEFORE the snapshot (its own
+        # ordering fact, independent of the quorum one) — otherwise the
+        # refused payloads still reach the reduce (the
+        # proto-model-stale-contribution ordering hazard, roster flavor)
+        base_input = (
+            {i: o for i, o in site_outs.items() if i not in mem_refused}
+            if facts.membership_before_reduce_input else dict(site_outs)
+        )
         reducer_input = (
             filtered if (facts.quorum_checked
                          and facts.quorum_before_reduce_input)
-            else dict(site_outs)
+            else base_input
         )
 
         phases = {out[0] for out in filtered.values()}
@@ -751,6 +927,81 @@ class _Explorer:
                         "the site is alive and participating",
                         scenario, trace, "exactly-once contributions",
                     )
+            # ---- roster soundness (ISSUE 15): nothing from a non-member
+            # epoch may enter the reduce
+            if elastic:
+                for i, out in sorted(reducer_input.items()):
+                    if "reduce" not in out[1]:
+                        continue
+                    adm_i = adm_eff.get(i)
+                    echo = out[5] if len(out) > 5 else None
+                    if adm_i is None:
+                        self._emit(
+                            ModelCheck.ROSTER,
+                            self._anchor("membership_filter",
+                                         self.ir.remote),
+                            f"the reduce consumes a payload from site_{i}, "
+                            "which is NOT a roster member this round (a "
+                            "gracefully retired site's late duplicate) — "
+                            "only the membership filter can refuse it: the "
+                            "quorum machinery never dropped the site, so "
+                            "the reappeared-site filtering does not apply",
+                            scenario, trace, "no non-member contributions",
+                        )
+                    elif echo is not None and int(echo) < int(adm_i):
+                        self._emit(
+                            ModelCheck.ROSTER,
+                            self._anchor("membership_filter",
+                                         self.ir.remote),
+                            f"the reduce consumes a payload from site_{i} "
+                            f"whose roster-epoch echo ({int(echo)}) "
+                            "predates the site's current admission "
+                            f"(epoch {int(adm_i)}) — a redelivery out of "
+                            "its previous, dead incarnation double-counts "
+                            "against the fresh one",
+                            scenario, trace, "epoch-refused incarnations",
+                        )
+                # a joiner admitted at round r contributes to round r+1's
+                # reduce exactly once — never to round r's
+                for i in sorted(admitted_now & set(reducer_input)):
+                    if reducer_input[i][2] == rnd:
+                        self._emit(
+                            ModelCheck.ADMISSION,
+                            self._anchor("admission", self.ir.local),
+                            f"site_{i} is admitted and contributes to the "
+                            f"SAME round-{rnd} reduce: the admission "
+                            "handshake requires the joiner's first "
+                            "contribution in round r+1 (exactly once) — "
+                            "an admission-round contribution lands twice "
+                            "around the r/r+1 boundary",
+                            scenario, trace, "joiner exactly-once",
+                        )
+                due = {
+                    i for i, s in enumerate(sites)
+                    if s[7] is not None and s[0] and s[8] == rnd
+                }
+                targeted = {e[2] for e in trace.entries if e[0] == rnd}
+                for i in sorted(due - set(site_outs) - targeted):
+                    self._emit(
+                        ModelCheck.ADMISSION,
+                        self._anchor("admission", self.ir.local),
+                        f"site_{i} was admitted last round and its first "
+                        f"contribution is due in round {rnd}, but the "
+                        "engine never invoked it and no fault explains "
+                        "the absence — the admission was lost",
+                        scenario, trace, "joiner exactly-once",
+                    )
+                if admitted_now and not facts.admission_exactly_once:
+                    self._emit(
+                        ModelCheck.ADMISSION,
+                        self._anchor("admission", self.ir.local),
+                        "the local join entry is not exactly-once: no "
+                        "negated cache sentinel guards the admission "
+                        "block, so a retry (or a re-broadcast admission "
+                        "record) re-runs the fold entry and resets the "
+                        "joiner's training state mid-run",
+                        scenario, trace, "joiner exactly-once",
+                    )
 
         # broadcast phase per the executed dispatch
         if executed:
@@ -763,51 +1014,101 @@ class _Explorer:
             out_phase = phase  # covered: non-reset fallthrough (round 1)
         update_tag = rnd if reduced else (bcast[2] if bcast else 0)
         keys = frozenset(produced)
-        remote = (r_cache, r_any, dropped)
-        return remote, (out_phase, keys, update_tag, reduced), None, reduced
+        # the broadcast carries the POST-admission epoch (the stamp every
+        # site echoes back); graceful-leave bumps land in _step, after the
+        # reduce counted the leaver's final contribution
+        remote = (r_cache, r_any, dropped, epoch_out)
+        return (remote, (out_phase, keys, update_tag, reduced, epoch_out),
+                None, reduced)
 
     # ---------------------------------------------------------------- rounds
     def _round_actions(self, state, scenario):
-        """Every single-fault action available this round, sorted.  The
-        ``staleness_k`` action only exists in scenarios whose window is
-        positive — at k=0 the async engine never stands a site in."""
-        rnd, budget, sites, chans, remote, bcast, reduces = state
-        if budget <= 0:
-            return []
+        """(fault singles, membership singles) available this round, each
+        sorted.  The ``staleness_k`` action only exists in scenarios whose
+        window is positive — at k=0 the async engine never stands a site
+        in.  Membership transitions spend the separate membership budget
+        and are scheduled only in elastic scenarios, in the steady state
+        (a COMPUTATION broadcast is out), on trees whose aggregator runs
+        the membership round step."""
+        rnd, budget, sites, chans, remote, bcast, reduces = state[:7]
+        mem_budget = state[7] if len(state) > 7 else 0
+        elastic = len(scenario) > 4 and bool(scenario[4])
         actions = []
-        for i, site in enumerate(sites):
-            if not site[0]:
-                continue
-            for kind in self.config.kinds:
-                if kind in ("drop_relay", "duplicate_delivery"):
-                    for comp in _COMPONENTS:
-                        actions.append((kind, i, comp))
-                elif kind in _STALE_ACTIONS:
-                    if site[6] is None:
-                        continue
-                    if kind == "staleness_k" and not scenario[2]:
-                        continue
-                    actions.append((kind, i))
-                elif kind == "run_ahead":
-                    # only in scenarios with a positive pipeline depth,
-                    # and only once the site has an output whose consumed
-                    # broadcast the echo can stay pinned at
-                    if site[6] is None:
-                        continue
-                    if len(scenario) < 4 or not scenario[3]:
-                        continue
-                    actions.append((kind, i))
-                else:
-                    actions.append((kind, i))
-        return sorted(actions)
+        if budget > 0:
+            for i, site in enumerate(sites):
+                if not site[0]:
+                    continue
+                adm, first_rnd = site[7], site[8]
+                if adm is None:
+                    # a retired (left) site is never invoked again: only
+                    # its late duplicate exists (the non-member refusal
+                    # path the membership filter patrols)
+                    if elastic and site[6] is not None and (
+                        "stale" in self.config.kinds
+                    ):
+                        actions.append(("stale", i))
+                    continue
+                if first_rnd > rnd:
+                    continue  # joining grace: not invocable yet
+                for kind in self.config.kinds:
+                    if kind in _MEMBERSHIP_ACTIONS:
+                        continue  # scheduled from the membership budget
+                    if kind in ("drop_relay", "duplicate_delivery"):
+                        for comp in _COMPONENTS:
+                            actions.append((kind, i, comp))
+                    elif kind in _STALE_ACTIONS:
+                        if site[6] is None:
+                            continue
+                        if kind == "staleness_k" and not scenario[2]:
+                            continue
+                        actions.append((kind, i))
+                    elif kind == "run_ahead":
+                        # only in scenarios with a positive pipeline depth,
+                        # and only once the site has an output whose
+                        # consumed broadcast the echo can stay pinned at
+                        if site[6] is None:
+                            continue
+                        if len(scenario) < 4 or not scenario[3]:
+                            continue
+                        actions.append((kind, i))
+                    else:
+                        actions.append((kind, i))
+        mems = []
+        steady = bcast is not None and bcast[0] == "computation"
+        if (elastic and mem_budget > 0 and steady
+                and self.ir.facts.membership_checked):
+            members_alive = [
+                i for i, s in enumerate(sites) if s[7] is not None and s[0]
+            ]
+            for i, site in enumerate(sites):
+                adm = site[7]
+                if site[0] and adm is None:
+                    # never admitted → join; gracefully left → rejoin
+                    kind = ("join" if site[6] is None and not site[5]
+                            else "rejoin")
+                    if kind in self.config.kinds:
+                        mems.append((kind, i))
+                elif not site[0] and adm is not None:
+                    # dead member: re-admission with a fresh incarnation
+                    if "rejoin" in self.config.kinds:
+                        mems.append(("rejoin", i))
+                elif (adm is not None and site[8] <= rnd
+                        and len(members_alive) > 1
+                        and "leave" in self.config.kinds):
+                    mems.append(("leave", i))
+        return sorted(actions), sorted(mems)
 
     def _step(self, state, actions, scenario, trace):
         """Execute one engine round under ``actions``.  Returns the new
         state, or None when the trace terminated (loudly or at bound)."""
-        rnd, budget, sites, chans, remote, bcast, reduces = state
+        rnd, budget, sites, chans, remote, bcast, reduces = state[:7]
+        mem_budget = state[7] if len(state) > 7 else 0
+        epoch_prev = remote[3] if len(remote) > 3 else 1
         quorum = scenario[0]
         trace = trace.extend(rnd, actions)
-        budget -= len(actions)
+        mem_actions = [a for a in actions if a[0] in _MEMBERSHIP_ACTIONS]
+        budget -= len(actions) - len(mem_actions)
+        mem_budget -= len(mem_actions)
 
         site_outs, stale_flags = {}, {}
         new_sites, new_chans = list(sites), list(chans)
@@ -827,10 +1128,31 @@ class _Explorer:
         # reappear redeliveries (death fired one round earlier)
         for i, site in enumerate(new_sites):
             if not site[0] and site[1] == rnd and site[6] is not None:
-                phase, keys, contrib, _, made = site[6]
-                site_outs[i] = (phase, keys, contrib, False, made)
+                phase, keys, contrib, _, made = site[6][:5]
+                echo_e = site[6][5] if len(site[6]) > 5 else None
+                site_outs[i] = (phase, keys, contrib, False, made, echo_e)
                 stale_flags[i] = True
                 new_sites[i] = site[:1] + (0,) + site[2:]
+
+        # a rejoin of a DEAD site races its old incarnation's redelivery
+        # into the very round the re-admission is processed — the
+        # worst-case interleaving the roster epoch exists to refuse
+        for kind, i in mem_actions:
+            if (kind == "rejoin" and not new_sites[i][0]
+                    and new_sites[i][6] is not None and i not in site_outs):
+                last = new_sites[i][6]
+                phase, keys, contrib, _, made = last[:5]
+                echo_e = last[5] if len(last) > 5 else None
+                site_outs[i] = (phase, keys, contrib, False, made, echo_e)
+                stale_flags[i] = True
+        if _JOIN_CONTRIBUTES_IN_ADMISSION_ROUND:
+            # broken-engine semantics (tests only): the joiner is invoked
+            # in the round its admission is processed — one round early
+            for kind, i in mem_actions:
+                if kind in ("join", "rejoin") and i not in site_outs:
+                    site_outs[i] = ("computation", frozenset({"reduce"}),
+                                    rnd, True, rnd, None)
+                    stale_flags[i] = False
 
         if not site_outs:
             self.report["terminal_loud"] += 1
@@ -847,10 +1169,38 @@ class _Explorer:
         if new_bcast is None:
             # a violating fall-through already emitted; stop the trace
             return None
-        out_phase, keys, update_tag, reduced = new_bcast
+        out_phase, keys, update_tag, reduced, epoch_bcast = new_bcast
         if out_phase == "success":
             self.report["terminal_success"] += 1
             return None
+
+        # ---- apply this round's roster transitions (ISSUE 15): the
+        # aggregator admitted joiners at the top of its round (the epoch
+        # bumps already ride the broadcast) and retires leavers AFTER the
+        # reduce counted their flagged final contribution
+        epoch_now = epoch_bcast
+        dropped_set = set(remote[2])
+        pos = 0
+        for kind, i in mem_actions:
+            if kind in ("join", "rejoin"):
+                pos += 1
+                s = new_sites[i]
+                # a fresh incarnation: fresh cache, alive, first
+                # contribution due next round; the old incarnation's
+                # last output remains only as refusal fodder for late
+                # duplicates, and its previous drop no longer applies
+                new_sites[i] = (True, 0, 0, frozenset(), False, False,
+                                s[6], epoch_prev + pos, rnd + 1)
+                dropped_set.discard(i)
+            elif kind == "leave" and i in site_outs and not stale_flags.get(
+                i
+            ):
+                # the flagged final contribution was delivered fresh and
+                # counted — retire the member (never a dropped site)
+                s = new_sites[i]
+                new_sites[i] = s[:7] + (None, 0)
+                epoch_now += 1
+        remote = (remote[0], remote[1], frozenset(dropped_set), epoch_now)
 
         # relay the broadcast files (the avg payload + its manifest)
         for i, site in enumerate(new_sites):
@@ -874,7 +1224,9 @@ class _Explorer:
                 new_chans[i] = (payload, manifest, frozenset(repairs))
         return (
             rnd + 1, budget, tuple(new_sites), tuple(new_chans), remote,
-            (out_phase, keys, update_tag), reduces + (1 if reduced else 0),
+            (out_phase, keys, update_tag, epoch_bcast),
+            reduces + (1 if reduced else 0),
+            mem_budget,
         )
 
     # ------------------------------------------------------------ exploration
@@ -883,9 +1235,18 @@ class _Explorer:
             for pretrain in self.config.pretrain:
                 for k in self.config.staleness:
                     for d_ra in self.config.run_ahead:
-                        self._explore_scenario(
-                            (quorum, pretrain, int(k), int(d_ra))
-                        )
+                        for el in self.config.elastic:
+                            if el and not (
+                                ModelCheck.DEFAULT_ELASTIC
+                                and self.ir.facts.membership_checked
+                            ):
+                                # the tree has no membership round step —
+                                # there is no roster to churn
+                                continue
+                            self._explore_scenario(
+                                (quorum, pretrain, int(k), int(d_ra),
+                                 bool(el))
+                            )
         findings = [f for f, _ in self.findings.values()]
         plans = [p for _, p in self.findings.values()]
         order = sorted(
@@ -896,7 +1257,10 @@ class _Explorer:
         return [findings[ix] for ix in order], [plans[ix] for ix in order]
 
     def _explore_scenario(self, scenario):
-        frontier = collections.deque([(_initial_state(self.config), _Trace())])
+        elastic = len(scenario) > 4 and bool(scenario[4])
+        frontier = collections.deque(
+            [(_initial_state(self.config, elastic), _Trace())]
+        )
         visited = set()
         bound = self.config.engine_rounds
         while frontier:
@@ -927,19 +1291,25 @@ class _Explorer:
                         scenario, trace, "deadlock freedom",
                     )
                 continue
-            singles = self._round_actions(state, scenario)
+            singles, mem_singles = self._round_actions(state, scenario)
             subsets = [()]
             # the whole remaining budget may be spent in ONE round: the
             # --model-faults contract is the simultaneous-fault tolerance
             # level verified, so no silent per-round cap
             for k in range(1, state[1] + 1):
                 subsets.extend(itertools.combinations(singles, k))
-            for actions in subsets:
-                nxt = self._step(state, actions, scenario, trace)
-                if nxt is not None:
-                    frontier.append(
-                        (nxt, trace.extend(state[0], actions))
-                    )
+            mem_subsets = [()]
+            mem_budget = state[7] if len(state) > 7 else 0
+            for k in range(1, mem_budget + 1):
+                mem_subsets.extend(itertools.combinations(mem_singles, k))
+            for fault_actions in subsets:
+                for mem_actions in mem_subsets:
+                    actions = fault_actions + mem_actions
+                    nxt = self._step(state, actions, scenario, trace)
+                    if nxt is not None:
+                        frontier.append(
+                            (nxt, trace.extend(state[0], actions))
+                        )
 
 
 # ------------------------------------------------------------- wire property

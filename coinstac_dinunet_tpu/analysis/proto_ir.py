@@ -48,10 +48,14 @@ from .protocol_flow import (
 )
 
 #: delegate modules whose wire events execute inside a node's COMPUTATION
-#: block (repo-relative path -> node role they belong to)
+#: block (repo-relative path -> node role they belong to).
+#: ``federation/membership.py`` is the aggregator's elastic-membership
+#: round processing (ISSUE 15): it consumes the ``leaving`` flag and the
+#: ``roster_epoch`` echo off each site's payload on the remote side.
 DELEGATE_FILES = {
     "parallel/learner.py": "local",
     "parallel/reducer.py": "remote",
+    "federation/membership.py": "remote",
 }
 
 #: methods whose ``return {literal: ...}`` dicts are wire payloads in the
@@ -127,6 +131,17 @@ class SemanticFacts:
     quorum_checked: bool = True
     quorum_filters_reappeared: bool = True
     quorum_before_reduce_input: bool = True
+    # elastic membership (ISSUE 15): the aggregator runs a membership
+    # round step (canonical ``_check_membership`` name, the same
+    # convention as ``_check_quorum``) before the reducer/trainer input
+    # snapshot, the membership filter refuses payloads by ROSTER EPOCH
+    # (an echo older than the site's current admission), and the local
+    # join entry is exactly-once (the admission block is guarded by a
+    # negated cache sentinel, so a retry after a completed join skips)
+    membership_checked: bool = True
+    membership_before_reduce_input: bool = True
+    roster_epoch_refusal: bool = True
+    admission_exactly_once: bool = True
     lockstep_phase_guard: bool = True
     round_lockstep_guard: bool = True
     # the round-stamp guard honors the async staleness WINDOW
@@ -255,8 +270,15 @@ def _delegate_events(role, enum_map, extra_modules=None):
                     fn, ast.Attribute
                 ):
                     # any input-rooted base counts, incl. the reducer's
-                    # per-site view ``self.input[s].get(K)``
-                    if _contains_input(fn.value):
+                    # per-site view ``self.input[s].get(K)`` — and the
+                    # per-site payload iteration variables the membership
+                    # delegate walks (``for site, site_vars in
+                    # input_dict.items()``), the same convention
+                    # _NodeModel._consume_anywhere honors
+                    if _contains_input(fn.value) or (
+                        isinstance(fn.value, ast.Name)
+                        and fn.value.id in ("site", "site_vars")
+                    ):
                         key = _resolve_key(node.args[0], enum_map)
                         if key:
                             consumes.append(
@@ -267,6 +289,53 @@ def _delegate_events(role, enum_map, extra_modules=None):
                     if key:
                         consumes.append(IREvent(key, "consume", node.lineno))
     return tuple(produces), tuple(consumes)
+
+
+#: the synthetic pseudo-phase holding the local join entry's events
+#: (ISSUE 15): the ``_join*`` method a joiner's FIRST invocation executes
+#: under the admission guard.  It is not a dispatch phase — the model
+#: checker executes it exactly once per admitted joiner, never on a
+#: steady-state re-invocation, so its one-shot cache writes (fold
+#: assignment, frozen args) do not trip the volatile-key rule.
+JOIN_BLOCK = "join"
+
+
+def _carve_join_block(module, blocks):
+    """Re-attribute the events of the local join-entry method (canonical
+    ``_join*`` name, the same convention as ``_check_quorum``) from
+    whatever dispatch region its call site sits in to the synthetic
+    :data:`JOIN_BLOCK`.  Returns (blocks, join_method_node or None)."""
+    methods = _find_class_methods(module.tree)
+    join_fn = next(
+        (fn for name, fn in sorted(methods.items())
+         if name.startswith("_join")), None
+    )
+    if join_fn is None:
+        return blocks, None
+    lo = join_fn.lineno
+    hi = getattr(join_fn, "end_lineno", lo) or lo
+    carved = {"produces": [], "consumes": [],
+              "cache_reads": [], "cache_writes": []}
+    new_blocks = {}
+    for phase, block in blocks.items():
+        kept = {}
+        for field in carved:
+            kept[field] = []
+            for e in getattr(block, field):
+                (carved if lo <= e.line <= hi else kept)[field].append(e)
+        new_blocks[phase] = dataclasses.replace(
+            block, **{f: tuple(v) for f, v in kept.items()}
+        )
+    if any(carved.values()):
+        new_blocks[JOIN_BLOCK] = PhaseBlock(
+            phase=JOIN_BLOCK, guard="input",
+            produces=tuple(carved["produces"]),
+            consumes=tuple(carved["consumes"]),
+            cache_reads=tuple(carved["cache_reads"]),
+            cache_writes=tuple(carved["cache_writes"]),
+            outgoing=(),
+        )
+    return new_blocks, join_fn
 
 
 def build_node_ir(module, role, enum_map=None, extra_delegates=None,
@@ -308,6 +377,10 @@ def build_node_ir(module, role, enum_map=None, extra_delegates=None,
             ),
             outgoing=tuple(sorted(model.outgoing.get(phase, ()))),
         )
+    # the local join entry is its own synthetic block (ISSUE 15) — carved
+    # BEFORE the delegate merge, whose events carry other files' lines
+    if role == "local":
+        blocks, _ = _carve_join_block(module, blocks)
     # delegate wire events execute inside the COMPUTATION block
     d_prod, d_cons = ((), ())
     if delegates or extra_delegates:
@@ -433,6 +506,21 @@ def extract_remote_facts(remote_module, facts):
                 facts.round_lockstep_window = True
             if marker in ("RUN_AHEAD", "run_ahead"):
                 facts.round_lockstep_run_ahead = True
+    # elastic membership (ISSUE 15): the membership round step (admission
+    # processing + the roster-epoch payload filter) must ALSO precede the
+    # reducer/trainer input snapshot — the same stale-contribution hazard
+    # the quorum ordering fact patrols, for rejoined incarnations
+    membership_line = next(
+        (ln for kind, name, ln in events
+         if kind == "call" and "membership" in name), None
+    )
+    facts.membership_checked = membership_line is not None
+    facts.membership_before_reduce_input = (
+        membership_line is not None
+        and (snapshot_line is None or membership_line < snapshot_line)
+    )
+    if membership_line is not None:
+        facts.anchors["membership"] = (remote_module.path, membership_line)
     if snapshot_line is not None:
         facts.anchors["reduce_input"] = (remote_module.path, snapshot_line)
     if quorum_line is not None:
@@ -456,6 +544,81 @@ def extract_remote_facts(remote_module, facts):
         )
         for n in ast.walk(cq)
     ) if cq is not None else False
+    return facts
+
+
+def extract_local_facts(local_module, facts):
+    """Local-side elastic-membership facts (ISSUE 15): is the join entry
+    exactly-once?  Marker: the ``_join*`` call site's enclosing ``if``
+    guard includes a NEGATED cache sentinel (``not self.cache.get(...)``)
+    — a retry after a completed join, or a re-broadcast admission record,
+    skips the entry instead of resetting the site's fold state."""
+    methods = _find_class_methods(local_module.tree)
+    join_fn = next(
+        (fn for name, fn in sorted(methods.items())
+         if name.startswith("_join")), None
+    )
+    if join_fn is None:
+        facts.admission_exactly_once = False
+        return facts
+    compute = methods.get("compute")
+    facts.admission_exactly_once = False
+    for node in ast.walk(compute) if compute is not None else ():
+        if not isinstance(node, ast.If):
+            continue
+        calls_join = any(
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == join_fn.name
+            for sub in ast.walk(node)
+        )
+        if not calls_join:
+            continue
+        facts.anchors["admission"] = (local_module.path, node.lineno)
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.UnaryOp) and isinstance(
+                sub.op, ast.Not
+            ) and any(
+                isinstance(s, ast.Attribute) and s.attr == "cache"
+                for s in ast.walk(sub.operand)
+            ):
+                facts.admission_exactly_once = True
+    return facts
+
+
+def extract_membership_facts(membership_source, facts, membership_path=None):
+    """Does the aggregator's membership filter refuse payloads BY ROSTER
+    EPOCH?  Marker: a function named ``refuses`` or ``filter_membership``
+    references the ``roster_epoch`` wire key (the ``ROSTER_EPOCH`` enum
+    member or its value) — the only witness that a rejoined site's fresh
+    contribution is distinguishable from a redelivery out of its previous,
+    dead incarnation."""
+    try:
+        tree = ast.parse(membership_source)
+    except SyntaxError:
+        facts.roster_epoch_refusal = False
+        return facts
+    facts.roster_epoch_refusal = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in (
+            "refuses", "filter_membership"
+        ):
+            facts.anchors.setdefault("membership_filter", (
+                membership_path
+                or "coinstac_dinunet_tpu/federation/membership.py",
+                node.lineno,
+            ))
+            for sub in ast.walk(node):
+                marker = None
+                if isinstance(sub, ast.Attribute):
+                    marker = sub.attr
+                elif isinstance(sub, ast.Constant) and isinstance(
+                    sub.value, str
+                ):
+                    marker = sub.value
+                if marker in ("ROSTER_EPOCH", "roster_epoch",
+                              "admitted_epoch"):
+                    facts.roster_epoch_refusal = True
     return facts
 
 
@@ -545,7 +708,13 @@ def build_protocol_ir(local_module=None, remote_module=None,
     if facts is None:
         facts = SemanticFacts()
         extract_remote_facts(remote_module, facts)
+        extract_local_facts(local_module, facts)
         extract_chaos_facts(chaos_source, facts)
+        membership_path = os.path.join(root, "federation", "membership.py")
+        if os.path.exists(membership_path):
+            extract_membership_facts(_read_source(membership_path), facts)
+        else:
+            facts.roster_epoch_refusal = False
         engine_path = os.path.join(root, "engine.py")
         if os.path.exists(engine_path):
             extract_engine_facts(_read_source(engine_path), facts)

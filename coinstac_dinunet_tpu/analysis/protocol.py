@@ -50,6 +50,9 @@ PROTOCOL_FILES = {
     "parallel/powersgd.py": None,  # Learner + Reducer classes, split per class
     "parallel/rankdad.py": None,
     "parallel/reducer.py": "agg",
+    # elastic membership (ISSUE 15): the aggregator's roster rounds
+    # consume the ``leaving`` flag + ``roster_epoch`` echo per site
+    "federation/membership.py": "agg",
     "trainer.py": "site",
 }
 

@@ -92,6 +92,17 @@ class LocalWire(_StrEnum):
     # message (``COINNRemote._check_lockstep_phases``; the
     # ``proto-model-stale-contribution`` invariant of ``dinulint --model``)
     ROUND = "wire_round"
+    # the aggregator's roster epoch echoed back verbatim (ISSUE 15 elastic
+    # membership): a payload produced before the site's current
+    # (re-)admission echoes an epoch OLDER than its admitted one — the only
+    # way the aggregator can tell a rejoined site's fresh contribution from
+    # a redelivery out of its previous, dead incarnation
+    # (``federation/membership.py``; the ``proto-model-roster`` invariant)
+    ROSTER_EPOCH = "roster_epoch"
+    # graceful-leave flag on a site's FINAL contribution: the reducer
+    # counts the payload, then the aggregator retires the site from the
+    # roster (epoch bump) — never a ``site_died``, never a retry cycle
+    LEAVING = "leaving"
 
 
 class RemoteWire(_StrEnum):
@@ -118,6 +129,15 @@ class RemoteWire(_StrEnum):
     # incremented every aggregator invocation, broadcast to every site,
     # and required to come back uniform — lockstep-at-most-once delivery
     ROUND = "wire_round"
+    # the membership roster's version counter (see
+    # :attr:`LocalWire.ROSTER_EPOCH`): bumped on every join/leave/rejoin,
+    # broadcast alongside ``wire_round``, echoed back verbatim
+    ROSTER_EPOCH = "roster_epoch"
+    # mid-run admission records for joining sites ({site: admission dict}):
+    # the joiner's run assignment (fold/seed/target_batches/cursor sync) +
+    # the roster epoch it was admitted at — consumed exactly once by the
+    # joiner's first invocation (``nodes/local.py`` join entry)
+    ADMISSIONS = "admissions"
 
 
 class MeshAxis:
@@ -390,6 +410,59 @@ class Federation:
     WIRE_MMAP = "wire_mmap"
 
 
+class Membership:
+    """Vocabulary for elastic membership (ISSUE 15 —
+    :mod:`coinstac_dinunet_tpu.federation.membership`): sites join, leave
+    and rejoin mid-run under an aggregator-owned **roster epoch**.
+
+    Plain ``str`` constants, mirroring :class:`Retry`.  Three families:
+
+    Cache keys:
+
+    - ``ROSTER`` — the aggregator's versioned membership record
+      (``{"epoch", "members": {site: admitted_epoch}, "left", "dead"}``),
+      owned by :class:`~..federation.membership.MembershipRoster` and
+      round-tripped through the JSON cache like every other protocol
+      state.  ``cache['all_sites']`` mirrors the CURRENT member list so
+      quorum is always judged against the live roster, not the INIT one.
+    - ``REQUESTS`` — the engine→aggregator membership request queue
+      (``[{"op": "join"|"rejoin", "site", "sync": {...}}]``): the engine
+      appends admission requests between invocations (the same channel it
+      pre-seeds ``all_sites`` on) and the aggregator consumes them at the
+      top of its next COMPUTATION round, bumping the epoch per admission.
+    - ``CAPACITY_WEIGHT`` — opt-in capacity-aware reduce weighting
+      (ROADMAP 3b seed, ``parallel/reducer.py``): scale each site's
+      participation weight by its observed throughput (the HEALTH
+      rollup's per-site ``samples_per_sec``) normalized by the round's
+      mean, composing with the survivor/staleness/quarantine weighting.
+      Off by default; identical to uniform when capacities are equal.
+    - ``SITE_CAPACITY`` — the aggregator's per-site observed-throughput
+      record ({site: samples/sec}), refreshed from each HEALTH rollup —
+      the capacity weighting's data source.
+
+    Event names (engine + aggregator lanes; the live board's roster line,
+    ``/metrics`` ``membership_changes_total{kind=}`` and the CI
+    ``--assert-event`` gate read them):
+
+    - ``EVENT_JOIN`` / ``EVENT_LEAVE`` / ``EVENT_REJOIN`` — one roster
+      transition each, carrying the new epoch + member count (and the
+      quorum need when a policy is configured).
+    - ``EVENT_REFUSED`` — a payload refused by roster epoch: it echoed an
+      epoch older than the site's current admission (a redelivery out of
+      a previous incarnation) or arrived from a non-member.
+    """
+
+    ROSTER = "roster"
+    REQUESTS = "membership_requests"
+    CAPACITY_WEIGHT = "capacity_weight"
+    SITE_CAPACITY = "site_capacity"
+
+    EVENT_JOIN = "membership:join"
+    EVENT_LEAVE = "membership:leave"
+    EVENT_REJOIN = "membership:rejoin"
+    EVENT_REFUSED = "membership:refused"
+
+
 class Perf:
     """Cache-key vocabulary for the perf flight recorder
     (:mod:`coinstac_dinunet_tpu.telemetry.perf`).
@@ -478,6 +551,10 @@ class Live:
       ``pipeline:stall`` event), so the wire tail is gating compute
       again.  Re-arms when a later round's reduce completes concurrently
       with site compute.
+    - ``VERDICT_QUORUM_EROSION`` — under elastic membership the live
+      roster eroded to within ``QUORUM_HEADROOM`` members of the
+      configured ``site_quorum`` need: one more leave/death fails the
+      run.  Re-arms when joins/rejoins rebuild the headroom.
 
     ``PROM_PREFIX`` is the stable prefix of every exported Prometheus
     metric name (``coinstac_dinunet_<series>``); renaming it breaks every
@@ -491,6 +568,8 @@ class Live:
     MFU_COLLAPSE = "watch_mfu_collapse"
     RETRY_STORM = "watch_retry_storm"
     RETRY_WINDOW = "watch_retry_window_s"
+    #: members above the quorum need below which quorum_erosion fires
+    QUORUM_HEADROOM = "watch_quorum_headroom"
     PROM_PREFIX = "coinstac_dinunet"
     VERDICT_SILENCE = "heartbeat_silence"
     VERDICT_ROUND_OUTLIER = "round_duration_outlier"
@@ -498,6 +577,7 @@ class Live:
     VERDICT_RETRY_STORM = "wire_retry_storm"
     VERDICT_STALENESS = "staleness_exceeded"
     VERDICT_PIPELINE = "pipeline_stall"
+    VERDICT_QUORUM_EROSION = "quorum_erosion"
 
 
 class Daemon:
@@ -564,8 +644,10 @@ class Capture:
 # Keys a node reads from ``input`` that the ENGINE/compspec injects on the
 # first invocation (not part of the local↔remote handshake); the
 # protocol-conformance rule treats reads of these as engine-provided rather
-# than consumed-but-never-produced.
-ENGINE_PROVIDED_KEYS = ("task_id", "data_conf")
+# than consumed-but-never-produced.  ``leave`` asks a site to flag its next
+# contribution as its graceful last one; ``membership_sync`` asks a member
+# to ship its live weights for a joiner's warm start (ISSUE 15).
+ENGINE_PROVIDED_KEYS = ("task_id", "data_conf", "leave", "membership_sync")
 
 
 #: The canonical invocation-per-round phase machine: which :class:`Phase`
@@ -629,6 +711,14 @@ class ModelCheck:
       and volatile-key hygiene over the explored executions.
     - ``WIRE`` — every wire key produced on an explored path is consumed
       on some reachable path.
+    - ``ROSTER`` / ``ADMISSION`` — elastic-membership soundness (the
+      ``join``/``leave`` actions, ISSUE 15): no contribution from a
+      non-member epoch ever enters a reduce (a left/dead incarnation's
+      redelivery must be refused by roster epoch), quorum is computed
+      against the CURRENT roster (never a stale INIT one), and a joiner
+      admitted at round r is admitted exactly once and contributes to
+      round r+1's reduce exactly once.  Counterexamples replay as
+      :func:`~..resilience.chaos.churn_plan`-style membership plans.
     """
 
     DEFAULT_SITES = 2
@@ -644,6 +734,10 @@ class ModelCheck:
     # ``run_ahead`` action (a FRESH contribution whose wire_round echo
     # lags by the pipeline depth)
     DEFAULT_RUN_AHEAD = 1
+    # elastic-membership dimension (ISSUE 15): every bound is explored
+    # with the roster fixed AND with one spare non-member slot + the
+    # ``join``/``leave`` actions in the alphabet
+    DEFAULT_ELASTIC = True
 
     DEADLOCK = "proto-model-deadlock"
     PHASE_RESET = "proto-model-phase-reset"
@@ -656,6 +750,8 @@ class ModelCheck:
     VOLATILE = "proto-model-volatile"
     WIRE = "proto-model-wire"
     CONFIG = "proto-model-config"
+    ROSTER = "proto-model-roster"
+    ADMISSION = "proto-model-admission"
 
 
 class Concurrency:

@@ -30,6 +30,8 @@ from .config.keys import (
     Federation,
     Key,
     Live,
+    LocalWire,
+    Membership,
     Metric,
     Mode,
     Phase,
@@ -76,6 +78,7 @@ _RUN_AHEAD_STRIP = (
     RemoteWire.SAVE_CURRENT_AS_BEST.value,
     RemoteWire.PRETRAINED_WEIGHTS.value,
     RemoteWire.HEALTH.value,
+    RemoteWire.ADMISSIONS.value,
 )
 
 #: broadcast keys that make a round ineligible for run-ahead: multi-
@@ -229,6 +232,25 @@ class InProcessEngine:
         self.last_remote_out = {}
         self.dead_sites = set()
         self.site_failures = {}
+        # elastic membership (ISSUE 15, federation/membership.py): sites
+        # gracefully retired (never invoked again — distinct from dead:
+        # their exit cost no retry cycle and fired no site_died), joins
+        # queued via add_site but not yet requested from the aggregator,
+        # joins whose admission request is on the wire, leaves queued via
+        # remove_site (the site's next input carries the ``leave`` flag),
+        # and the member asked to ship warm-start weights this round
+        self.left_sites = set()
+        self._pending_join = {}       # site -> "join" | "rejoin"
+        self._awaiting_admission = {}  # site -> "join" | "rejoin"
+        self._pending_leave = set()
+        self._sync_donors = set()
+        # churn-plan ops racing an in-flight transition on the same site
+        # (a leave while its rejoin admission is on the wire, a rejoin
+        # while its graceful leave is pending) are deferred — re-tried at
+        # the next round's churn hook — never skipped: admission takes
+        # rounds, and a per-round plan schedules against the INTENDED
+        # roster, not the in-flight one
+        self._deferred_ops = []
         # per-site last round output, kept for the chaos replay faults
         # (``stale`` replays it in place of a fresh invocation; ``reappear``
         # redelivers a dead site's last message one round after its death)
@@ -286,7 +308,292 @@ class InProcessEngine:
 
     # --------------------------------------------------------- site dropout
     def _alive_site_ids(self):
-        return [s for s in self.site_ids if s not in self.dead_sites]
+        return [
+            s for s in self.site_ids
+            if s not in self.dead_sites and s not in self.left_sites
+        ]
+
+    # ----------------------------------------------- elastic membership (15)
+    def add_site(self, site_id=None, site_args=None, first_input=None):
+        """Queue a mid-run JOIN (or rejoin of a dead/left site).  The site
+        is provisioned now (directories, fresh cache, state) but becomes
+        invocable only after the aggregator's admission handshake: at the
+        next steady-state round the engine submits an admission request
+        (``cache['membership_requests']``) carrying a donor member's
+        round-alignment sync and asks that donor to ship its live weights
+        (``membership_sync``); when the admission record comes back on the
+        broadcast (:attr:`~.config.keys.RemoteWire.ADMISSIONS`), the
+        joiner is activated and invoked from the following round — so a
+        joiner admitted at round r contributes to round r+1's reduce,
+        exactly once.  Returns the site id."""
+        if site_id is None:
+            ix = len(self.site_ids)
+            while f"site_{ix}" in self.site_states:
+                ix += 1
+            site_id = f"site_{ix}"
+        site_id = str(site_id)
+        if (site_id in self._alive_site_ids()
+                or site_id in self._pending_join
+                or site_id in self._awaiting_admission):
+            raise ValueError(f"{site_id} is already a member (or joining)")
+        rejoin = site_id in self.dead_sites or site_id in self.left_sites
+        base = os.path.join(self.workdir, site_id)
+        xfer = os.path.join(self.workdir, "remote_base", site_id)
+        outd = os.path.join(base, "out")
+        for d in (base, xfer, outd):
+            os.makedirs(d, exist_ok=True)
+        self.site_states[site_id] = {
+            "baseDirectory": base,
+            "outputDirectory": outd,
+            "transferDirectory": xfer,
+            "clientId": site_id,
+        }
+        # a fresh incarnation: any state of a previous life is gone (the
+        # whole reason its old payloads must be refused by roster epoch)
+        self.site_caches[site_id] = {}
+        self.site_inputs.setdefault(site_id, {})
+        if site_args:
+            self.site_args[site_id] = dict(site_args)
+        fi = getattr(self, "first_input", None)
+        if fi is not None:
+            if first_input is not None:
+                fi[site_id] = dict(first_input)
+            elif site_id not in fi and fi:
+                # fresh-process engines resolve node args via first_input:
+                # a joiner inherits the consortium template by default
+                fi[site_id] = dict(next(iter(fi.values())))
+            self._first_done.discard(site_id)
+        self._pending_join[site_id] = "rejoin" if rejoin else "join"
+        return site_id
+
+    def remove_site(self, site_id, graceful=True):
+        """Remove a member mid-run.  ``graceful`` (default) injects the
+        ``leave`` flag into the site's next round input: it computes one
+        final flagged contribution, the reducer counts it, the aggregator
+        retires it (roster epoch bump) and the engine never invokes it
+        again — no ``site_died``, no retry cycle.  ``graceful=False``
+        drops the site immediately (the quorum machinery treats it like a
+        death, minus the failed invocation)."""
+        site_id = str(site_id)
+        if site_id not in self._alive_site_ids():
+            raise ValueError(f"{site_id} is not an alive member")
+        if graceful:
+            self._pending_leave.add(site_id)
+            return
+        self.dead_sites.add(site_id)
+        self.site_failures[site_id] = "removed by operator"
+        self._recorder().event(
+            "site_died", cat="quorum", site=site_id,
+            error="removed by operator", attempts=0,
+            retries_exhausted=False,
+        )
+
+    def _membership_steady(self):
+        """True when the federation is in the steady state a join can be
+        admitted into: the last broadcast is a COMPUTATION round and every
+        broadcast mode is TRAIN — the joiner then enters mid-epoch in
+        lockstep (barrier/transition rounds defer the admission)."""
+        out = self.last_remote_out or {}
+        if out.get(RemoteWire.PHASE.value) != Phase.COMPUTATION.value:
+            return False
+        modes = set(
+            (out.get(RemoteWire.GLOBAL_MODES.value) or {}).values()
+        )
+        return not modes or modes == {Mode.TRAIN.value}
+
+    def _apply_membership_op(self, kind, site):
+        """One churn-plan op against the live roster.  Returns True when
+        the op is applied (or already satisfied), False when it must be
+        DEFERRED — the same site has a transition in flight (admission on
+        the wire, leave pending) that this op's precondition waits on.
+        Raises ValueError only for genuine plan bugs (an op no amount of
+        waiting can satisfy)."""
+        in_flight_join = (site in self._pending_join
+                          or site in self._awaiting_admission)
+        if kind == "leave":
+            # the in-flight check MUST come first: a rejoining site still
+            # sits in left_sites until its admission activates, and the
+            # already-left fast path would silently swallow this NEW leave
+            if in_flight_join:
+                return False  # joining: let the admission land first
+            if site in self.left_sites or site in self._pending_leave:
+                return True   # already left / leaving
+            self.remove_site(site, graceful=True)
+            return True
+        # join / rejoin
+        if in_flight_join:
+            return True       # already on its way in
+        if site in self._pending_leave:
+            return False      # leaving: let the retirement land first
+        if site in self._alive_site_ids():
+            return True       # already a member — nothing to admit
+        self.add_site(site)
+        return True
+
+    def _membership_round(self, rnd, rec):
+        """The engine's churn hook, run at the top of every round: apply
+        the chaos churn plan's join/leave/rejoin ops
+        (:meth:`~.resilience.chaos.ChaosSession.membership_ops`) plus any
+        ops deferred behind an in-flight transition, activate joiners
+        whose admission arrived on the last broadcast, and submit pending
+        admission requests during the steady state.  Under run-ahead
+        pipelining any membership activity first drains the in-flight
+        reduces — a membership round is a barrier."""
+        ops = self._deferred_ops + list(self.chaos.membership_ops(rnd, rec))
+        self._deferred_ops = []
+        for kind, site in ops:
+            try:
+                if not self._apply_membership_op(kind, site):
+                    self._deferred_ops.append((kind, site))
+            except ValueError as exc:
+                # a churn plan op racing the roster (double-join, leave of
+                # a dead site) is a plan bug worth surfacing, not a crash
+                logger.warn(f"churn plan op {kind}@{site} skipped: {exc}")
+        pending = (self._pending_join or self._awaiting_admission
+                   or self._pending_leave)
+        if pending and self._reduce_pending:
+            self._pipeline_drain(rec, reason="membership")
+        admissions = (
+            (self.last_remote_out or {}).get(RemoteWire.ADMISSIONS.value)
+            or {}
+        )
+        for s in sorted(set(self._awaiting_admission) & set(admissions)):
+            self._activate_joiner(s, rec)
+        if self._pending_join and self._membership_steady():
+            donor = next(iter(self._alive_site_ids()), None)
+            if donor is not None:
+                reqs = self.remote_cache.setdefault(Membership.REQUESTS, [])
+                for s in sorted(self._pending_join):
+                    sync = {
+                        k: self.site_caches.get(donor, {}).get(k)
+                        for k in ("cursor", "epoch", "mode")
+                    }
+                    reqs.append({
+                        "op": self._pending_join[s], "site": s,
+                        "sync": {
+                            k: v for k, v in sync.items() if v is not None
+                        },
+                    })
+                    self._awaiting_admission[s] = self._pending_join[s]
+                self._pending_join = {}
+                # the same round's donor invocation ships the live weights
+                # the admission broadcast relays to the joiner's warm start
+                self._sync_donors.add(donor)
+
+    def _activate_joiner(self, s, rec):
+        """The admission record for ``s`` arrived: the site becomes a
+        live member.  The admission broadcast's files were relayed before
+        the joiner was invocable, so the aggregator's outbox is replayed
+        into its inbox here (catch-up relay), and its input is the
+        admission broadcast itself — its first invocation enters at the
+        steady-state COMPUTATION phase (``nodes/local.py`` join entry)."""
+        op = self._awaiting_admission.pop(s, "join")
+        rejoin = op == "rejoin" or s in self.dead_sites or s in self.left_sites
+        self.dead_sites.discard(s)
+        self.left_sites.discard(s)
+        self.site_failures.pop(s, None)
+        if s not in self.site_ids:
+            self.site_ids.append(s)
+        # fresh-incarnation bookkeeping: no replay record, no async
+        # staleness history, no run-ahead depth may survive a rejoin
+        self._last_site_outs.pop(s, None)
+        self._async_last_sub.pop(s, None)
+        self._async_consumed.pop(s, None)
+        self._run_ahead_depth.pop(s, None)
+        self._async_snapshots.pop(s, None)
+        self._async_snap_gen.pop(s, None)
+        self._async_snap_files.pop(s, None)
+        with self._async_hist_lock:
+            self._async_invoke_hist.pop(s, None)
+            self._async_warm.discard(s)
+        self._relay_to_site(s)
+        self.site_inputs[s] = dict(self.last_remote_out)
+        self._sync_admission(s)
+        # no membership:* event here: the aggregator's admission
+        # (membership.process_admissions) already emitted the one
+        # roster-transition event — a second engine-lane emission would
+        # double-count membership_changes_total and feed the live plane a
+        # conflicting members= semantics (alive count vs roster size)
+        logger.warn(
+            f"membership: {s} {'re-joined' if rejoin else 'joined'} the "
+            f"federation ({len(self._alive_site_ids())} alive members)"
+        )
+
+    def _sync_admission(self, s):
+        """Refresh the joiner's admission sync to a donor member's CURRENT
+        round alignment (cursor/epoch/mode) at activation time.  The
+        request-time sync the admission broadcast carried is one wire
+        round stale by the time the joiner's first invocation runs (the
+        aggregator processed the admission during that round), and a
+        one-step cursor skew would phase-shift the joiner's epoch barrier
+        against the federation forever — the engine owns round alignment,
+        so it re-stamps the sync with the donor's live cache here."""
+        admissions = dict(
+            self.site_inputs[s].get(RemoteWire.ADMISSIONS.value) or {}
+        )
+        adm = dict(admissions.get(s) or {})
+        if not adm:
+            return
+        donor = next(
+            (x for x in self._alive_site_ids() if x != s), None
+        )
+        if donor is None:
+            return
+        dc = self.site_caches.get(donor) or {}
+        for k in ("cursor", "epoch", "mode"):
+            if dc.get(k) is not None:
+                adm[k] = dc[k]
+        admissions[s] = adm
+        self.site_inputs[s][RemoteWire.ADMISSIONS.value] = admissions
+
+    def _relay_to_site(self, s):
+        """Catch-up relay for a freshly activated joiner: the aggregator's
+        whole outbox, manifest last (the same ordering contract as
+        :meth:`_relay_broadcast`)."""
+        xfer = self.remote_state["transferDirectory"]
+        names = sorted(
+            os.listdir(xfer),
+            key=lambda f: (f == wire_transport.MANIFEST_NAME, f),
+        )
+        for f in names:
+            wire_transport.atomic_copy(
+                os.path.join(xfer, f),
+                os.path.join(self.site_states[s]["baseDirectory"], f),
+            )
+
+    def _membership_input(self, s, inp):
+        """Engine-brokered membership keys injected into one site's round
+        input (see ``ENGINE_PROVIDED_KEYS``): the one-shot warm-start
+        weight request for the donor, and the graceful-leave flag (which
+        persists until the leaver's flagged contribution is delivered)."""
+        extra = {}
+        if s in self._sync_donors:
+            self._sync_donors.discard(s)
+            extra["membership_sync"] = True
+        if s in self._pending_leave:
+            extra["leave"] = True
+        if not extra:
+            return inp
+        return {**inp, **extra}
+
+    def _finalize_leavers(self, site_outs, rec):
+        """Move every site whose delivered output carried the LEAVING flag
+        out of the invocable roster — the aggregator retired it this round
+        (after the reduce counted its final contribution).  Runs before
+        the broadcast fan-out so a left site gets no next-round input."""
+        for s in sorted(self._pending_leave):
+            out = site_outs.get(s)
+            if out is not None and out.get(LocalWire.LEAVING.value):
+                self._pending_leave.discard(s)
+                self.left_sites.add(s)
+                self.site_inputs.pop(s, None)
+                # the aggregator's retirement (membership.retire_leaving)
+                # already emitted the one membership:leave event — see
+                # _activate_joiner for why the engine lane stays silent
+                logger.warn(
+                    f"membership: {s} left gracefully "
+                    f"({len(self._alive_site_ids())} alive members remain)"
+                )
 
     def _quorum_configured(self):
         """True when site_quorum was configured on ANY of this engine's
@@ -493,8 +800,9 @@ class InProcessEngine:
     def _site_input(self, s):
         """The input dict for this round's invocation of site ``s``
         (computed ONCE per round, before the retry loop, so every retry
-        attempt sees identical input)."""
-        return self.site_inputs[s]
+        attempt sees identical input).  Membership keys (the warm-start
+        sync request, the graceful-leave flag) are injected here."""
+        return self._membership_input(s, self.site_inputs[s])
 
     def _site_attempt(self, rnd, s, inp, rec):
         """ONE invocation attempt of site ``s``; returns its output dict.
@@ -578,6 +886,7 @@ class InProcessEngine:
         rec = self._recorder()
         rnd = self.rounds + 1
         rec.set_context(round=rnd)
+        self._membership_round(rnd, rec)
         site_outs = {}
         with self.chaos.activate(rec), rec.span("engine:round", cat="engine"):
             for s in self._alive_site_ids():
@@ -608,6 +917,7 @@ class InProcessEngine:
                 )
 
             remote_out = self._remote_and_relay(rnd, site_outs, rec)
+            self._finalize_leavers(site_outs, rec)
         rec.flush()
         self.site_inputs = {s: dict(remote_out) for s in self._alive_site_ids()}
         self.rounds += 1
@@ -699,8 +1009,24 @@ class InProcessEngine:
             pool = min(pool, self._ASYNC_POOL_CAP)
         self._async_cfg = {
             "enabled": bool(enabled), "k": k, "pool": pool, "run_ahead": d,
+            # with no explicit pool size the pool follows the LIVE roster
+            # (elastic membership: a join grows it, ISSUE 15) instead of
+            # freezing the founding n_sites
+            "pool_auto": pool_raw is None,
         }
         return self._async_cfg
+
+    def _async_pool_size(self, ac):
+        """This round's invocation-pool ceiling: the configured size, or —
+        when the operator set none — the live member count, so mid-run
+        joins keep every site concurrently invocable (the resize is
+        applied by :meth:`_ensure_async_pool`)."""
+        if not ac.get("pool_auto"):
+            return ac["pool"]
+        size = max(len(self._alive_site_ids()), 1)
+        if self._ASYNC_POOL_CAP is not None:
+            size = min(size, self._ASYNC_POOL_CAP)
+        return size
 
     def _ensure_async_pool(self, size):
         if self._async_pool is None:
@@ -709,6 +1035,14 @@ class InProcessEngine:
             self._async_pool = ThreadPoolExecutor(
                 max_workers=int(size), thread_name_prefix="coinn-async"
             )
+        elif int(size) > getattr(self._async_pool, "_max_workers", 0):
+            # live resize for elastic membership: a mid-run join must not
+            # queue behind the founding roster's pool ceiling.  Raising
+            # ``_max_workers`` is sufficient — ThreadPoolExecutor spawns
+            # threads lazily on submit up to the current ceiling, so the
+            # next submission grows the pool (stdlib-stable since 3.8;
+            # shrinking is never needed: an idle thread just parks).
+            self._async_pool._max_workers = int(size)
         return self._async_pool
 
     def _ensure_reduce_pool(self):
@@ -1090,13 +1424,14 @@ class InProcessEngine:
         rec = self._recorder()
         rnd = self.rounds + 1
         rec.set_context(round=rnd)
+        self._membership_round(rnd, rec)
         k, d = ac["k"], ac["run_ahead"]
         site_outs = {}
         self._async_fresh = set()
         with self.chaos.activate(rec), rec.span(
             "engine:round", cat="engine", mode="async"
         ):
-            pool = self._ensure_async_pool(ac["pool"])
+            pool = self._ensure_async_pool(self._async_pool_size(ac))
             if d:
                 # harvest completed reduces first: an idle site must never
                 # be handed a broadcast it already consumed
@@ -1205,6 +1540,7 @@ class InProcessEngine:
                     # the round below runs the exact inline (d=0) tail
                     self._pipeline_drain(rec, reason="barrier")
                 remote_out = self._remote_and_relay(rnd, site_outs, rec)
+            self._finalize_leavers(site_outs, rec)
         rec.flush()
         if not pipelined:
             self.site_inputs = {
@@ -1348,7 +1684,7 @@ class SubprocessEngine(InProcessEngine):
         if s not in self._first_done:
             inp.update(self.first_input.get(s, {}))
             self._first_done.add(s)
-        return inp
+        return self._membership_input(s, inp)
 
     def _site_attempt(self, rnd, s, inp, rec):
         # a hung process produces no output until the timeout kills it —
@@ -1450,6 +1786,14 @@ class MeshEngine:
         # zero-participation path an empty-data site takes).  Empty here;
         # populated by subclasses with a dropout story (federation/engine).
         self.dead_sites = set()
+
+    def _site_loads(self, s):
+        """Whether site ``s`` gets a LIVE loader this epoch/eval (vs the
+        fully-masked placeholder stream).  The elastic-membership subclass
+        (federation/engine.py) overrides this with roster awareness: a
+        retired or not-yet-admitted slot rides masked even when its data
+        directory is populated."""
+        return s not in self.dead_sites
 
     def site_data_dir(self, site_id, data_dir=None):
         d = os.path.join(
@@ -1715,7 +2059,7 @@ class MeshEngine:
                     "train", dataset=train_sets[s], shuffle=True,
                     seed=int(rc.get("seed", 0)), epoch=epoch - 1,
                     target_batches=target_batches,
-                )) if len(train_sets[s]) and s not in self.dead_sites
+                )) if len(train_sets[s]) and self._site_loads(s)
                  else None)
                 for s in self.site_ids
             ]
@@ -1885,7 +2229,7 @@ class MeshEngine:
         loaders = {
             s: (iter(handles[s].get_loader(
                 which, dataset=datasets[s], shuffle=False, target_batches=nb))
-                if len(datasets[s]) and s not in self.dead_sites else None)
+                if len(datasets[s]) and self._site_loads(s) else None)
             for s in self.site_ids
         }
         for _ in range(nb):

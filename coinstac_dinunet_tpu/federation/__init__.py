@@ -22,6 +22,10 @@ is the scale inversion (ROADMAP item 1):
   process per site (+ aggregator) over a framed JSON pipe, supervised
   restarts (``worker:restart``) instead of dead sites, the node scripts
   and the cache/input/state contract untouched.
+- :mod:`.membership` — :class:`MembershipRoster` + the aggregator-side
+  elastic-membership rounds (ISSUE 15): the versioned roster epoch, the
+  mid-run JOIN admission handshake, graceful LEAVE retirement, and
+  rejoin-after-death with stale incarnations refused by epoch.
 
 Benchmark: ``scripts/bench_federation.py`` (headline: rounds/sec at 1,000
 simulated sites, ledgered for ``telemetry doctor`` regression verdicts).
@@ -29,10 +33,12 @@ See docs/FEDERATION.md for the operator guide.
 """
 from .daemon import DaemonEngine  # noqa: F401
 from .engine import SiteVectorizedEngine  # noqa: F401
+from .membership import MembershipRoster  # noqa: F401
 from .vector import SiteVectorizedFederation, resolve_site_shards  # noqa: F401
 
 __all__ = [
     "DaemonEngine",
+    "MembershipRoster",
     "SiteVectorizedEngine",
     "SiteVectorizedFederation",
     "resolve_site_shards",

@@ -72,7 +72,7 @@ import time
 import traceback
 
 from .. import utils
-from ..config.keys import Daemon
+from ..config.keys import Daemon, Membership
 from ..engine import SubprocessEngine
 from ..resilience.retry import RetryPolicy
 
@@ -280,10 +280,18 @@ def worker_main(argv=None):
                               "error": f"unknown op {msg.get('op')!r}"})
             continue
         payload = dict(msg.get("payload") or {})
+        # engine-authored cache writes (elastic-membership admission
+        # requests, ISSUE 15) ride as an explicit patch: a warm worker
+        # owns the live cache and discards the inbound JSON copy below,
+        # so anything the ENGINE wrote into its copy between rounds would
+        # otherwise silently never reach the node
+        patch = payload.pop("cache_patch", None)
         payload.setdefault("cache", {})
         warm = live_cache is not None
         if warm:
             payload["cache"] = live_cache
+        if patch:
+            payload["cache"].update(patch)
         try:
             result = compute(payload)
             live_cache = payload["cache"]
@@ -492,6 +500,24 @@ class DaemonEngine(SubprocessEngine):
         # threads share — one lock per engine keeps a concurrent restart
         # from racing a neighbor's spawn bookkeeping
         self._worker_lock = threading.RLock()
+        # joiners whose fresh worker add_site pre-warmed in the background
+        # (activation must not kill it: it IS the new incarnation)
+        self._prewarmed = set()
+        # the daemon's capacity high-water mark (the vectorized plane's
+        # spare-slot twin, ISSUE 15): a chaos churn plan names its JOIN
+        # targets at build time, so their workers spawn warm NOW on
+        # background threads — a mid-run admission then costs one
+        # full-cache frame instead of a synchronous interpreter +
+        # imports + backend cold start
+        self._spare_workers = set()
+        for f in getattr(self.chaos, "faults", ()):
+            if f.kind == "join" and f.site and f.site not in self.site_ids:
+                sid = str(f.site)
+                self._spare_workers.add(sid)
+                threading.Thread(
+                    target=self._prewarm_worker, args=(sid,), daemon=True,
+                    name=f"prewarm-{sid}",
+                ).start()
 
     # ---------------------------------------------------------- supervision
     def _worker_env(self):
@@ -560,6 +586,17 @@ class DaemonEngine(SubprocessEngine):
         # deterministic under any completion order
         rnd = int(rnd) if rnd is not None else self.rounds + 1
         payload = utils.clean_recursive(payload)
+        # engine-authored cache writes must survive the warm worker
+        # replacing the inbound JSON cache with its live one: the elastic
+        # membership admission queue (ISSUE 15) is written by the ENGINE
+        # into its cache copy between rounds, so it rides the frame as an
+        # explicit ``cache_patch`` the worker applies on top of whichever
+        # cache it computes with
+        patch = {
+            k: (payload.get("cache") or {}).get(k)
+            for k in (Membership.REQUESTS,)
+            if (payload.get("cache") or {}).get(k)
+        }
 
         def attempt():
             worker = self._ensure_worker(target, script, rec)
@@ -578,6 +615,8 @@ class DaemonEngine(SubprocessEngine):
             if (worker.delta and self._warm_gen.get(target)
                     == self._worker_gen.get(target)):
                 req = {k: v for k, v in payload.items() if k != "cache"}
+            if patch:
+                req = {**req, "cache_patch": patch}
             try:
                 res = worker.request(
                     {"op": "invoke", "round": rnd, "payload": req},
@@ -646,6 +685,80 @@ class DaemonEngine(SubprocessEngine):
             delta=delta is not None,
         )
         return result
+
+    # ------------------------------------------------- elastic membership
+    def add_site(self, site_id=None, site_args=None, first_input=None):
+        """Queue the JOIN, then overlap the joiner's worker bring-up
+        (interpreter + imports + backend init — seconds) with the
+        admission handshake's round-trip: any worker left over from the
+        site's previous incarnation is killed NOW (its live cache is the
+        stale state the roster epoch exists to refuse) and a fresh one
+        spawns on a background thread, so by activation the join costs
+        one full-cache frame instead of a synchronous cold start."""
+        sid = super().add_site(site_id, site_args=site_args,
+                               first_input=first_input)
+        if sid in self._spare_workers:
+            # a clean pre-spawned spare (never served an invocation):
+            # it IS the fresh incarnation — keep it
+            self._spare_workers.discard(sid)
+            self._prewarmed.add(sid)
+            return sid
+        self._discard_worker(sid)
+        self._prewarmed.add(sid)
+        threading.Thread(
+            target=self._prewarm_worker, args=(sid,), daemon=True,
+            name=f"prewarm-{sid}",
+        ).start()
+        return sid
+
+    def _prewarm_worker(self, sid):
+        try:
+            self._ensure_worker(sid, self.local_script, self._recorder())
+        except Exception:  # noqa: BLE001 — activation spawns on demand
+            self._prewarmed.discard(sid)
+
+    def _discard_worker(self, sid, shutdown=False):
+        """Retire ``sid``'s worker AND its delta-protocol bookkeeping in
+        one place: a membership incarnation change must never let a warm
+        worker (or the engine-side ``_warm_gen``/``_delta_base`` mirror
+        feeding the dirty-key frame protocol) survive into the next life.
+        Returns the retired worker (already killed/shut down) or None."""
+        with self._worker_lock:
+            w = self._workers.pop(sid, None)
+        self._warm_gen.pop(sid, None)
+        self._delta_base.pop(sid, None)
+        if w is not None:
+            if shutdown:
+                w.shutdown()
+            else:
+                w.kill()
+        return w
+
+    def _activate_joiner(self, s, rec):
+        """A joiner's (or rejoiner's) worker must start from the FRESH
+        incarnation: a worker left over from the site's dead life still
+        holds its live cache, and the warm delta protocol would let it
+        silently serve stale state.  :meth:`add_site` already killed the
+        stale worker and pre-warmed a clean one (which resumes from the
+        fresh JSON cache — its first frame ships the full cache); a
+        joiner that arrived outside add_site's pre-warm is killed here so
+        the next invocation spawns clean."""
+        if s in self._prewarmed:
+            self._prewarmed.discard(s)
+        else:
+            self._discard_worker(s)
+        super()._activate_joiner(s, rec)
+
+    def _finalize_leavers(self, site_outs, rec):
+        """A gracefully left site's warm worker has served its last
+        invocation: orderly shutdown, not a corpse for close() to find."""
+        before = set(self.left_sites)
+        super()._finalize_leavers(site_outs, rec)
+        for s in sorted(self.left_sites - before):
+            w = self._discard_worker(s, shutdown=True)
+            if w is not None:
+                rec.event(Daemon.EVENT_SHUTDOWN, cat="daemon",
+                          target=str(s), site=str(s), pid=w.pid)
 
     def _relay_broadcast(self, rnd, rec):
         super()._relay_broadcast(rnd, rec)

@@ -33,7 +33,7 @@ import time
 
 import numpy as np
 
-from ..config.keys import Live, Metric
+from ..config.keys import Live, Membership, Metric
 from ..engine import MeshEngine
 from ..nodes.remote import COINNRemote
 from ..resilience.chaos import ChaosFault, ChaosSession
@@ -43,17 +43,151 @@ from .vector import SiteVectorizedFederation
 
 
 class SiteVectorizedEngine(MeshEngine):
-    """Full federated lifecycle over the site-vectorized gradient plane."""
+    """Full federated lifecycle over the site-vectorized gradient plane.
+
+    Elastic membership (ISSUE 15) rides the roster MASK, never the shape:
+    the stacked site axis is allocated once at a **capacity high-water
+    mark** (``n_sites`` founding members + ``spare_capacity`` empty
+    slots, derived from the churn plan when one is loaded), and every
+    membership change — graceful leave, mid-run join into a spare slot,
+    rejoin after a chaos death — only flips that slot between live
+    batches and the fully-masked placeholder stream (weight 0 in the
+    in-jit reduce).  The one-jit round therefore NEVER recompiles on
+    churn.  Data granularity follows the vectorized plane's lockstep
+    epochs: a join/rejoin re-arms the slot's loader at the next epoch
+    boundary (roster and quorum effect is immediate), a leave masks the
+    slot from the very next round.
+    """
 
     def __init__(self, workdir, n_sites, fault_plan=None, site_shards=None,
-                 **kw):
+                 spare_capacity=None, **kw):
         kw.pop("devices_per_site", None)  # no per-site device rank here
-        super().__init__(workdir, n_sites, **kw)
-        self.chaos = ChaosSession.from_spec(fault_plan)
+        chaos = ChaosSession.from_spec(fault_plan)
+        if spare_capacity is None:
+            # every join in the plan targets a slot past the founding
+            # roster — allocate exactly those spares so churn plans from
+            # resilience.chaos.churn_plan work unconfigured
+            spare_capacity = sum(
+                1 for f in getattr(chaos, "faults", ())
+                if f.kind == "join"
+            )
+        self.founding_sites = int(n_sites)
+        self.capacity = int(n_sites) + int(spare_capacity)
+        super().__init__(workdir, self.capacity, **kw)
+        self.chaos = chaos
         self.site_shards = site_shards
         self.rounds = 0
         self.site_failures = {}
+        # elastic-membership roster (ISSUE 15): spare slots are allocated
+        # but not yet admitted; left slots were members and retired
+        # gracefully.  A dead site REMAINS a roster member (PR-9
+        # semantics) until a rejoin re-admits it or the run ends.
+        self.spare_sites = set(self.site_ids[self.founding_sites:])
+        self.left_sites = set()
+        self.roster_epoch = 1
+        self._membership_counts = {"join": 0, "leave": 0, "rejoin": 0}
         self._round_t = None  # (wall, perf) stamp of the previous hook
+
+    # ----------------------------------------------- elastic membership (15)
+    def _member_ids(self):
+        """The CURRENT roster: founding + admitted spares − retired."""
+        return [
+            s for s in self.site_ids
+            if s not in self.spare_sites and s not in self.left_sites
+        ]
+
+    def _site_loads(self, s):
+        """Only live roster members get live loaders: a spare (not yet
+        admitted) or retired slot rides fully masked even when its data
+        directory is populated — it must not contribute to any reduce."""
+        return (s not in self.dead_sites and s not in self.spare_sites
+                and s not in self.left_sites)
+
+    def add_site(self, site_id=None):
+        """Mid-run JOIN/REJOIN on the vectorized plane: activate a spare
+        slot (join), or re-admit a retired or dead slot (rejoin) — the
+        ``dead_sites`` exclusion is REVERSIBLE through this path (the
+        grow-only mask was the PR-15 satellite bug: a healed site stayed
+        excluded from the reduce mask forever).  The roster/quorum effect
+        is immediate; the slot's loader re-arms at the next epoch
+        boundary (lockstep-epoch data granularity).  Returns the slot id.
+        """
+        rec = self._recorder()
+        if site_id is None:
+            site_id = next(iter(sorted(self.spare_sites)), None)
+            if site_id is None:
+                raise ValueError(
+                    "no spare capacity left: size the engine's "
+                    "spare_capacity to the expected join volume (the "
+                    "stacked site axis cannot grow without recompiling)"
+                )
+        site_id = str(site_id)
+        if site_id not in self.site_states:
+            raise ValueError(
+                f"{site_id} is outside the allocated capacity "
+                f"({self.capacity} slots)"
+            )
+        rejoin = site_id in self.left_sites or site_id in self.dead_sites
+        if not rejoin and site_id not in self.spare_sites:
+            raise ValueError(f"{site_id} is already an active member")
+        self.spare_sites.discard(site_id)
+        self.left_sites.discard(site_id)
+        self.dead_sites.discard(site_id)
+        self.site_failures.pop(site_id, None)
+        self.roster_epoch += 1
+        kind = "rejoin" if rejoin else "join"
+        self._membership_counts[kind] += 1
+        rec.event(
+            Membership.EVENT_REJOIN if rejoin else Membership.EVENT_JOIN,
+            cat="membership", site=site_id, epoch=self.roster_epoch,
+            members=len(self._member_ids()),
+        )
+        logger.warn(
+            f"membership: {site_id} {'re-joined' if rejoin else 'joined'} "
+            f"the vectorized federation at roster epoch {self.roster_epoch} "
+            f"({len(self._member_ids())} members; data re-arms at the next "
+            "epoch boundary)"
+        )
+        return site_id
+
+    def remove_site(self, site_id, graceful=True):
+        """Mid-run LEAVE: retire a member — its slot is masked from the
+        next round on, the roster epoch bumps, and quorum is judged
+        against the shrunken roster.  Graceful (default) never fires
+        ``site_died``; ``graceful=False`` routes through the death path
+        (a chaos-equivalent operator kill)."""
+        site_id = str(site_id)
+        if site_id not in self._member_ids() or site_id in self.dead_sites:
+            raise ValueError(f"{site_id} is not an alive member")
+        if not graceful:
+            self._site_failure(
+                site_id, RuntimeError("removed by operator")
+            )
+            return
+        self.left_sites.add(site_id)
+        self.roster_epoch += 1
+        self._membership_counts["leave"] += 1
+        self._recorder().event(
+            Membership.EVENT_LEAVE, cat="membership", site=site_id,
+            epoch=self.roster_epoch, members=len(self._member_ids()),
+        )
+        logger.warn(
+            f"membership: {site_id} left the vectorized federation "
+            f"gracefully at roster epoch {self.roster_epoch} "
+            f"({len(self._member_ids())} members remain)"
+        )
+
+    def _membership_round(self, rec):
+        """Apply the chaos churn plan's roster transitions pinned to this
+        round (:func:`~..resilience.chaos.churn_plan`)."""
+        for kind, s in self.chaos.membership_ops(self.rounds, rec):
+            try:
+                if kind == "leave":
+                    self.remove_site(s, graceful=True)
+                else:  # join / rejoin
+                    self.add_site(s)
+            except ValueError as exc:
+                logger.warn(f"churn plan op {kind}@{s} skipped: {exc}")
 
     # ------------------------------------------------------ federation plane
     def _build_federation(self, rc):
@@ -80,7 +214,9 @@ class SiteVectorizedEngine(MeshEngine):
         """A chaos fault killed site ``s`` this round.  Without
         ``site_quorum`` the failure propagates (all-site lockstep); with it
         the site is dead from this round on — survivor-weighted semantics,
-        judged against the original roster."""
+        judged against the CURRENT roster (ISSUE 15: a gracefully retired
+        site neither counts as alive nor inflates the need; a mid-run
+        joiner extends both)."""
         quorum = self.cache.get("site_quorum")
         if not quorum:
             raise exc
@@ -95,8 +231,9 @@ class SiteVectorizedEngine(MeshEngine):
             f"({self.site_failures[s]}); excluded from the remaining rounds "
             "(site_quorum set)"
         )
-        alive = [x for x in self.site_ids if x not in self.dead_sites]
-        need = max(COINNRemote._quorum_need(quorum, self.n_sites), 1)
+        members = self._member_ids()
+        alive = [x for x in members if x not in self.dead_sites]
+        need = max(COINNRemote._quorum_need(quorum, len(members)), 1)
         if len(alive) < need:
             self._recorder().event(
                 "quorum:fail", cat="quorum", reason="quorum unmet",
@@ -105,8 +242,8 @@ class SiteVectorizedEngine(MeshEngine):
             )
             raise RuntimeError(
                 f"quorum unmet: {len(alive)} sites alive, quorum {quorum} "
-                f"of {self.n_sites} requires >= {need}; dead: "
-                f"{sorted(self.dead_sites)}"
+                f"of {len(members)} roster members requires >= {need}; "
+                f"dead: {sorted(self.dead_sites)}"
             )
         self._recorder().event(
             "quorum:continue", cat="quorum", alive=alive,
@@ -133,7 +270,10 @@ class SiteVectorizedEngine(MeshEngine):
             rec.record_span("engine:round", prev[0], dt, cat="engine",
                             round=self.rounds)
             if dt > 0:
-                alive = len(self.site_ids) - len(self.dead_sites)
+                alive = len([
+                    s for s in self._member_ids()
+                    if s not in self.dead_sites
+                ])
                 rec.metric(Metric.ROUNDS_PER_SEC, 1.0 / dt,
                            round=self.rounds)
                 rec.metric(Metric.SITES_PER_SEC, alive / dt,
@@ -141,34 +281,44 @@ class SiteVectorizedEngine(MeshEngine):
             _perf.sample_device_memory(self.cache, recorder=rec)
         self.rounds += 1
         rec.set_context(round=self.rounds)
+        # elastic membership first: this round's churn plan transitions
+        # re-scope the roster BEFORE faults fire and masks apply
+        self._membership_round(rec)
+        members = self._member_ids()
         if rec.enabled:
             # one liveness pulse per ROUND (not per site: at 10^3 stacked
             # sites per jit, per-site events would dwarf the payload) —
             # the live board keys vectorized-plane progress on it
             rec.event(
                 Live.HEARTBEAT, cat="engine",
-                alive=len(self.site_ids) - len(self.dead_sites),
+                alive=len([s for s in members
+                           if s not in self.dead_sites]),
             )
         try:
-            for s in self.site_ids:
+            for s in members:
                 if s in self.dead_sites:
                     continue
                 try:
                     self.chaos.invoke_fault(self.rounds, s, rec)
                 except ChaosFault as exc:
                     self._site_failure(s, exc)
-            if len(self.dead_sites) >= len(self.site_ids):
+            if all(s in self.dead_sites for s in self._member_ids()):
                 raise RuntimeError(
-                    f"every site died; failures: {self.site_failures}"
+                    f"every roster member died; failures: "
+                    f"{self.site_failures}"
                 )
         finally:
             # unlike the serial engines there is no per-round node flush, so
             # the engine lane flushes here — including on a quorum-unmet
             # abort, where the site_died/quorum events ARE the postmortem
             rec.flush()
-        if self.dead_sites:
+        # the roster mask: dead, retired and not-yet-admitted slots all
+        # degrade to fully-masked placeholders — weight 0 in the in-jit
+        # reduce, the stacked shape untouched (no recompile on churn)
+        masked = self.dead_sites | self.left_sites | self.spare_sites
+        if masked:
             for i, s in enumerate(self.site_ids):
-                if s in self.dead_sites and site_batches[i] is not None:
+                if s in masked and site_batches[i] is not None:
                     site_batches[i] = [
                         {**b,
                          "_mask": np.zeros_like(np.asarray(b["_mask"]))}

@@ -1,0 +1,351 @@
+"""Elastic membership — the aggregator-owned roster epoch machinery.
+
+The reference federation contract fixes the site roster at INIT: death
+(quorum drop) is the only exit and there is no entry path at all — fatal
+for the ROADMAP's "millions of users" north star, where sites come and go
+continuously (serverless/preemptible economics, PAPERS.md
+arXiv:2509.14920).  This module converts the quorum/survivor-weighting and
+``reappear`` machinery of PRs 9–14 into a first-class membership protocol:
+
+- **Roster epoch.**  The aggregator owns a versioned membership record
+  (``cache['roster']`` — :class:`MembershipRoster`), broadcast on the wire
+  as :attr:`~..config.keys.RemoteWire.ROSTER_EPOCH` alongside
+  ``wire_round`` and echoed back verbatim by every site.  Every
+  join/leave/rejoin bumps the epoch.  ``cache['all_sites']`` mirrors the
+  CURRENT member list, so the quorum policy
+  (:meth:`~..nodes.remote.COINNRemote._check_quorum`) is always judged
+  against the live roster, never the INIT one.
+- **JOIN mid-run.**  The engine queues an admission request
+  (``cache['membership_requests']``) carrying the donor's round-alignment
+  sync (cursor/epoch/mode); the aggregator admits the joiner at the top of
+  its next COMPUTATION round (epoch bump) and broadcasts an **admission
+  record** (:attr:`~..config.keys.RemoteWire.ADMISSIONS`): the current
+  fold assignment + ``target_batches`` + the sync + the admission epoch.
+  The joiner's first invocation enters directly at the steady-state
+  COMPUTATION phase (``nodes/local.py`` join entry) and warm-starts from
+  the donor's live weights relayed through the existing pretrain-broadcast
+  path — so a joiner admitted at round r contributes to round r+1's
+  reduce, exactly once.
+- **LEAVE gracefully.**  A leaving site flags its final contribution
+  :attr:`~..config.keys.LocalWire.LEAVING`; the reducer counts the payload
+  and the aggregator then retires the site (epoch bump) — never a
+  ``site_died``, never a retry cycle.
+- **Rejoin after death.**  The ``reappear`` chaos fault's scenario —
+  a dropped site coming back — upgrades from a refused anomaly to a
+  re-admission path: the engine re-admits the site with a FRESH cache
+  through the same join handshake, and any payload out of the previous,
+  dead incarnation is refused **by roster epoch** exactly as ``wire_round``
+  refuses stale rounds (it echoes an epoch older than the site's current
+  admission).
+
+The tier-4 model checker's ``join``/``leave`` actions
+(:mod:`~..analysis.model_check`) verify the roster-soundness invariants
+(no contribution from a non-member epoch, quorum against the current
+roster, joiner exactly-once admission); :func:`~..resilience.chaos
+.churn_plan` drives the "churn 10% of 2,000 sites per round" drills.
+"""
+from .. import telemetry
+from ..config.keys import LocalWire, Membership, RemoteWire
+from ..utils import logger
+
+
+class MembershipRoster:
+    """The aggregator's versioned membership record (JSON-able; lives in
+    ``cache['roster']`` and round-trips like every other protocol state).
+
+    ``members`` maps each current member to the roster epoch it was
+    (last) admitted at — the refusal boundary for payloads out of a
+    previous incarnation.  ``left`` records graceful retirements (a left
+    site may later rejoin, which re-admits it at a fresh epoch).
+    """
+
+    def __init__(self, epoch=1, members=None, left=None, joining=None,
+                 pending=None):
+        self.epoch = int(epoch)
+        self.members = dict(members or {})
+        self.left = list(left or [])
+        # members admitted whose FIRST contribution has not arrived yet
+        # (a join takes effect on the wire one round after admission): the
+        # quorum check must not count them as dropped in the interim
+        self.joining = list(joining or [])
+        # the admission record broadcast for each still-joining member,
+        # kept until its first contribution arrives so a retried (or
+        # crashed-and-healed) aggregator attempt re-broadcasts the SAME
+        # record instead of losing the admission with the drained request
+        # queue — the exactly-once contract must survive the retry policy
+        self.pending = dict(pending or {})
+
+    # ------------------------------------------------------------ lifecycle
+    @classmethod
+    def load(cls, cache, seed_sites=None):
+        """The roster from ``cache['roster']``; when absent, seeded from
+        ``seed_sites`` (or ``cache['all_sites']``) at epoch 1 — every
+        founding member's admitted epoch is 1.  Returns None when there is
+        nothing to seed from yet (a standalone INIT round resolves it)."""
+        rec = cache.get(Membership.ROSTER)
+        if isinstance(rec, dict) and "members" in rec:
+            return cls(rec.get("epoch", 1), rec.get("members"),
+                       rec.get("left"), rec.get("joining"),
+                       rec.get("pending"))
+        sites = seed_sites if seed_sites is not None else cache.get("all_sites")
+        if not sites:
+            return None
+        return cls(1, {str(s): 1 for s in sites}, [])
+
+    def save(self, cache):
+        """Commit the record AND mirror the current member list into
+        ``cache['all_sites']`` — the single roster every quorum decision
+        reads, so membership changes re-scope quorum immediately."""
+        cache[Membership.ROSTER] = {
+            "epoch": self.epoch,
+            "members": dict(self.members),
+            "left": list(self.left),
+            "joining": list(self.joining),
+            "pending": dict(self.pending),
+        }
+        cache["all_sites"] = sorted(self.members)
+
+    # ------------------------------------------------------------ transitions
+    def admit(self, site):
+        """Join/rejoin: bump the epoch and (re-)admit ``site`` at it.  The
+        joiner sits in the ``joining`` grace set until its first accepted
+        contribution arrives — absent from a round's input, it is not yet
+        *dropped* (the join takes effect on the wire one round later)."""
+        site = str(site)
+        self.epoch += 1
+        self.members[site] = self.epoch
+        if site in self.left:
+            self.left.remove(site)
+        if site not in self.joining:
+            self.joining.append(site)
+        return self.epoch
+
+    def retire(self, site):
+        """Graceful leave: bump the epoch and remove ``site``."""
+        site = str(site)
+        self.epoch += 1
+        self.members.pop(site, None)
+        if site in self.joining:
+            self.joining.remove(site)
+        self.pending.pop(site, None)
+        if site not in self.left:
+            self.left.append(site)
+        return self.epoch
+
+    # --------------------------------------------------------------- queries
+    def is_member(self, site):
+        return str(site) in self.members
+
+    def admitted_epoch(self, site):
+        return self.members.get(str(site))
+
+    def refuses(self, site, echoed_epoch):
+        """True when a payload must be refused by roster epoch: it came
+        from a non-member, or it echoes an epoch OLDER than the site's
+        current admission (a redelivery out of a previous incarnation).
+        ``None`` echoes from members are tolerated — pre-ROSTER_EPOCH
+        peers and the round before the first broadcast reaches a site."""
+        site = str(site)
+        if site not in self.members:
+            return True
+        if echoed_epoch is None:
+            return False
+        return int(echoed_epoch) < int(self.members[site])
+
+    def quorum_need(self, quorum):
+        """Minimum alive-member count under ``quorum``, judged against the
+        CURRENT roster size — the one canonical normalization
+        (:meth:`~..nodes.remote.COINNRemote._quorum_need`) over the live
+        member list, so the live quorum evidence can never drift from the
+        aggregator's actual quorum decision."""
+        from ..nodes.remote import COINNRemote
+
+        return COINNRemote._quorum_need(quorum, len(self.members))
+
+
+# ------------------------------------------------------- aggregator rounds
+def filter_membership(cache, input_dict):
+    """The aggregator's roster-epoch gate, run BEFORE the quorum check and
+    before any reducer/trainer snapshots ``input`` (the same ordering the
+    ``proto-model-stale-contribution`` fix pinned for quorum filtering):
+    drops every payload the roster refuses — non-member outputs and echoes
+    older than the site's current admission — and returns
+    ``(filtered_input, refused {site: reason})``.
+
+    A refused payload is a protocol event, not a run failure: the fresh
+    members' round proceeds survivor-weighted exactly as if the stale
+    message had never arrived (`membership:refused` lands on the timeline
+    for the postmortem)."""
+    roster = MembershipRoster.load(cache)
+    if roster is None:
+        return input_dict, {}
+    refused = {}
+    for site, site_vars in input_dict.items():
+        if not isinstance(site_vars, dict):
+            continue
+        echo = site_vars.get(LocalWire.ROSTER_EPOCH.value)
+        if roster.refuses(site, echo):
+            if roster.is_member(site):
+                refused[site] = (
+                    f"echoed roster epoch {echo} predates the site's "
+                    f"admission at epoch {roster.admitted_epoch(site)}"
+                )
+            elif (
+                site in roster.left
+                and site_vars.get(LocalWire.LEAVING.value)
+                and site_vars.get(LocalWire.ROUND.value) is not None
+                and cache.get("wire_round") is not None
+                and int(site_vars[LocalWire.ROUND.value])
+                == int(cache["wire_round"])
+            ):
+                # the IN-FLIGHT round's flagged final contribution seen
+                # again by a RETRIED aggregator attempt (the first attempt
+                # retired the leaver, then failed before committing): the
+                # protocol promises this payload counts, so the exact
+                # current-round echo readmits it — any later redelivery
+                # echoes the retirement round, lags `wire_round`, and is
+                # refused here as before
+                continue
+            else:
+                refused[site] = "not a roster member"
+    # a joiner's first ACCEPTED contribution ends its joining grace: from
+    # now on its absence counts as a drop like any member's, and the
+    # retry-safety admission record kept for re-broadcast is retired
+    arrived = [
+        s for s in roster.joining if s in input_dict and s not in refused
+    ]
+    if arrived:
+        for s in arrived:
+            roster.joining.remove(s)
+            roster.pending.pop(s, None)
+        roster.save(cache)
+    if not refused:
+        return input_dict, {}
+    rec = telemetry.get_active()
+    for site, why in sorted(refused.items()):
+        rec.event(
+            Membership.EVENT_REFUSED, cat="membership", site=site,
+            reason=why, epoch=roster.epoch,
+        )
+    logger.warn(
+        f"membership: refused payloads by roster epoch from "
+        f"{sorted(refused)} ({roster.epoch=}); the round proceeds with "
+        "the current members"
+    )
+    return {k: v for k, v in input_dict.items() if k not in refused}, refused
+
+
+def process_admissions(cache):
+    """Drain the engine's join/rejoin request queue
+    (``cache['membership_requests']``) into admission records: one epoch
+    bump + one :attr:`~..config.keys.RemoteWire.ADMISSIONS` entry per
+    joiner, carrying the current fold assignment, ``target_batches``, the
+    donor round-alignment sync the engine attached, and the admission
+    epoch.  A re-admitted site is also cleared from ``dropped_sites`` —
+    its previous incarnation's drop no longer applies to the fresh one.
+
+    Also returns (and re-broadcasts) the admission records of every
+    still-joining member whose first contribution has not arrived yet: a
+    failed aggregator attempt discards its output AFTER this step already
+    drained the queue and mutated the roster, so the healed retry must be
+    able to rebuild the identical broadcast from the roster's ``pending``
+    records — same epoch, no second admission — or the join is silently
+    lost (the engine-side activation is idempotent: it pops its awaiting
+    entry once, so a re-broadcast is harmless).
+
+    Returns the admissions dict to broadcast ({} when nothing is joining)."""
+    requests = cache.pop(Membership.REQUESTS, None) or []
+    roster = MembershipRoster.load(cache)
+    if roster is None:
+        # pre-INIT: nothing to admit into yet; the engine re-queues
+        if requests:
+            cache[Membership.REQUESTS] = requests
+        return {}
+    if not requests:
+        return dict(roster.pending)
+    rec = telemetry.get_active()
+    admissions = {}
+    for req in requests:
+        site = str(req.get("site"))
+        if site in roster.pending:
+            # a re-delivered request: the daemon engine's cache_patch
+            # rides EVERY retry attempt, so a failed attempt against a
+            # warm worker whose live cache already drained the queue
+            # re-injects the same request — the admission already
+            # happened, and its pending record re-broadcasts below with
+            # no second epoch bump and no second membership event
+            continue
+        op = str(req.get("op", "join"))
+        rejoin = op == "rejoin" or site in roster.left or site in set(
+            cache.get("dropped_sites", [])
+        )
+        epoch = roster.admit(site)
+        dropped = [s for s in cache.get("dropped_sites", []) if s != site]
+        if dropped != cache.get("dropped_sites", []):
+            cache["dropped_sites"] = dropped
+        admission = {
+            **dict(cache.get("fold") or {}),
+            "pretrain": False,
+            "target_batches": cache.get("target_batches"),
+            **dict(req.get("sync") or {}),
+            RemoteWire.ROSTER_EPOCH.value: epoch,
+        }
+        admissions[site] = admission
+        roster.pending[site] = admission
+        rec.event(
+            Membership.EVENT_REJOIN if rejoin else Membership.EVENT_JOIN,
+            cat="membership", site=site, epoch=epoch,
+            members=len(roster.members),
+            **_quorum_attrs(cache, roster),
+        )
+        logger.warn(
+            f"membership: {'re-admitted' if rejoin else 'admitted'} {site} "
+            f"at roster epoch {epoch} ({len(roster.members)} members)"
+        )
+    roster.save(cache)
+    return dict(roster.pending)
+
+
+def retire_leaving(cache, input_dict):
+    """Retire every site whose round output carries the
+    :attr:`~..config.keys.LocalWire.LEAVING` flag — called AFTER the
+    reduce consumed their final contribution, so a graceful leave costs
+    nothing: the payload counts, the site retires, the epoch bumps, and
+    the next round's quorum is judged against the shrunken roster.
+    Returns the retired site list."""
+    leavers = [
+        site for site, site_vars in input_dict.items()
+        if isinstance(site_vars, dict)
+        and site_vars.get(LocalWire.LEAVING.value)
+    ]
+    if not leavers:
+        return []
+    roster = MembershipRoster.load(cache)
+    if roster is None:
+        return []
+    rec = telemetry.get_active()
+    for site in leavers:
+        epoch = roster.retire(site)
+        rec.event(
+            Membership.EVENT_LEAVE, cat="membership", site=str(site),
+            epoch=epoch, members=len(roster.members),
+            **_quorum_attrs(cache, roster),
+        )
+        logger.warn(
+            f"membership: {site} left gracefully at roster epoch {epoch} "
+            f"({len(roster.members)} members remain)"
+        )
+    roster.save(cache)
+    return leavers
+
+
+def _quorum_attrs(cache, roster):
+    """The quorum-headroom evidence membership events carry when a policy
+    is configured — the live plane's ``quorum_erosion`` verdict reads it."""
+    quorum = cache.get("site_quorum")
+    if not quorum:
+        return {}
+    try:
+        return {"quorum_need": max(roster.quorum_need(quorum), 1)}
+    except ValueError:
+        return {}

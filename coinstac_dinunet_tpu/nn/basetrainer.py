@@ -91,6 +91,20 @@ _VOLATILE_CACHE_KEYS = frozenset((
     # parallel/reducer.py discount): rewritten every aggregator round —
     # host-side protocol bookkeeping, never traced
     "site_staleness",
+    # elastic-membership state (federation/membership.py, ISSUE 15): the
+    # versioned roster record mutates on every join/leave/rejoin, the
+    # request queue is drained per aggregator round, site-capacity
+    # throughput refreshes from every HEALTH rollup, and the quorum roster
+    # mirror tracks the live membership — all host-side protocol
+    # bookkeeping, never traced
+    "roster", "membership_requests", "site_capacity", "all_sites",
+    "target_batches", "joined_epoch",
+    # ... and the join entry (nodes/local.py::_join_run) replays the
+    # INIT_RUNS bookkeeping mid-round: num_folds derives from the volatile
+    # splits record, and frozen_args mirrors arg keys that ALL remain in
+    # the bucket key individually — neither write carries trace-relevant
+    # information the key does not already see
+    "num_folds", "frozen_args",
     # Key.* bookkeeping the nodes append per round/fold (metrics rollups,
     # serialized score blobs, one-shot flags) — all host-side, never traced
     Key.TEST_METRICS.value, Key.TRAIN_SERIALIZABLE.value,

@@ -172,6 +172,68 @@ class COINNLocal:
         out[LocalWire.PHASE.value] = Phase.COMPUTATION.value
         return out
 
+    def _join_run(self, trainer, admission):
+        """Mid-run admission (ISSUE 15, :mod:`~..federation.membership`):
+        enter the federation at the steady-state COMPUTATION phase without
+        replaying the fold lifecycle.  The admission record (broadcast as
+        :attr:`~..config.keys.RemoteWire.ADMISSIONS`) carries the current
+        fold assignment + ``target_batches`` + the donor's round-alignment
+        sync (cursor/epoch/mode), so this site's padded loader falls into
+        lockstep mid-epoch; the warm start loads the donor's live weights
+        relayed through the existing pretrain-broadcast path
+        (``pretrained_weights``) — params AND optimizer state, so the
+        joiner's next update application stays bitwise on the replicated
+        trajectory.  Local data prep (splits) runs here exactly once: the
+        INIT_RUNS work this site never saw, minus the wire."""
+        out = {}
+        admission = dict(admission)
+        self.cache["joined_epoch"] = admission.pop(
+            RemoteWire.ROSTER_EPOCH.value, None
+        )
+        self.cache.update(
+            {k: v for k, v in admission.items() if v is not None}
+        )
+        trainer.data_handle.prepare_data()
+        self.cache["num_folds"] = len(self.cache["splits"])
+        frozen = {k: self.cache.get(k) for k in self._args}
+        frozen["num_folds"] = self.cache["num_folds"]
+        self.cache["frozen_args"] = frozen
+        self.cache.setdefault("cursor", 0)
+        self.cache.setdefault("epoch", 0)
+        self.cache[Key.TRAIN_SERIALIZABLE.value] = []
+        self.cache["split_file"] = self.cache["splits"][
+            str(self.cache["split_ix"])
+        ]
+        self.cache["log_dir"] = os.path.join(
+            self.state.get("outputDirectory", "."),
+            str(self.cache["task_id"]),
+            f"fold_{self.cache['split_ix']}",
+        )
+        os.makedirs(self.cache["log_dir"], exist_ok=True)
+        tag = f"{self.cache['task_id']}-{self.cache['split_ix']}"
+        self.cache["best_nn_state"] = f"best.{tag}.ckpt"
+        self.cache["latest_nn_state"] = f"latest.{tag}.ckpt"
+        trainer.init_nn()
+        wfile = self.input.get(RemoteWire.PRETRAINED_WEIGHTS.value)
+        src = (os.path.join(self.state.get("baseDirectory", "."), wfile)
+               if wfile else None)
+        if src and os.path.exists(src):
+            # full train state (params + optimizer + step/rng): the warm
+            # start must land ON the federation's replicated trajectory,
+            # not merely near it — load_optimizer stays True here, unlike
+            # the fold-start pretrain broadcast where everyone is fresh
+            trainer.load_checkpoint(full_path=src, allow_torch=False)
+            self.cache["_train_state"] = trainer.train_state
+        else:
+            logger.warn(
+                f"joining site {self.state.get('clientId')} found no "
+                "warm-start weights broadcast; entering from a fresh init "
+                "(the federation's params replication invariant is broken "
+                "until convergence re-absorbs it)"
+            )
+        out[LocalWire.PHASE.value] = Phase.COMPUTATION.value
+        return out
+
     def _pretrain_local(self, trainer):
         """Designated site trains locally and ships its best weights
         (≙ ref ``local.py:152-170``)."""
@@ -406,6 +468,18 @@ class COINNLocal:
                 self.cache["_train_state"] = trainer.train_state
             self.out[LocalWire.PHASE.value] = Phase.COMPUTATION.value
 
+        # mid-run admission (ISSUE 15): a joiner's very first invocation
+        # arrives at the steady-state COMPUTATION phase carrying its
+        # admission record — adopt it (fold assignment, cursor sync, warm
+        # start) before the train-state restoration logic runs.  The
+        # split_file guard makes the entry exactly-once: every already-
+        # initialized member (and any retry after a completed join) skips.
+        admission = (self.input.get(RemoteWire.ADMISSIONS.value) or {}).get(
+            self.state.get("clientId", "site")
+        )
+        if admission is not None and not self.cache.get("split_file"):
+            self.out.update(**self._join_run(trainer, admission))
+
         if self.out[LocalWire.PHASE.value] == Phase.COMPUTATION.value and trainer.train_state is None:
             # later invocations within a fold: models are stateless flax defs;
             # the live train-state pytree persists in the cache (≙ the ref
@@ -437,13 +511,30 @@ class COINNLocal:
         learner = self._get_learner_cls(learner_cls)(trainer=trainer, mp_pool=mp_pool)
         client_id = self.state.get("clientId", "site")
         global_modes = self.input.get(RemoteWire.GLOBAL_MODES.value, {})
-        self.out[LocalWire.MODE.value] = global_modes.get(client_id, self.cache.get("mode"))
+        # a site absent from a non-empty uniform broadcast map (a joiner —
+        # the map was keyed from the round BEFORE its admission) follows
+        # the federation's consensus mode, not its stale constructor
+        # default: a joiner entering on a barrier round must barrier too
+        mode_fallback = self.cache.get("mode")
+        if global_modes and client_id not in global_modes:
+            modes = set(global_modes.values())
+            if len(modes) == 1:
+                mode_fallback = next(iter(modes))
+        self.out[LocalWire.MODE.value] = global_modes.get(client_id, mode_fallback)
         # echo the aggregator's round stamp verbatim (idempotent under
         # invocation retries): a delayed duplicate of an earlier message
         # echoes a stale counter, which is how the aggregator rejects it
         # (COINNRemote._check_lockstep_phases / proto-model-stale-contribution)
         if self.input.get(RemoteWire.ROUND.value) is not None:
             self.out[LocalWire.ROUND.value] = self.input[RemoteWire.ROUND.value]
+        # ... and the roster epoch alongside it (ISSUE 15): a redelivery
+        # out of a previous incarnation echoes the epoch of its dead life,
+        # which is how the membership filter refuses it
+        # (federation/membership.py / proto-model-roster)
+        if self.input.get(RemoteWire.ROSTER_EPOCH.value) is not None:
+            self.out[LocalWire.ROSTER_EPOCH.value] = self.input[
+                RemoteWire.ROSTER_EPOCH.value
+            ]
 
         rec = telemetry.get_active()
         if self.out[LocalWire.PHASE.value] == Phase.COMPUTATION.value:
@@ -459,6 +550,25 @@ class COINNLocal:
             ):
                 with rec.span("local:to_reduce", cat="backward"):
                     self.out.update(**learner.to_reduce())
+
+            # engine-brokered membership hooks (ISSUE 15; engine-provided
+            # input keys, see config/keys.py ENGINE_PROVIDED_KEYS):
+            # ``membership_sync`` asks this member to ship its live train
+            # state (params + optimizer, post-update) for a joiner's warm
+            # start — it rides the existing weights_file→pretrained_weights
+            # broadcast path; ``leave`` flags this round's contribution as
+            # the site's graceful last one (the reducer counts it, then the
+            # aggregator retires the site — never a site_died)
+            if self.input.get("membership_sync") and (
+                trainer.train_state is not None
+            ):
+                sync_name = f"member_sync.{self.cache['task_id']}.ckpt"
+                trainer.save_checkpoint(full_path=os.path.join(
+                    self.state.get("transferDirectory", "."), sync_name
+                ))
+                self.out[LocalWire.WEIGHTS_FILE.value] = sync_name
+            if self.input.get("leave"):
+                self.out[LocalWire.LEAVING.value] = True
 
             if global_modes and all(
                 m == Mode.VALIDATION.value for m in global_modes.values()

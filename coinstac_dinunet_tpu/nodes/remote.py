@@ -27,6 +27,7 @@ from ..config.keys import (
     GatherMode,
     Key,
     LocalWire,
+    Membership,
     Metric,
     Mode,
     Phase,
@@ -57,6 +58,53 @@ class COINNRemote:
             if LocalWire.SHARED_ARGS.value in site:
                 self.cache.update(**site[LocalWire.SHARED_ARGS.value])
                 self.cache[Key.ARGS_CACHED.value] = True
+
+    # ------------------------------------------------------ elastic membership
+    def _check_membership(self):
+        """Elastic-membership round processing (ISSUE 15,
+        :mod:`~..federation.membership`), run BEFORE the quorum check and
+        before any reducer/trainer snapshots ``self.input`` — the same
+        ordering contract the quorum filtering pins:
+
+        1. drain the engine's join/rejoin request queue
+           (``cache['membership_requests']``) into admission records —
+           one roster-epoch bump per joiner, broadcast this round as
+           :attr:`~..config.keys.RemoteWire.ADMISSIONS`; a pending
+           admission also reuses the pretrain-broadcast path
+           (:meth:`_pre_compute`) to relay the donor's shipped live
+           weights (``weights_file``) to the joiner's warm start;
+        2. refuse payloads **by roster epoch**: a non-member's output, or
+           an echo of :attr:`~..config.keys.LocalWire.ROSTER_EPOCH` older
+           than the site's current admission, is a redelivery out of a
+           previous incarnation — dropped from the round exactly as the
+           quorum filter drops a reappeared dead site, never aggregated.
+
+        Graceful-leave retirement runs at the END of compute
+        (:func:`~..federation.membership.retire_leaving`): the leaver's
+        flagged final contribution must first be counted by the reduce.
+        """
+        from ..federation import membership as _membership
+
+        # the epoch gate runs FIRST: a still-unadmitted rejoiner's stale
+        # payload must be judged against the roster as it stood when the
+        # payload was sent, and an arriving joiner's first contribution
+        # ends its joining grace (clearing the retry-safety ``pending``
+        # record) before the re-broadcast below would redundantly ship it
+        filtered, refused = _membership.filter_membership(
+            self.cache, dict(self.input)
+        )
+        if refused:
+            self.input = utils.FrozenDict(filtered)
+        self._admissions = _membership.process_admissions(self.cache)
+        if self._admissions:
+            self.out[RemoteWire.ADMISSIONS.value] = self._admissions
+            # warm start (the existing pretrain-broadcast path): the
+            # engine asked a donor member to ship its live weights in the
+            # same round it queued the admission.  Re-runs (a retried
+            # attempt, a still-joining re-broadcast) are safe: the copy is
+            # driven by this round's input and no-ops once the donor's
+            # shipped checkpoint is out of it.
+            self.out.update(**self._pre_compute())
 
     # ---------------------------------------------------------- site dropout
     @staticmethod
@@ -98,11 +146,27 @@ class COINNRemote:
         participation-weighted (absent sites simply contribute nothing),
         so the math degrades to the survivor average — the documented
         semantics, never a silent re-weighting.  Once dropped, a site
-        stays dropped (its mid-round state is gone); quorum is always
-        judged against the ORIGINAL roster."""
+        stays dropped (its mid-round state is gone) unless elastic
+        membership re-admits it with a FRESH incarnation
+        (:func:`~..federation.membership.process_admissions` clears the
+        drop); quorum is judged against the CURRENT roster —
+        ``cache['all_sites']`` mirrors the live member list under elastic
+        membership (ISSUE 15), and a just-admitted joiner whose first
+        contribution is still in flight (the roster's ``joining`` grace
+        set) neither counts as dropped nor inflates the need."""
         roster = self.cache.get("all_sites")
         if not roster:
             return
+        joining = set(
+            (self.cache.get(Membership.ROSTER) or {}).get("joining") or ()
+        )
+        if joining:
+            # the admission takes effect on the wire one round after the
+            # broadcast: a joiner absent from this round's input is not
+            # yet DROPPED, and the quorum need is judged without it
+            roster = [s for s in roster if s not in joining]
+            if not roster:
+                return
         prev = set(self.cache.get("dropped_sites", []))
         returned = prev & set(self.input.keys())
         if returned:
@@ -172,9 +236,17 @@ class COINNRemote:
         if self.cache.get("seed") is None:
             self.cache["seed"] = config.current_seed
         # engines pre-seed the full consortium roster (a round-0 death must
-        # count against the original n_sites); standalone deployments fall
-        # back to the INIT round's participants
+        # count against the founding n_sites); standalone deployments fall
+        # back to the INIT round's participants.  The roster record
+        # (federation/membership.py) is materialized here at epoch 1 —
+        # every membership change after INIT bumps it, and
+        # cache['all_sites'] mirrors the CURRENT member list from then on
         self.cache.setdefault("all_sites", sorted(self.input.keys()))
+        from ..federation import membership as _membership
+
+        roster = _membership.MembershipRoster.load(self.cache)
+        if roster is not None:
+            roster.save(self.cache)
         self.cache[Key.GLOBAL_TEST_SERIALIZABLE.value] = []
         self.cache["data_size"] = {
             site: site_vars.get(LocalWire.DATA_SIZE.value)
@@ -201,7 +273,10 @@ class COINNRemote:
         self.cache[Key.TEST_METRICS.value] = []
 
         train_sizes = {
-            site: (self.cache["data_size"][site] or {})
+            # .get twice: a mid-run joiner (ISSUE 15) reaches later fold
+            # transitions without an INIT data_size probe — it simply
+            # cannot be the pretrain designee and never sets the pace
+            site: (self.cache["data_size"].get(site) or {})
             .get(split_ix, {})
             .get("train", 0)
             for site in self.input
@@ -213,6 +288,9 @@ class COINNRemote:
             (math.ceil(n / batch_size) for n in train_sizes.values() if n),
             default=1,
         )
+        # cached for mid-run admissions: a joiner's admission record must
+        # carry the CURRENT fold's lockstep pace (federation/membership.py)
+        self.cache["target_batches"] = target_batches
         out = {}
         for site in self.input:
             fold = {**self.cache["fold"]}
@@ -464,16 +542,48 @@ class COINNRemote:
                 )
                 for site, lag in sorted(stale.items()):
                     rec.metric(Metric.SITE_STALENESS, float(lag), site=site)
+        # the roster-epoch half of the lockstep contract (ISSUE 15): every
+        # echoed ROSTER_EPOCH must be AT MOST the aggregator's current
+        # epoch — a site claiming a future roster ("roster_epoch" ahead of
+        # the broadcast) can only be a cross-run or forged message and is
+        # refused loudly.  Echoes LAGGING the current epoch are legitimate
+        # (epoch bumps overtake in-flight rounds); echoes older than the
+        # site's own admission were already dropped by the membership
+        # filter (federation/membership.py) before this check ran.
+        roster_rec = self.cache.get(Membership.ROSTER) or {}
+        cur_epoch = roster_rec.get("epoch")
+        if cur_epoch is not None:
+            ahead = {}
+            for site, site_vars in self.input.items():
+                echo = site_vars.get(LocalWire.ROSTER_EPOCH.value)
+                if echo is not None and int(echo) > int(cur_epoch):
+                    ahead[site] = int(echo)
+            if ahead:
+                telemetry.get_active().event(
+                    "quorum:fail", cat="quorum",
+                    reason="roster epoch ahead", epoch=int(cur_epoch),
+                    ahead=ahead,
+                )
+                raise RuntimeError(
+                    f"roster epoch violation: sites {ahead} echo a roster "
+                    f"epoch ahead of the aggregator's ({int(cur_epoch)}) — "
+                    "a cross-run or forged membership message; refusing "
+                    "to aggregate"
+                )
 
     # -------------------------------------------------------------- main loop
     def compute(self, mp_pool=None, trainer_cls=None, reducer_cls=None, **kw):
         utils.maybe_enable_compilation_cache(self.cache)
-        # quorum filtering MUST precede the trainer/reducer construction:
-        # both snapshot ``self.input``, so a reappeared dropped site filtered
-        # only afterwards would still reach the reduce and its stale payload
-        # would be silently double-counted into the global average — the
-        # ``proto-model-stale-contribution`` counterexample the tier-4 model
-        # checker surfaced (dinulint --model, docs/ANALYSIS.md "Tier 4")
+        # membership + quorum filtering MUST precede the trainer/reducer
+        # construction: both snapshot ``self.input``, so a reappeared
+        # dropped site (or a stale incarnation refused by roster epoch)
+        # filtered only afterwards would still reach the reduce and its
+        # stale payload would be silently double-counted into the global
+        # average — the ``proto-model-stale-contribution`` counterexample
+        # the tier-4 model checker surfaced (dinulint --model,
+        # docs/ANALYSIS.md "Tier 4"; the roster variant is
+        # ``proto-model-roster``)
+        self._check_membership()
         self._check_quorum()
         self._check_lockstep_phases()
         trainer = trainer_cls(
@@ -503,6 +613,11 @@ class COINNRemote:
         self.out[RemoteWire.ROUND.value] = (
             int(self.cache.get("wire_round") or 0) + 1
         )
+        # the roster epoch rides every broadcast alongside the round stamp
+        # (echoed back verbatim — the membership filter's refusal basis)
+        roster_rec = self.cache.get(Membership.ROSTER)
+        if isinstance(roster_rec, dict) and "epoch" in roster_rec:
+            self.out[RemoteWire.ROSTER_EPOCH.value] = int(roster_rec["epoch"])
 
         rec = telemetry.get_active()
         self.out[RemoteWire.GLOBAL_MODES.value] = self._set_mode()
@@ -541,6 +656,20 @@ class COINNRemote:
                 self.out.update(**self._send_global_scores(trainer))
                 self.out[RemoteWire.PHASE.value] = Phase.SUCCESS.value
 
+        # graceful-leave retirement (ISSUE 15): AFTER every dispatch block
+        # consumed the round's input — the leaver's flagged final
+        # contribution was counted by the reduce above, so retiring it now
+        # costs nothing (epoch bump, shrunken roster from next round on;
+        # never a site_died, never a retry cycle)
+        from ..federation import membership as _membership
+
+        _membership.retire_leaving(self.cache, {
+            site: site_vars
+            for site, site_vars in self.input.items()
+            if isinstance(site_vars, dict)
+            and site_vars.get(LocalWire.LEAVING.value)
+        })
+
         # federation-wide health rollup: the aggregator's own watchdog
         # findings (reduce-side divergence/nonfinite/stall) merged with
         # every site's shipped summary, broadcast back so each site can
@@ -548,6 +677,7 @@ class COINNRemote:
         if rec.enabled:
             fed = dict(telemetry.Watchdog(self.cache, rec).summary())
             per_site = {}
+            caps = {}
             for site, site_vars in self.input.items():
                 h = site_vars.get(LocalWire.HEALTH.value)
                 if h:
@@ -557,7 +687,17 @@ class COINNRemote:
                     # the same health broadcast (telemetry/perf.py)
                     if h.get("perf"):
                         entry["perf"] = h["perf"]
+                        sps = h["perf"].get("samples_per_sec")
+                        if sps:
+                            caps[site] = float(sps)
                     per_site[site] = entry
+            if caps:
+                # observed per-site throughput — the capacity-aware reduce
+                # weighting's data source (parallel/reducer.py,
+                # cache['capacity_weight']; ROADMAP 3b)
+                cap_rec = dict(self.cache.get(Membership.SITE_CAPACITY) or {})
+                cap_rec.update(caps)
+                self.cache[Membership.SITE_CAPACITY] = cap_rec
             if per_site:
                 fed["sites"] = per_site
             if fed:

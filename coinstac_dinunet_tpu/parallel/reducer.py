@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import config
-from ..config.keys import Federation
+from ..config.keys import Federation, Membership
 from ..resilience.retry import RetryPolicy
 from ..telemetry import get_active as _telemetry
 from ..telemetry import health as _health
@@ -265,7 +265,61 @@ class COINNReducer:
                 w * (gamma ** int(staleness.get(s, 0) or 0))
                 for w, s in zip(weights, sites)
             ]
-        return jnp.asarray(weights, jnp.float32)
+        caps = self._capacity_factors(sites)
+        if caps is not None:
+            weights = [w * c for w, c in zip(weights, caps)]
+        return self._renormalize_epoch(
+            jnp.asarray(weights, jnp.float32), sites
+        )
+
+    def _capacity_factors(self, sites):
+        """Opt-in capacity-aware weighting factors (ROADMAP 3b seed,
+        ``cache['capacity_weight']``, off by default): each participant's
+        factor is its observed throughput — the HEALTH rollup's per-site
+        samples/sec, refreshed into ``cache['site_capacity']`` by the
+        aggregator every round — normalized by the mean over THIS round's
+        participants with a reading.  Equal capacities therefore produce
+        factors of exactly 1.0 (identical to the uniform weighting,
+        property-tested), the factors re-center automatically at every
+        roster epoch (a join/leave shifts the mean, never skews it), and
+        a site without a reading yet (a fresh joiner's first rounds)
+        weighs neutrally at 1.0.  Composes multiplicatively with the
+        participation/staleness weighting here and the survivor/
+        nonfinite/quarantine weighting downstream."""
+        if not self.cache.get(Membership.CAPACITY_WEIGHT):
+            return None
+        caps = self.cache.get(Membership.SITE_CAPACITY) or {}
+        known = [float(caps[s]) for s in sites if caps.get(s)]
+        if not known:
+            return None
+        mean = sum(known) / len(known)
+        if mean <= 0.0:
+            return None
+        return [
+            float(caps[s]) / mean if caps.get(s) else 1.0 for s in sites
+        ]
+
+    def _renormalize_epoch(self, weights, sites):
+        """Per-epoch fan-in renormalization (ISSUE 15): once the roster
+        has churned (roster epoch > 1), the composed weight vector is
+        re-centered to mean 1 over this round's participants.  The
+        weighted mean itself is scale-invariant, but the absolute scale
+        is not inert: a shrunken roster whose survivors are all
+        staleness/capacity-discounted can push ``sum(w)`` under the
+        ``max(sum(w), 1.0)`` guard floor in the compiled means, silently
+        biasing the round toward zero — and the health/survivor series
+        would otherwise record weights whose scale drifts with every
+        join/leave.  A no-op while the roster is the founding one
+        (epoch 1), keeping fixed-roster trajectories bit-identical to the
+        pre-membership engines."""
+        roster = self.cache.get(Membership.ROSTER)
+        if not (isinstance(roster, dict)
+                and int(roster.get("epoch", 1) or 1) > 1):
+            return weights
+        total = float(jnp.sum(weights))
+        if total <= 0.0:
+            return weights
+        return weights * jnp.float32(float(len(sites)) / total)
 
     # ---------------------------------------------------------------- reduce
     def _average(self, site_leaves, weights=None, payload=None):

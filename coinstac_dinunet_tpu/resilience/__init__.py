@@ -28,6 +28,7 @@ from .chaos import (  # noqa: F401
     ChaosFault,
     ChaosHang,
     ChaosSession,
+    churn_plan,
     fraction_kill_plan,
     load_fault_plan,
 )

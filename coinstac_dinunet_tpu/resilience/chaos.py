@@ -72,6 +72,7 @@ FAULT_KINDS = (
     "drop_relay", "duplicate_delivery",
     "stale", "reappear",
     "worker_kill",
+    "join", "leave", "rejoin",
 )
 _INVOKE_KINDS = ("crash", "hang", "slow")
 _PAYLOAD_KINDS = ("truncate_payload", "corrupt_payload")
@@ -87,6 +88,17 @@ _RELAY_KINDS = ("drop_relay", "duplicate_delivery")
 #:   output is redelivered — the dropped-site-reappears scenario whose
 #:   stale payload only the aggregator's roster filtering can reject.
 _REPLAY_KINDS = ("stale", "reappear")
+#: elastic-membership churn ops (ISSUE 15, ``federation/membership.py``):
+#: not faults at all but deterministic ROSTER transitions the engines'
+#: churn hook executes at the pinned round — ``leave`` retires the site
+#: gracefully (flagged final contribution, never a site_died), ``join``
+#: admits a brand-new site mid-run through the admission handshake, and
+#: ``rejoin`` re-admits a previously dead or left site with a fresh
+#: incarnation (its old payloads refused by roster epoch).  Engines query
+#: :meth:`ChaosSession.membership_ops` once per round;
+#: :func:`churn_plan` builds the "churn N% of the roster per round"
+#: drill schedules from them.
+_MEMBERSHIP_KINDS = ("join", "leave", "rejoin")
 #: daemon-only process fault (``federation/daemon.py``): SIGKILL the
 #: target's long-lived worker process.  ``when`` picks the kill point:
 #: ``"invoke"`` (default) kills it mid-invocation — the supervisor must
@@ -152,6 +164,7 @@ class Fault:
         self.file = str(spec["file"]) if spec.get("file") is not None else None
         if self.site is None and self.kind in (
             _INVOKE_KINDS + _PAYLOAD_KINDS + _REPLAY_KINDS + _WORKER_KINDS
+            + _MEMBERSHIP_KINDS
         ):
             raise ValueError(
                 f"fault[{index}] ({self.kind}): 'site' is required"
@@ -257,6 +270,71 @@ def slow_site_plan(site="site_0", seconds=0.25, first_round=2,
     ]}
 
 
+def churn_plan(n_sites, fraction, first_round=2, rounds=4, seed=0,
+               min_active_frac=0.5):
+    """Deterministic elastic-membership churn plan (ISSUE 15): every round
+    in ``[first_round, first_round + rounds)`` accrues ``fraction ·
+    active`` of churn credit and fires one roster transition per whole
+    credit, cycling leave → join → rejoin — the "churn 10% of 2,000 sites
+    per round" drill, scaled to any roster.  Fractional credit CARRIES
+    (10% of 3 sites is one op every ~3 rounds, not one per round — the
+    ceil would triple the drill on small rosters).
+
+    The generator simulates the roster as it schedules, so the plan is
+    always self-consistent: only active sites leave, joins mint fresh site
+    ids past the founding roster, rejoins re-admit previously-left sites
+    (falling back to a join when nobody has left yet), and the active
+    roster never drops below ``min_active_frac`` of the founding size
+    (leaves degrade to joins there — churn must drill elasticity, not
+    starve quorum).  Same ``(n_sites, fraction, seed)`` → the same
+    schedule, so a churn run stays comparable against its golden run.
+
+    Returns a plan dict in the :func:`load_fault_plan` schema (pass it as
+    ``fault_plan=`` to any engine; the engines' churn hook
+    ``_membership_round`` executes the ops)."""
+    import math
+    import random as _random
+
+    n_sites = int(n_sites)
+    if not 0.0 < float(fraction) < 1.0:
+        raise ValueError(
+            f"fraction {fraction!r} must be strictly in (0, 1) — churning "
+            "nobody or everybody is not an elasticity drill"
+        )
+    rng = _random.Random(int(seed))
+    active = [f"site_{i}" for i in range(n_sites)]
+    left = []
+    next_new = n_sites
+    floor = max(1, int(math.ceil(float(min_active_frac) * n_sites)))
+    faults = []
+    ops_cycle = ("leave", "join", "rejoin")
+    op_ix = 0
+    credit = 0.0
+    for r in range(int(first_round), int(first_round) + int(rounds)):
+        credit += float(fraction) * len(active)
+        n_ops = int(credit)
+        credit -= n_ops
+        for _ in range(n_ops):
+            kind = ops_cycle[op_ix % len(ops_cycle)]
+            op_ix += 1
+            if kind == "rejoin" and not left:
+                kind = "join"
+            if kind == "leave" and len(active) <= floor:
+                kind = "join"
+            if kind == "leave":
+                site = active.pop(rng.randrange(len(active)))
+                left.append(site)
+            elif kind == "rejoin":
+                site = left.pop(0)
+                active.append(site)
+            else:  # join
+                site = f"site_{next_new}"
+                next_new += 1
+                active.append(site)
+            faults.append({"kind": kind, "round": r, "site": site})
+    return {"faults": faults}
+
+
 def load_fault_plan(spec):
     """Fault plan (dict or JSON file path) → validated list of faults."""
     if isinstance(spec, (str, os.PathLike)):
@@ -294,6 +372,9 @@ class _NullChaos:
 
     def worker_fault(self, rnd, site, rec, when="invoke"):
         return None
+
+    def membership_ops(self, rnd, rec):
+        return ()
 
     def reappear_deliveries(self, rnd, rec):
         return ()
@@ -421,6 +502,21 @@ class ChaosSession:
             self._fire(fault, rec, when=fault.when)
             return fault
         return None
+
+    def membership_ops(self, rnd, rec):
+        """Elastic-membership churn ops pinned to this round, in plan
+        order: ``[(kind, site), ...]`` with kind in join/leave/rejoin.
+        The ENGINES act on them (``add_site``/``remove_site`` — the churn
+        hook ``_membership_round``); each op fires exactly once."""
+        ops = []
+        for fault in self.faults:
+            if fault.kind not in _MEMBERSHIP_KINDS:
+                continue
+            if not (fault.matches(rnd) and fault.can_fire()):
+                continue
+            self._fire(fault, rec)
+            ops.append((fault.kind, fault.site))
+        return ops
 
     def reappear_deliveries(self, rnd, rec):
         """Sites whose reappear redelivery is due this round (their death

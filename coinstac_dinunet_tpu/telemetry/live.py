@@ -65,6 +65,7 @@ VERDICT_SEVERITY = {
     Live.VERDICT_RETRY_STORM: "warning",
     Live.VERDICT_STALENESS: "warning",
     Live.VERDICT_PIPELINE: "warning",
+    Live.VERDICT_QUORUM_EROSION: "warning",
 }
 
 
@@ -189,12 +190,14 @@ class LiveState:
     """
 
     def __init__(self, silence_after=30.0, round_outlier=4.0,
-                 mfu_collapse=0.3, retry_storm=10, retry_window=30.0):
+                 mfu_collapse=0.3, retry_storm=10, retry_window=30.0,
+                 quorum_headroom=1):
         self.silence_after = float(silence_after)
         self.round_outlier = float(round_outlier)
         self.mfu_collapse = float(mfu_collapse)
         self.retry_storm = int(retry_storm)
         self.retry_window = float(retry_window)
+        self.quorum_headroom = int(quorum_headroom)
 
         self.sites = {}
         self.round = 0
@@ -229,6 +232,18 @@ class LiveState:
         # daemon frame-pipe byte counters (daemon:frame events) — the
         # delta-cache win is the tx/rx trend across a run
         self.frame_bytes = {"tx": 0, "rx": 0, "frames": 0}
+        # elastic membership (ISSUE 15): the roster as the membership:*
+        # event stream reports it — current epoch, live member count,
+        # per-kind transition counters, joiners whose first record has
+        # not shown yet, gracefully retired sites, and the quorum need
+        # the aggregator's membership events carry when a policy is
+        # configured (the quorum_erosion verdict's evidence)
+        self.roster_epoch = 0
+        self.roster_members = None
+        self.membership_changes = {}
+        self.joining = set()
+        self.left = set()
+        self.quorum_need = None
         # event-name counts (bounded by the event vocabulary): the watch
         # CLI's --assert-event gating reads this, it stays out of the
         # snapshot to keep /healthz stable
@@ -261,6 +276,7 @@ class LiveState:
             mfu_collapse=cache.get(Live.MFU_COLLAPSE, 0.3),
             retry_storm=cache.get(Live.RETRY_STORM, 10),
             retry_window=cache.get(Live.RETRY_WINDOW, 30.0),
+            quorum_headroom=cache.get(Live.QUORUM_HEADROOM, 1),
         )
 
     def site(self, name):
@@ -324,6 +340,8 @@ class LiveState:
             # candidate for the site silence verdict
             if site is not None and str(site) != "remote":
                 s = self.site(site)
+                # a joiner's first own record ends its joining grace
+                self.joining.discard(str(site))
                 if s["last_heartbeat"] is None or t0 > s["last_heartbeat"]:
                     s["last_heartbeat"] = t0
                 if s["last_seen"] is None or t0 > s["last_seen"]:
@@ -423,6 +441,43 @@ class LiveState:
             self.worker_restarts += 1
             if site is not None and str(site) != "remote":
                 self.site(site)["worker_restarts"] += 1
+        elif name.startswith("membership:"):
+            # elastic-membership roster transitions (ISSUE 15,
+            # federation/membership.py + the engines' churn hooks): the
+            # roster line, the membership_changes_total{kind=} exports
+            # and the quorum_erosion verdict all key off these
+            kind = name.split(":", 1)[1]
+            self.membership_changes[kind] = (
+                self.membership_changes.get(kind, 0) + 1
+            )
+            try:
+                self.roster_epoch = max(self.roster_epoch,
+                                        int(rec.get("epoch", 0) or 0))
+            except (TypeError, ValueError):
+                pass
+            if rec.get("members") is not None:
+                try:
+                    self.roster_members = int(rec["members"])
+                except (TypeError, ValueError):
+                    pass
+            if rec.get("quorum_need") is not None:
+                try:
+                    self.quorum_need = int(rec["quorum_need"])
+                except (TypeError, ValueError):
+                    pass
+            if site is not None and kind in ("join", "rejoin", "leave"):
+                site = str(site)
+                if kind == "leave":
+                    self.left.add(site)
+                    self.joining.discard(site)
+                else:
+                    # a (re-)admission heals every prior exclusion: the
+                    # fresh incarnation is not the dead/left one
+                    self.left.discard(site)
+                    self.dead.discard(site)
+                    self.joining.add(site)
+                    if site in self.sites:
+                        self.sites[site]["dead"] = False
         elif name == "wire:retry":
             self.wire_retries += 1
             self._retry_times.append(t0)
@@ -624,6 +679,31 @@ class LiveState:
             self._rearm("pipeline_stall")
             self._pipeline_flowed = False
 
+        # elastic membership (ISSUE 15): the live roster eroded to within
+        # the configured headroom of the quorum need — one more leave or
+        # death fails the run.  Edge-triggered federation-wide, armed only
+        # while the aggregator's membership events report a quorum need
+        # (i.e. a site_quorum policy is configured); re-arms when joins/
+        # rejoins rebuild the headroom.
+        if self.quorum_need is not None and self.roster_members is not None:
+            alive = self.roster_members - len(self.dead - self.left)
+            headroom = alive - self.quorum_need
+            if headroom < self.quorum_headroom:
+                v = self._fire(
+                    "quorum_erosion", Live.VERDICT_QUORUM_EROSION,
+                    "live roster eroding toward the quorum floor",
+                    f"{alive} live members vs quorum need "
+                    f"{self.quorum_need} (headroom {headroom} < "
+                    f"{self.quorum_headroom}) at roster epoch "
+                    f"{self.roster_epoch}: one more leave or death fails "
+                    "the run",
+                    now,
+                )
+                if v:
+                    fired.append(v)
+            else:
+                self._rearm("quorum_erosion")
+
         if len(self.round_durs) >= _ROUND_MIN_SAMPLES:
             *window, last = self.round_durs
             med = statistics.median(window)
@@ -744,6 +824,15 @@ class LiveState:
             "pipeline_stalls": self.pipeline_stalls,
             "reduce_concurrent_s": round(self.reduce_concurrent_s, 4),
             "frame_bytes": dict(self.frame_bytes),
+            "roster": {
+                "epoch": self.roster_epoch,
+                "members": self.roster_members,
+                "joining": sorted(self.joining),
+                "left": sorted(self.left),
+                "dead": sorted(self.dead - self.left),
+                "changes": dict(self.membership_changes),
+                "quorum_need": self.quorum_need,
+            },
             "wire_retries": self.wire_retries,
             "corruption_recovered": self.corruption_recovered,
             "dead_sites": sorted(self.dead),
@@ -808,6 +897,26 @@ def render_board(snap, root=""):
         lines.append(
             f"daemon frames {fb['frames']} · tx {_fmt_bytes(fb['tx'])} · "
             f"rx {_fmt_bytes(fb['rx'])}"
+        )
+    roster = snap.get("roster") or {}
+    if roster.get("epoch"):
+        # elastic membership only: the line appears once the roster has a
+        # versioned epoch (a membership:* event flowed) — fixed-roster
+        # boards stay unchanged
+        ch = roster.get("changes") or {}
+        changes = " ".join(
+            f"{k}={ch[k]}" for k in ("join", "leave", "rejoin", "refused")
+            if ch.get(k)
+        )
+        need = roster.get("quorum_need")
+        lines.append(
+            f"roster epoch {roster['epoch']} · "
+            f"members {roster.get('members') if roster.get('members') is not None else '-'} · "
+            f"joining: {', '.join(roster.get('joining') or ()) or '-'} · "
+            f"left: {', '.join(roster.get('left') or ()) or '-'} · "
+            f"dead: {len(roster.get('dead') or ())}"
+            + (f" · quorum need {need}" if need is not None else "")
+            + (f" · {changes}" if changes else "")
         )
     if snap["sites"]:
         width = max(len(n) for n in snap["sites"])

@@ -173,12 +173,23 @@ def render_prometheus(snap):
                   "daemon frame-pipe bytes by direction (the delta-cache "
                   "win is the per-invoke trend)",
                   labels={"dir": direction})
+    roster = snap.get("roster") or {}
+    w.gauge("roster_size", roster.get("members"),
+            "live elastic-membership roster size (absent on fixed-roster "
+            "runs)")
+    changes = roster.get("changes") or {}
+    if roster.get("epoch") or changes:
+        for kind in ("join", "leave", "rejoin", "refused"):
+            w.counter("membership_changes_total", changes.get(kind, 0),
+                      "elastic-membership roster transitions, by kind",
+                      labels={"kind": kind})
     by_kind = {}
     for v in snap.get("verdicts") or ():
         by_kind[v["verdict"]] = by_kind.get(v["verdict"], 0) + 1
     for kind in (Live.VERDICT_SILENCE, Live.VERDICT_ROUND_OUTLIER,
                  Live.VERDICT_MFU_COLLAPSE, Live.VERDICT_RETRY_STORM,
-                 Live.VERDICT_STALENESS, Live.VERDICT_PIPELINE):
+                 Live.VERDICT_STALENESS, Live.VERDICT_PIPELINE,
+                 Live.VERDICT_QUORUM_EROSION):
         w.counter("verdicts_total", by_kind.get(kind, 0),
                   "in-flight stall verdicts fired, by kind",
                   labels={"kind": kind})

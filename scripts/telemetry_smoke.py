@@ -24,7 +24,15 @@ acceptance contract: ``wire:corruption_recovered`` and ``invoke:retry``
 events in the merged trace, a ``site_died`` event carrying the exhausted
 attempt count, and a ``telemetry doctor`` postmortem naming every injected
 fault — the chaos gate, run by the CI ``chaos`` job which uploads the
-markdown postmortem as an artifact.
+markdown postmortem as an artifact.  ``--fault-plan churn`` is the
+elastic-membership variant (ISSUE 15): one graceful leave, one mid-run
+join, one kill+rejoin — the smoke additionally asserts one
+``membership:<kind>`` event per planned roster transition, a zero-cost
+leave (no ``site_died``/``invoke:retry`` for the leaver), and the final
+roster record (one epoch bump per op, joiners admitted at fresh epochs);
+the CI ``churn`` job runs it under ``telemetry watch --assert-event
+membership:join`` and uploads the postmortem + executed plan as the
+``churn-postmortem`` artifact.
 
 With ``--capture-on-anomaly`` the run additionally enables the perf flight
 recorder's anomaly-triggered profiler capture
@@ -79,7 +87,11 @@ def main(argv=None):
                         "live-watch variant (hung site at round 3 plus slow "
                         "rounds on a survivor, so the run provably outlives "
                         "the silence threshold while `telemetry watch` "
-                        "fires the heartbeat-silence verdict in flight)")
+                        "fires the heartbeat-silence verdict in flight); "
+                        "'churn' is the elastic-membership variant (one "
+                        "graceful leave, one mid-run join, one kill+rejoin "
+                        "— forces >= 3 sites; the CI churn job gates it "
+                        "with `--assert-event membership:join`)")
     args = p.parse_args(argv)
     if args.capture_on_anomaly and args.inject_nan_site is None:
         # the capture assertions need a deterministic anomaly source — a
@@ -132,6 +144,26 @@ def main(argv=None):
                  "file": "grads.npy"},
                 {"kind": "hang", "round": 3, "site": "site_1"},
             ]}
+        elif args.fault_plan == "churn":
+            # the elastic-membership acceptance plan (ISSUE 15,
+            # federation/membership.py): one graceful leave (the final
+            # contribution counts, then the site retires — never a
+            # site_died, never a retry cycle), one mid-run join (admission
+            # handshake; the joiner's first contribution is due the round
+            # AFTER its admission), and one kill+rejoin (a permanent crash
+            # exhausts the invocation retries into a site_died, then the
+            # re-admission path reverses the death at a fresh roster
+            # epoch).  The CI `churn` job runs this under `telemetry watch
+            # --assert-event membership:join` and ships the doctor
+            # postmortem + this executed plan as the churn-postmortem
+            # artifact.
+            args.sites = max(args.sites, 3)
+            fault_plan = {"faults": [
+                {"kind": "leave", "round": 3, "site": "site_2"},
+                {"kind": "crash", "round": 4, "site": "site_1"},
+                {"kind": "join", "round": 5, "site": "site_3"},
+                {"kind": "rejoin", "round": 7, "site": "site_1"},
+            ]}
         elif args.fault_plan == "stall":
             # the live-watch acceptance plan: after the hang kills site_1 at
             # round 3, every later round is slowed on the surviving site_0
@@ -168,15 +200,29 @@ def main(argv=None):
         dataset_cls=(NaNFSVDataset if nan_site else FSVDataset),
         task_id="fsv_classification",
         data_dir="data", split_ratio=[0.6, 0.2, 0.2], batch_size=4,
-        epochs=2, validation_epochs=1, learning_rate=5e-2, input_size=12,
+        # the churn plan's last op (the rejoin at round 7) plus the
+        # rejoined site's first fresh contribution must land before the
+        # run reaches SUCCESS — 6 epochs keeps the round budget safely
+        # past the plan's horizon
+        epochs=(6 if args.fault_plan == "churn" else 2),
+        validation_epochs=1, learning_rate=5e-2, input_size=12,
         hidden_sizes=[8], num_classes=2, seed=7, synthetic=True,
         patience=50, profile=True, fault_plan=fault_plan, **chaos_args,
         **capture_args,
         # site epoch counters are 0-based: 1 = the second epoch
         site_args=({nan_site: {"nan_from_epoch": 1}} if nan_site else None),
     )
-    for s in eng.site_ids:
-        d = eng.site_data_dir(s)
+    # a planned mid-run joiner's data must exist before its admission
+    # (synthetic FSV samples key off the subject file names, so the
+    # future slot's dataset is fully determined before the slot exists)
+    joiners = sorted(
+        str(ft["site"]) for ft in (fault_plan or {}).get("faults", ())
+        if ft["kind"] == "join" and str(ft["site"]) not in set(eng.site_ids)
+    )
+    for s in list(eng.site_ids) + joiners:
+        d = (eng.site_data_dir(s) if s in set(eng.site_ids)
+             else os.path.join(args.workdir, s, "data"))
+        os.makedirs(d, exist_ok=True)
         for i in range(12):
             with open(os.path.join(d, f"{s}_subj{i}.txt"), "w") as f:
                 f.write("x")
@@ -247,6 +293,8 @@ def main(argv=None):
             assert iretries, (
                 "no invoke:retry events — the retry engine never ran"
             )
+        rejoined = {str(ft["site"]) for ft in fault_plan["faults"]
+                    if ft["kind"] == "rejoin"}
         if hung_site:
             died = [e for e in evts if e["name"] == "site_died"]
             assert any(
@@ -257,7 +305,47 @@ def main(argv=None):
                 f"hung site {hung_site} was not quorum-dropped via retry "
                 f"exhaustion: {died}"
             )
-            assert eng.dead_sites == {hung_site}, eng.dead_sites
+            if hung_site in rejoined:
+                # the kill+rejoin scenario: the death fired (asserted
+                # above) but the re-admission path reversed it
+                assert hung_site not in eng.dead_sites, eng.dead_sites
+            else:
+                assert eng.dead_sites == {hung_site}, eng.dead_sites
+        mem_ops = [ft for ft in fault_plan["faults"]
+                   if ft["kind"] in ("join", "leave", "rejoin")]
+        if mem_ops:
+            from coinstac_dinunet_tpu.config.keys import Membership
+
+            # one membership:<kind> event per planned roster transition,
+            # site-attributed (the live board / --assert-event feed)
+            for ft in mem_ops:
+                wanted = f"membership:{ft['kind']}"
+                assert any(
+                    e["name"] == wanted and e.get("site") == ft["site"]
+                    for e in evts
+                ), (wanted, ft)
+            # a graceful leave costs nothing: never a site_died, never a
+            # retry cycle for the leaver
+            leavers = {ft["site"] for ft in mem_ops if ft["kind"] == "leave"}
+            for e in evts:
+                if e["name"] in ("site_died", "invoke:retry"):
+                    assert e.get("site") not in leavers, e
+            # the roster record: every planned op bumped the epoch exactly
+            # once, joiners/rejoiners are members at a fresh admission
+            # epoch, leavers retired
+            roster = eng.remote_cache.get(Membership.ROSTER) or {}
+            assert int(roster.get("epoch", 1)) == 1 + len(mem_ops), roster
+            for ft in mem_ops:
+                if ft["kind"] == "leave":
+                    assert ft["site"] in roster["left"], roster
+                    assert ft["site"] not in roster["members"], roster
+                else:
+                    assert roster["members"].get(ft["site"], 1) > 1, roster
+            print(
+                f"\nmembership scenario verified: {len(mem_ops)} roster "
+                f"transition(s), final epoch {roster['epoch']}, members "
+                f"{sorted(roster['members'])}"
+            )
         report = build_report(events)
         planned = {ft["kind"] for ft in fault_plan["faults"]}
         reported = {c["kind"] for c in report["chaos"]}

@@ -87,6 +87,7 @@ def test_async_k0_pool1_is_bit_identical_to_serial(tmp_path):
                       async_staleness=0, async_invoke_pool=1)
     assert eng._async_config() == {
         "enabled": True, "k": 0, "pool": 1, "run_ahead": 0,
+        "pool_auto": False,
     }
     try:
         eng.run(max_rounds=200)

@@ -1,0 +1,711 @@
+"""Elastic membership (ISSUE 15): sites join, leave and churn mid-run.
+
+The roster-epoch protocol (``federation/membership.py``) converts the
+fixed-at-INIT site roster into a versioned membership record owned by the
+aggregator: mid-run JOIN through an admission handshake (warm start via
+the pretrain-broadcast path, entry at the steady-state COMPUTATION phase),
+graceful LEAVE (a flagged final contribution that counts, then retirement
+— never a ``site_died``), and rejoin-after-death with stale incarnations
+refused by roster epoch.  These tests pin the ISSUE-15 contract:
+
+- **roster record**: admit/retire/refuse transitions + the quorum need
+  against the LIVE roster;
+- **acceptance**: the 3-site federation where site_2 leaves at round 3
+  and a fresh site_3 joins at round 5 runs to SUCCESS with zero deaths,
+  the joiner contributes to round r+1's reduce exactly once, the params
+  replication invariant survives the churn bitwise, and the monitored
+  best-validation score equals a golden fixed-roster run of the surviving
+  configuration;
+- **rejoin**: a chaos-killed site re-admits through the same handshake
+  (death is reversible) and payloads out of the dead incarnation are
+  refused by epoch;
+- **daemon**: a mid-run join spawns a fresh warm worker; a leave shuts
+  the leaver's worker down cleanly;
+- **vectorized plane**: membership rides the roster mask at a capacity
+  high-water mark (no recompiles), and the PR-15 satellite regression —
+  ``dead_sites`` was grow-only — is pinned: a rejoin restores the slot;
+- **reducer**: capacity-aware weighting (off by default, uniform when
+  capacities are equal) and the per-epoch renormalization;
+- **tier-4**: the ``join``/``leave``/``rejoin`` actions pass clean at the
+  default bound and each broken-roster switch yields exactly one finding
+  with a replayable churn plan;
+- **live plane**: the roster board line, the Prometheus roster exports
+  and the edge-triggered ``quorum_erosion`` verdict.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from coinstac_dinunet_tpu.config.keys import Live, Membership, ModelCheck
+from coinstac_dinunet_tpu.engine import InProcessEngine
+from coinstac_dinunet_tpu.federation import SiteVectorizedEngine
+from coinstac_dinunet_tpu.federation.membership import (
+    MembershipRoster,
+    filter_membership,
+    process_admissions,
+    retire_leaving,
+)
+from coinstac_dinunet_tpu.models import FSVDataset, FSVTrainer
+from coinstac_dinunet_tpu.resilience.chaos import churn_plan, load_fault_plan
+from coinstac_dinunet_tpu.telemetry.live import LiveState, render_board
+from coinstac_dinunet_tpu.telemetry.serve import render_prometheus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "fsv_classification")
+
+# hidden_sizes=[] keeps the model CONVEX: the churned and the golden
+# trajectories pass through different intermediate rosters but converge to
+# the same global optimum, so the monitored best-validation plateau is an
+# exact-equality comparison rather than a tolerance band.
+ARGS = dict(
+    data_dir="data", split_ratio=[0.6, 0.2, 0.2], batch_size=4, epochs=16,
+    validation_epochs=2, learning_rate=5e-2, input_size=64, hidden_sizes=[],
+    num_classes=2, seed=7, synthetic=True, verbose=False, patience=50,
+)
+N_SITES = 3
+
+
+def _fill(eng, names=None, per_site=10):
+    names = names or {}
+    for s in eng.site_ids:
+        d = eng.site_data_dir(s)
+        for i in range(per_site):
+            with open(os.path.join(
+                d, f"{names.get(s, s)}_subj{i}.txt"
+            ), "w") as f:
+                f.write("x")
+
+
+def _provision_joiner(workdir, site, per_site=10):
+    """Pre-place the future joiner's data: synthetic FSV samples key off
+    the subject FILE names, so the joiner's dataset is fully determined
+    before the slot exists."""
+    d = os.path.join(str(workdir), site, "data")
+    os.makedirs(d, exist_ok=True)
+    for i in range(per_site):
+        with open(os.path.join(d, f"{site}_subj{i}.txt"), "w") as f:
+            f.write("x")
+
+
+def _fsv_engine(workdir, fault_plan=None, **extra):
+    eng = InProcessEngine(
+        workdir, n_sites=N_SITES, trainer_cls=FSVTrainer,
+        dataset_cls=FSVDataset, task_id="fsv_classification",
+        fault_plan=fault_plan, **{**ARGS, **extra},
+    )
+    _fill(eng)
+    return eng
+
+
+# ------------------------------------------------------------ roster record
+def test_roster_record_lifecycle():
+    roster = MembershipRoster(1, {"site_0": 1, "site_1": 1})
+    assert roster.quorum_need(0.5) == 1 and roster.quorum_need(2) == 2
+
+    epoch = roster.admit("site_2")
+    assert epoch == 2 and roster.is_member("site_2")
+    assert "site_2" in roster.joining
+    # a non-member payload and a previous-incarnation echo are refused;
+    # a None echo from a member is tolerated (pre-epoch peers)
+    assert roster.refuses("site_9", 2)
+    assert not roster.refuses("site_0", None)
+    assert not roster.refuses("site_2", 2)
+
+    epoch = roster.retire("site_2")
+    assert epoch == 3 and not roster.is_member("site_2")
+    assert "site_2" in roster.left and "site_2" not in roster.joining
+    assert roster.refuses("site_2", 3)
+    # rejoin after leave: fresh admission, old echoes refused
+    epoch = roster.admit("site_2")
+    assert epoch == 4 and roster.admitted_epoch("site_2") == 4
+    assert roster.refuses("site_2", 2) and not roster.refuses("site_2", 4)
+    assert "site_2" not in roster.left
+
+    # save mirrors the CURRENT member list into all_sites
+    cache = {}
+    roster.save(cache)
+    assert cache["all_sites"] == ["site_0", "site_1", "site_2"]
+    again = MembershipRoster.load(cache)
+    assert again.epoch == 4 and again.admitted_epoch("site_2") == 4
+
+    with pytest.raises(ValueError):
+        roster.quorum_need(1.5)
+
+
+def test_filter_membership_refuses_by_epoch_and_nonmember():
+    roster = MembershipRoster(1, {"site_0": 1, "site_1": 1})
+    roster.retire("site_1")           # epoch 2
+    roster.admit("site_1")            # epoch 3: fresh incarnation
+    cache = {}
+    roster.save(cache)
+    inp = {
+        "site_0": {"roster_epoch": 3, "reduce": True},
+        # the dead incarnation's redelivery echoes its old epoch
+        "site_1": {"roster_epoch": 1, "reduce": True},
+        # never a member at all
+        "site_9": {"roster_epoch": 3, "reduce": True},
+    }
+    filtered, refused = filter_membership(cache, inp)
+    assert sorted(refused) == ["site_1", "site_9"]
+    assert sorted(filtered) == ["site_0"]
+    assert "predates" in refused["site_1"]
+    assert refused["site_9"] == "not a roster member"
+
+    # the joining grace ends on the first ACCEPTED contribution
+    roster2 = MembershipRoster.load(cache)
+    assert "site_1" in roster2.joining
+    inp_ok = {"site_1": {"roster_epoch": 3, "reduce": True}}
+    filter_membership(cache, inp_ok)
+    assert "site_1" not in MembershipRoster.load(cache).joining
+
+
+def test_admission_survives_aggregator_retry():
+    """A failed aggregator attempt discards its output AFTER
+    process_admissions drained the request queue and bumped the epoch —
+    the healed retry must re-broadcast the IDENTICAL admission record
+    (same epoch, no second admission) from the roster's pending records,
+    or the join is silently lost."""
+    roster = MembershipRoster(1, {"site_0": 1, "site_1": 1})
+    cache = {"target_batches": 4}
+    roster.save(cache)
+    cache[Membership.REQUESTS] = [
+        {"op": "join", "site": "site_2", "sync": {"cursor": 7}}
+    ]
+
+    first = process_admissions(cache)
+    assert sorted(first) == ["site_2"]
+    assert first["site_2"]["roster_epoch"] == 2
+    assert first["site_2"]["cursor"] == 7
+
+    # the retried attempt: queue empty, roster already mutated — the
+    # same record comes back, the epoch does NOT bump again
+    retry = process_admissions(cache)
+    assert retry == first
+    assert MembershipRoster.load(cache).epoch == 2
+
+    # the daemon-engine retry shape: the engine's cache_patch rides every
+    # attempt, so the SAME request is re-injected into a cache whose live
+    # roster already admitted the site — deduped against the pending
+    # record, never a second admission
+    cache[Membership.REQUESTS] = [
+        {"op": "join", "site": "site_2", "sync": {"cursor": 7}}
+    ]
+    redelivered = process_admissions(cache)
+    assert redelivered == first
+    assert MembershipRoster.load(cache).epoch == 2
+
+    # the joiner's first accepted contribution retires the pending
+    # record: the round after, nothing is re-broadcast
+    filter_membership(cache, {"site_2": {"roster_epoch": 2, "reduce": 1}})
+    assert process_admissions(cache) == {}
+    assert MembershipRoster.load(cache).pending == {}
+
+
+def test_leaver_final_contribution_survives_aggregator_retry():
+    """retire_leaving runs at the end of compute; if the attempt then
+    fails, the healed retry re-sees the leaver's flagged final payload
+    with the site already retired.  The membership filter must readmit
+    exactly the in-flight round's payload (the reduce promised to count
+    it) while a LATER round's redelivery of the same files stays
+    refused."""
+    roster = MembershipRoster(1, {"site_0": 1, "site_1": 1})
+    cache = {"wire_round": 5}
+    roster.save(cache)
+    final = {"roster_epoch": 1, "leaving": True, "wire_round": 5,
+             "reduce": 1}
+
+    assert retire_leaving(cache, {"site_1": final}) == ["site_1"]
+    assert not MembershipRoster.load(cache).is_member("site_1")
+
+    # same-round retry: the flagged payload passes the filter
+    filtered, refused = filter_membership(
+        cache, {"site_0": {"roster_epoch": 2, "reduce": 1},
+                "site_1": dict(final)}
+    )
+    assert refused == {} and sorted(filtered) == ["site_0", "site_1"]
+
+    # a later round's redelivery of the SAME files lags wire_round and
+    # is refused as before — the retry exemption never double-counts
+    cache["wire_round"] = 6
+    filtered, refused = filter_membership(
+        cache, {"site_0": {"roster_epoch": 2, "reduce": 1},
+                "site_1": dict(final)}
+    )
+    assert sorted(filtered) == ["site_0"]
+    assert refused == {"site_1": "not a roster member"}
+
+
+# -------------------------------------------------------------- churn plans
+def test_churn_plan_schema_and_self_consistency():
+    plan = churn_plan(20, 0.10, first_round=2, rounds=4, seed=3)
+    assert load_fault_plan(plan)
+    same = churn_plan(20, 0.10, first_round=2, rounds=4, seed=3)
+    assert plan == same  # deterministic
+
+    active = {f"site_{i}" for i in range(20)}
+    left = []
+    for f in plan["faults"]:
+        kind, site = f["kind"], f["site"]
+        assert kind in ("join", "leave", "rejoin")
+        if kind == "leave":
+            assert site in active
+            active.discard(site)
+            left.append(site)
+        elif kind == "rejoin":
+            assert site == left.pop(0)  # re-admits previously-left sites
+            active.add(site)
+        else:
+            assert site not in active  # joins mint fresh ids
+            active.add(site)
+        assert len(active) >= 10  # the min_active_frac floor
+
+    with pytest.raises(ValueError):
+        churn_plan(20, 0.0)
+    with pytest.raises(ValueError):
+        churn_plan(20, 1.0)
+
+
+# ------------------------------------------------------- engine acceptance
+def test_graceful_leave_and_join_acceptance(tmp_path):
+    """ISSUE-15 acceptance: site_2 leaves gracefully at round 3, a fresh
+    site_3 joins at round 5, the run completes with zero deaths, the
+    joiner contributes to round r+1's reduce exactly once, params stay
+    bitwise replicated across the churned roster, and the monitored best
+    score equals a golden fixed-roster run of the surviving
+    configuration."""
+    plan = {"faults": [
+        {"kind": "leave", "round": 3, "site": "site_2"},
+        {"kind": "join", "round": 5, "site": "site_3"},
+    ]}
+    eng = _fsv_engine(tmp_path / "churn", fault_plan=plan)
+    _provision_joiner(tmp_path / "churn", "site_3")
+
+    admission_round = None
+    contributed = []   # rounds in which site_3's output reached the reduce
+    anchor = []        # the established site_0's reduce rounds, same window
+    succeeded = False
+    for rnd in range(1, 400):
+        site_outs, remote_out = eng.step_round()
+        if "site_3" in site_outs and site_outs["site_3"].get("reduce"):
+            contributed.append(rnd)
+        if admission_round is not None and rnd > admission_round and (
+            site_outs.get("site_0") or {}
+        ).get("reduce"):
+            anchor.append(rnd)
+        if admission_round is None and (
+            remote_out.get("admissions") or {}
+        ).get("site_3"):
+            admission_round = rnd
+            # the admission round's reduce must NOT include the joiner
+            assert "site_3" not in site_outs
+        if remote_out.get("phase") == "success":
+            succeeded = True
+            break
+    assert succeeded
+
+    # graceful leave: never a death, never a retry cycle
+    assert eng.dead_sites == set() and eng.site_failures == {}
+    assert eng.left_sites == {"site_2"}
+    # a joiner admitted at round r contributes from round r+1 on — exactly
+    # once per reduce round, starting exactly one round after the
+    # admission, in lockstep with the established members (not every round
+    # is a reduce round: validation rounds interleave)
+    assert admission_round is not None
+    assert contributed and contributed[0] == admission_round + 1
+    assert contributed == anchor
+
+    roster = eng.remote_cache[Membership.ROSTER]
+    assert roster["epoch"] == 3
+    assert sorted(roster["members"]) == ["site_0", "site_1", "site_3"]
+    assert roster["members"]["site_3"] == 3
+    assert roster["left"] == ["site_2"] and roster["joining"] == []
+    assert eng.remote_cache["all_sites"] == ["site_0", "site_1", "site_3"]
+
+    # the replication invariant survived the churn bitwise
+    import jax
+
+    flats = []
+    for s in eng._alive_site_ids():
+        ts = eng.site_caches[s]["_train_state"]
+        flats.append(np.concatenate([
+            np.asarray(x).ravel()
+            for x in jax.tree_util.tree_leaves(ts.params)
+        ]))
+    for flat in flats[1:]:
+        assert (flat == flats[0]).all()
+
+    # golden fixed-roster run of the SURVIVING configuration: same data
+    # (synthetic FSV samples key off subject file names), no churn
+    golden = InProcessEngine(
+        tmp_path / "golden", n_sites=N_SITES, trainer_cls=FSVTrainer,
+        dataset_cls=FSVDataset, task_id="fsv_classification", **ARGS,
+    )
+    _fill(golden, names={"site_2": "site_3"})
+    golden.run(max_rounds=300)
+    assert golden.success
+    assert (eng.remote_cache["best_val_score"]
+            == golden.remote_cache["best_val_score"])
+
+
+def test_rejoin_after_death_is_first_class(tmp_path):
+    """The ``reappear`` scenario upgraded: a chaos-killed site re-admits
+    through the join handshake with a FRESH incarnation — death is
+    reversible, the roster epoch bumps, and the run completes with the
+    site back in the reduce."""
+    plan = {"faults": [
+        {"kind": "crash", "round": 3, "site": "site_2"},  # permanent
+        {"kind": "rejoin", "round": 6, "site": "site_2"},
+    ]}
+    eng = _fsv_engine(tmp_path, fault_plan=plan, site_quorum=2,
+                      invoke_retry=False)
+    rejoined_contributes = False
+    succeeded = False
+    for rnd in range(1, 400):
+        site_outs, remote_out = eng.step_round()
+        if rnd > 7 and "site_2" in site_outs:
+            rejoined_contributes = True
+        if remote_out.get("phase") == "success":
+            succeeded = True
+            break
+    assert succeeded
+    assert "site_2" not in eng.dead_sites  # reversible
+    assert rejoined_contributes
+    roster = eng.remote_cache[Membership.ROSTER]
+    assert roster["members"]["site_2"] > 1  # fresh admission epoch
+    # the re-admission cleared the drop record
+    assert "site_2" not in (eng.remote_cache.get("dropped_sites") or [])
+
+
+def test_remote_node_refuses_rejoined_sites_old_incarnation():
+    """The COINNRemote wiring of the membership filter: after a rejoin,
+    a delayed redelivery out of the site's DEAD incarnation (an older
+    admission epoch echo) is dropped from ``self.input`` before the
+    reducer can snapshot it — the rejoin-refused-by-epoch case."""
+    from coinstac_dinunet_tpu.nodes.remote import COINNRemote
+
+    roster = MembershipRoster(1, {"site_0": 1, "site_1": 1})
+    roster.retire("site_1")   # death recorded as a retire-for-rejoin
+    roster.admit("site_1")    # fresh incarnation at epoch 3
+    cache = {}
+    roster.save(cache)
+    remote = COINNRemote(
+        cache=cache,
+        input={
+            "site_0": {"roster_epoch": 3, "reduce": True},
+            # the dead incarnation's payload, delayed on the wire
+            "site_1": {"roster_epoch": 1, "reduce": True},
+        },
+        state={"baseDirectory": ".", "outputDirectory": ".",
+               "transferDirectory": ".", "cacheDirectory": "."},
+    )
+    remote._check_membership()
+    assert sorted(remote.input) == ["site_0"]
+    assert remote.out.get("admissions") is None
+
+
+# ----------------------------------------------------------------- reducer
+class _Cache(dict):
+    pass
+
+
+class _FakeTrainer:
+    def __init__(self, cache, inp):
+        self.cache = cache
+        self.input = inp
+        self.state = {}
+
+
+def _reducer(cache, sites):
+    from coinstac_dinunet_tpu.parallel.reducer import COINNReducer
+
+    inp = {s: {"grad_weight": 1.0} for s in sites}
+    return COINNReducer(trainer=_FakeTrainer(cache, inp))
+
+
+def test_capacity_weight_uniform_when_equal():
+    """Property: capacity weighting ON with EQUAL observed capacities is
+    bitwise the uniform weighting; unequal capacities tilt toward the
+    faster site; the knob is off by default."""
+    sites = ["site_0", "site_1", "site_2"]
+    base = np.asarray(_reducer(_Cache(), sites)._site_weights())
+
+    equal = _Cache({
+        Membership.CAPACITY_WEIGHT: True,
+        Membership.SITE_CAPACITY: {s: 123.4 for s in sites},
+    })
+    got = np.asarray(_reducer(equal, sites)._site_weights())
+    assert (got == base).all()
+
+    unequal = _Cache({
+        Membership.CAPACITY_WEIGHT: True,
+        Membership.SITE_CAPACITY: {"site_0": 30.0, "site_1": 10.0,
+                                   "site_2": 20.0},
+    })
+    got = np.asarray(_reducer(unequal, sites)._site_weights())
+    assert got[0] > got[2] > got[1]
+    np.testing.assert_allclose(got.mean(), 1.0, atol=1e-6)
+
+    # off by default: capacities recorded but the knob unset → uniform
+    off = _Cache({Membership.SITE_CAPACITY: {"site_0": 99.0}})
+    got = np.asarray(_reducer(off, sites)._site_weights())
+    assert (got == base).all()
+
+    # a site with no reading yet (fresh joiner) weighs neutrally
+    partial = _Cache({
+        Membership.CAPACITY_WEIGHT: True,
+        Membership.SITE_CAPACITY: {"site_0": 50.0, "site_1": 50.0},
+    })
+    got = np.asarray(_reducer(partial, sites)._site_weights())
+    np.testing.assert_allclose(got[2], 1.0, atol=1e-6)
+
+
+def test_epoch_renormalization_guards_the_denominator_floor():
+    """Once the roster has churned (epoch > 1) the composed weight vector
+    re-centers to mean 1: a shrunken, discount-weighted roster can no
+    longer fall under the ``max(sum(w), 1.0)`` floor in the compiled
+    means.  At epoch 1 the weights are untouched (fixed-roster runs stay
+    bit-identical)."""
+    sites = ["site_0", "site_1"]
+    # deep staleness discount drives both weights to 0.25 → sum 0.5 < 1
+    churned = _Cache({
+        Membership.ROSTER: {"epoch": 2, "members": {s: 1 for s in sites}},
+        "site_staleness": {s: 2 for s in sites},
+    })
+    w = np.asarray(_reducer(churned, sites)._site_weights())
+    np.testing.assert_allclose(w.sum(), 2.0, atol=1e-6)
+
+    fixed = _Cache({
+        Membership.ROSTER: {"epoch": 1, "members": {s: 1 for s in sites}},
+        "site_staleness": {s: 2 for s in sites},
+    })
+    w = np.asarray(_reducer(fixed, sites)._site_weights())
+    np.testing.assert_allclose(w.sum(), 0.5, atol=1e-6)
+
+
+# --------------------------------------------------------- vectorized plane
+pytestmark_vec = pytest.mark.slow
+
+
+def test_vector_engine_rejoin_reverses_dead_mask(tmp_path):
+    """PR-15 satellite regression: the vectorized engine's ``dead_sites``
+    was grow-only — a healed site stayed masked out of the reduce
+    forever.  A ``rejoin`` churn op re-admits it."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_trainer import XorDataset, XorTrainer
+
+    base = dict(
+        task_id="xor", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+        batch_size=8, epochs=2, validation_epochs=1, learning_rate=5e-2,
+        input_shape=(2,), seed=11, patience=50, site_quorum=2,
+    )
+    plan = {"faults": [
+        {"kind": "crash", "round": 2, "site": "site_1"},  # permanent
+        {"kind": "rejoin", "round": 4, "site": "site_1"},
+    ]}
+    eng = SiteVectorizedEngine(tmp_path, n_sites=4, trainer_cls=XorTrainer,
+                               dataset_cls=XorDataset, fault_plan=plan,
+                               **base)
+    assert eng.capacity == 4  # no joins in the plan → no spare slots
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(16):
+            with open(os.path.join(d, f"s{i}_{j}"), "w") as f:
+                f.write("x")
+    eng.run()
+    assert eng.success
+    assert eng.dead_sites == set()          # reversible, not grow-only
+    assert eng._member_ids() == eng.site_ids
+    assert eng._membership_counts["rejoin"] == 1
+    assert eng.roster_epoch == 2
+
+
+def test_vector_engine_leave_and_join_via_spare_slot(tmp_path):
+    """Vectorized churn rides the roster mask at the capacity high-water
+    mark: a leave masks the slot, a join activates a pre-allocated spare
+    — the stacked shape (and therefore the compiled step) never changes."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_trainer import XorDataset, XorTrainer
+
+    base = dict(
+        task_id="xor", data_dir="data", split_ratio=[0.7, 0.15, 0.15],
+        batch_size=8, epochs=2, validation_epochs=1, learning_rate=5e-2,
+        input_shape=(2,), seed=11, patience=50,
+    )
+    plan = {"faults": [
+        {"kind": "leave", "round": 2, "site": "site_1"},
+        {"kind": "join", "round": 3, "site": "site_4"},
+    ]}
+    eng = SiteVectorizedEngine(tmp_path, n_sites=4, trainer_cls=XorTrainer,
+                               dataset_cls=XorDataset, fault_plan=plan,
+                               **base)
+    assert eng.capacity == 5 and eng.spare_sites == {"site_4"}
+    assert not eng._site_loads("site_4")  # masked until admitted
+    for i, s in enumerate(eng.site_ids):
+        d = eng.site_data_dir(s)
+        for j in range(16):
+            with open(os.path.join(d, f"s{i}_{j}"), "w") as f:
+                f.write("x")
+    eng.run()
+    assert eng.success
+    assert eng.left_sites == {"site_1"} and eng.spare_sites == set()
+    assert sorted(eng._member_ids()) == [
+        "site_0", "site_2", "site_3", "site_4",
+    ]
+    assert eng._site_loads("site_4") and not eng._site_loads("site_1")
+    assert eng.roster_epoch == 3
+
+
+# ------------------------------------------------------------------ daemon
+def test_daemon_join_spawns_worker_and_leave_shuts_it_down(tmp_path):
+    """Elastic membership over the persistent-worker deployment: a mid-run
+    JOIN spawns a fresh warm worker for the joiner, a graceful LEAVE shuts
+    the leaver's worker down (an orderly shutdown, not a corpse for
+    ``close()``), and the run completes with zero deaths and zero worker
+    restarts for the churned sites."""
+    from coinstac_dinunet_tpu.federation.daemon import DaemonEngine
+
+    daemon_args = dict(
+        data_dir="data", split_ratio=[0.6, 0.2, 0.2], batch_size=4,
+        epochs=4, validation_epochs=2, learning_rate=5e-2, input_size=12,
+        hidden_sizes=[8], num_classes=2, seed=7, synthetic=True,
+        verbose=False, patience=50,
+    )
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    plan = {"faults": [
+        {"kind": "leave", "round": 3, "site": "site_2"},
+        {"kind": "join", "round": 5, "site": "site_3"},
+    ]}
+    eng = DaemonEngine(
+        tmp_path, n_sites=N_SITES,
+        local_script=os.path.join(EXAMPLE, "local.py"),
+        remote_script=os.path.join(EXAMPLE, "remote.py"),
+        first_input={"fsv_classification_args": {
+            **daemon_args, "persist_round_state": True,
+        }},
+        env=env, fault_plan=plan,
+    )
+    _fill(eng)
+    _provision_joiner(tmp_path, "site_3")
+    try:
+        eng.run(max_rounds=300)
+        assert eng.success
+        pids = eng.worker_pids()
+        assert "site_3" in pids          # spawned mid-run
+        assert "site_2" not in pids      # shut down at the leave
+        assert eng.dead_sites == set() and eng.site_failures == {}
+        assert eng.left_sites == {"site_2"}
+        roster = eng.remote_cache[Membership.ROSTER]
+        assert sorted(roster["members"]) == ["site_0", "site_1", "site_3"]
+        assert roster["left"] == ["site_2"]
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------------------------- tier-4
+def test_model_membership_actions_pass_clean_at_default_bound():
+    from coinstac_dinunet_tpu.analysis.model_check import (
+        FAULT_ALPHABET,
+        ModelConfig,
+        run_model_check,
+    )
+
+    for kind in ("join", "leave", "rejoin"):
+        assert kind in FAULT_ALPHABET
+    assert ModelConfig().elastic == (False, True)
+    assert ModelCheck.DEFAULT_ELASTIC
+    res = run_model_check(config=ModelConfig(
+        kinds=("join", "leave", "rejoin", "crash", "stale", "reappear"),
+    ))
+    assert res.findings == []
+
+
+@pytest.mark.parametrize("switch,rule,plan_kinds", [
+    ("_ROSTER_ACCEPTS_STALE_EPOCH", ModelCheck.ROSTER,
+     {"leave", "stale"}),
+    ("_QUORUM_AGAINST_INIT_ROSTER", ModelCheck.ROSTER, {"leave"}),
+    ("_JOIN_CONTRIBUTES_IN_ADMISSION_ROUND", ModelCheck.ADMISSION,
+     {"join"}),
+])
+def test_model_broken_roster_switches_fire_exactly_once(
+    monkeypatch, switch, rule, plan_kinds
+):
+    """Non-vacuity: each broken-roster semantics switch makes exactly one
+    invariant fire, with a replayable churn plan whose ops are valid
+    chaos fault kinds."""
+    from coinstac_dinunet_tpu.analysis import model_check as mc
+
+    monkeypatch.setattr(mc, switch, True)
+    res = mc.run_model_check()
+    assert [f.rule for f in res.findings] == [rule]
+    plan = res.plans[0]
+    assert {f["kind"] for f in plan["faults"]} == plan_kinds
+    assert plan["scenario"]["elastic"] is True
+    assert load_fault_plan({"faults": plan["faults"]})
+
+
+# --------------------------------------------------------------- live plane
+def _membership_records(quorum_need=2):
+    t = 100.0
+    recs = [
+        {"kind": "event", "name": "membership:join", "site": "site_3",
+         "cat": "membership", "epoch": 2, "members": 4,
+         "quorum_need": quorum_need, "t0": t, "round": 5},
+        {"kind": "event", "name": "membership:leave", "site": "site_1",
+         "cat": "membership", "epoch": 3, "members": 3,
+         "quorum_need": quorum_need, "t0": t + 1, "round": 6},
+    ]
+    return recs
+
+
+def test_live_roster_line_and_prometheus_exports():
+    st = LiveState()
+    st.ingest(_membership_records())
+    snap = st.snapshot(now=105.0)
+    roster = snap["roster"]
+    assert roster["epoch"] == 3 and roster["members"] == 3
+    assert roster["left"] == ["site_1"]
+    assert roster["joining"] == ["site_3"]
+    assert roster["changes"] == {"join": 1, "leave": 1}
+    assert roster["quorum_need"] == 2
+
+    board = render_board(snap)
+    assert "roster epoch 3" in board and "left: site_1" in board
+
+    prom = render_prometheus(snap)
+    assert "coinstac_dinunet_roster_size 3" in prom
+    assert ('coinstac_dinunet_membership_changes_total{kind="join"} 1'
+            in prom)
+    assert ('coinstac_dinunet_membership_changes_total{kind="leave"} 1'
+            in prom)
+
+    # the joining grace ends at the site's first own record
+    st.ingest([{"kind": "event", "name": Live.HEARTBEAT, "site": "site_3",
+                "t0": 106.0, "round": 7}])
+    assert st.snapshot(now=107.0)["roster"]["joining"] == []
+
+
+def test_quorum_erosion_verdict_fires_and_rearms():
+    st = LiveState(quorum_headroom=1)
+    st.ingest(_membership_records(quorum_need=3))
+    # 3 members, need 3 → headroom 0 < 1: one more leave fails the run
+    fired = st.check(now=102.0)
+    assert [v["verdict"] for v in fired] == [Live.VERDICT_QUORUM_EROSION]
+    assert "headroom 0" in fired[0]["evidence"]
+    # edge-triggered: no refire while armed
+    assert st.check(now=103.0) == []
+    # a join rebuilds the headroom → re-arms, then erodes again → refires
+    st.ingest([{"kind": "event", "name": "membership:rejoin",
+                "site": "site_1", "epoch": 4, "members": 4,
+                "quorum_need": 3, "t0": 104.0}])
+    assert st.check(now=104.5) == []
+    st.ingest([{"kind": "event", "name": "membership:leave",
+                "site": "site_1", "epoch": 5, "members": 3,
+                "quorum_need": 3, "t0": 105.0}])
+    fired = st.check(now=105.5)
+    assert [v["verdict"] for v in fired] == [Live.VERDICT_QUORUM_EROSION]

@@ -20,13 +20,17 @@ from test_trainer import XorDataset, XorTrainer
 # lockstep round stamp (broadcast every round, echoed by sites from round
 # 2 on — round 1's site input carries no stamp yet): the at-most-once
 # delivery witness the tier-4 model checker demanded (proto-model-
-# stale-contribution, docs/ANALYSIS.md "Tier 4").
+# stale-contribution, docs/ANALYSIS.md "Tier 4").  ``roster_epoch`` rides
+# alongside it from ISSUE 15 on (elastic membership): the aggregator's
+# roster version, broadcast every round and echoed back verbatim — the
+# refusal basis for payloads out of a previous incarnation.
 GOLDEN_SITE_ROUND1 = {"data_size", "mode", "phase", "shared_args"}
-GOLDEN_REMOTE_ROUND1 = {"global_modes", "global_runs", "phase", "wire_round"}
+GOLDEN_REMOTE_ROUND1 = {"global_modes", "global_runs", "phase", "wire_round",
+                        "roster_epoch"}
 GOLDEN_SITE_TRAIN = {"grad_weight", "grads_file", "mode", "phase", "reduce",
-                     "wire_round"}
+                     "wire_round", "roster_epoch"}
 GOLDEN_REMOTE_TRAIN = {"avg_grads_file", "global_modes", "phase", "update",
-                       "wire_round"}
+                       "wire_round", "roster_epoch"}
 
 
 def _engine(tmp_path, n_sites=2, per_site=16, **args):
