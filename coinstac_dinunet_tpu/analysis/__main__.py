@@ -110,6 +110,31 @@ def build_parser():
                    help="post-warmup rounds the interleaving explorer "
                         "enumerates completion schedules over (default: "
                         "Concurrency.DEFAULT_ROUNDS)")
+    p.add_argument("--wire", action="store_true",
+                   help="also run the tier-6 wire-contract auditor: lift "
+                        "every boundary-crossing artifact (handshake keys, "
+                        "tensor dumps, daemon frames, cache deltas) into a "
+                        "typed wire-schema IR and check the wire-orphan/"
+                        "wire-unversioned/wire-dense rules plus wire-lock "
+                        "drift against wire_schema.lock.json (pure stdlib "
+                        "ast, no JAX; see docs/ANALYSIS.md 'Tier 6')")
+    p.add_argument("--write-lock", action="store_true",
+                   help="rewrite the wire-schema lockfile from the current "
+                        "extraction (and regenerate the docs/FEDERATION.md "
+                        "wire-contract table between its markers) instead "
+                        "of reporting wire-lock drift")
+    p.add_argument("--wire-lock", default=None, metavar="FILE",
+                   help="wire-schema lockfile path (default: "
+                        "./wire_schema.lock.json)")
+    p.add_argument("--wire-ledger", default=None, metavar="FILE",
+                   help="also write the static byte-cost ledger JSON "
+                        "(params x dtype x per-round multiplicity per "
+                        "tensor path; the CI lint job uploads it)")
+    p.add_argument("--reconcile", default=None, metavar="DIR",
+                   help="compare the static byte ledger against the "
+                        "telemetry wire records under DIR (recursive "
+                        "telemetry.*.jsonl scan); unaccounted observed "
+                        "bytes report as wire-unmodeled")
     return p
 
 
@@ -120,6 +145,11 @@ TIER_PREFIXES = {
     "tier3": ("tier3-", "perf-", "proto-flow-", "proto-cache-"),
     "model": ("proto-model-",),
     "tier5": ("conc-", "proto-conc-"),
+    # tier-6 is tracked by its EXACT rule ids, never the bare "wire-"
+    # prefix: the default-tier rule wire-atomic-commit shares the spelling
+    # and its baselined entries must not ride a tier-6 carry-over
+    "wire": ("wire-orphan", "wire-unversioned", "wire-dense", "wire-lock",
+             "wire-unmodeled", "wire-config"),
 }
 
 
@@ -141,13 +171,22 @@ def main(argv=None):
             for r in rules
         ]
     if args.list_rules:
+        # every opt-in tier's rule families are enumerated here from their
+        # id lists (no tier flag, no JAX import needed), each annotated
+        # with the owning tier — a rule must never be invisible just
+        # because the flag that RUNS it wasn't passed
         for r in sorted(rules, key=lambda r: r.id):
             print(f"{r.id}: {r.doc}")
         from .concurrency import TIER5_STATIC_RULE_IDS
         from .dataflow import TIER3_RULE_IDS
+        from .deepcheck import DEEP_RULE_IDS
         from .model_check import MODEL_RULE_IDS
         from .schedule_explorer import EXPLORER_RULE_IDS
+        from .wire_schema import WIRE_RULE_IDS
 
+        for rid in DEEP_RULE_IDS:
+            print(f"{rid}: (tier-2 deep checker, --deep; "
+                  "see docs/ANALYSIS.md)")
         for rid in TIER3_RULE_IDS:
             print(f"{rid}: (tier-3, --tier3; see docs/ANALYSIS.md)")
         for rid in MODEL_RULE_IDS:
@@ -158,6 +197,9 @@ def main(argv=None):
                   "see docs/ANALYSIS.md)")
         for rid in EXPLORER_RULE_IDS:
             print(f"{rid}: (tier-5 interleaving explorer, --tier5; "
+                  "see docs/ANALYSIS.md)")
+        for rid in WIRE_RULE_IDS:
+            print(f"{rid}: (tier-6 wire auditor, --wire; "
                   "see docs/ANALYSIS.md)")
         return 0
     if args.list_deep:
@@ -236,19 +278,26 @@ def main(argv=None):
               "needs at least 1 post-warmup round (0/negative bounds "
               "make every round-loop invariant vacuous)", file=sys.stderr)
         return 2
+    if not args.wire and (args.write_lock or args.wire_lock is not None
+                          or args.wire_ledger is not None
+                          or args.reconcile is not None):
+        print("--write-lock/--wire-lock/--wire-ledger/--reconcile require "
+              "--wire", file=sys.stderr)
+        return 2
     rule_ids = args.rules.split(",") if args.rules else None
     if rule_ids:
         from .concurrency import TIER5_STATIC_RULE_IDS
         from .dataflow import TIER3_RULE_IDS
         from .model_check import MODEL_RULE_IDS
         from .schedule_explorer import EXPLORER_RULE_IDS
+        from .wire_schema import WIRE_RULE_IDS
 
         tier5_ids = set(TIER5_STATIC_RULE_IDS) | set(EXPLORER_RULE_IDS)
-        # tier-3/4/5 ids are selectable too (their findings are filtered
+        # tier-3/4/5/6 ids are selectable too (their findings are filtered
         # after the tier runs below)
         known = {r.id for r in rules} | set(TIER3_RULE_IDS) | set(
             MODEL_RULE_IDS
-        ) | tier5_ids
+        ) | tier5_ids | set(WIRE_RULE_IDS)
         unknown = sorted(set(rule_ids) - known)
         if unknown:
             print(f"unknown rule id(s): {', '.join(unknown)} "
@@ -270,6 +319,11 @@ def main(argv=None):
         if tier5_selected and not args.tier5:
             print(f"--rules {','.join(tier5_selected)} requires --tier5 "
                   "(tier-5 rules only run under --tier5)", file=sys.stderr)
+            return 2
+        wire_selected = sorted(set(rule_ids) & set(WIRE_RULE_IDS))
+        if wire_selected and not args.wire:
+            print(f"--rules {','.join(wire_selected)} requires --wire "
+                  "(tier-6 rules only run under --wire)", file=sys.stderr)
             return 2
     if args.write_baseline and rule_ids:
         print("--write-baseline with --rules would drop every other rule's "
@@ -406,7 +460,36 @@ def main(argv=None):
             keep = wanted5 | {Concurrency.CONFIG}
             tier5_findings = [f for f in tier5_findings if f.rule in keep]
         findings = findings + tier5_findings
-    if args.deep or args.tier3 or args.model or args.tier5:
+    if args.wire:
+        # tier-6: the wire-contract auditor (pure stdlib ast, no JAX)
+        from ..config.keys import WireContract
+        from .wire_schema import DEFAULT_LOCK, run_wire
+
+        wire_findings, wire_schema = run_wire(
+            paths=args.paths,
+            lock_path=args.wire_lock or DEFAULT_LOCK,
+            write_lock_file=args.write_lock,
+            reconcile_dir=args.reconcile,
+            ledger_path=args.wire_ledger,
+        )
+        if wire_schema is None and not wire_findings:
+            # partial scan (single-file lint): a one-sided lift would
+            # flood every key of the missing side as an orphan — skip,
+            # exactly like the default tier's protocol-conformance rule
+            print("dinulint --wire: skipped — the scanned paths do not "
+                  "cover the full wire boundary file set",
+                  file=sys.stderr)
+        wanted_wire = set(rule_ids) if rule_ids else None
+        if wanted_wire is not None:
+            # the tier's own error channel must survive any filter
+            keep = wanted_wire | {WireContract.CONFIG}
+            wire_findings = [f for f in wire_findings if f.rule in keep]
+        findings = findings + wire_findings
+        if args.write_lock and wire_schema is not None:
+            print(f"wrote wire-schema lockfile to "
+                  f"{args.wire_lock or DEFAULT_LOCK} "
+                  f"({len(wire_schema.entries)} entries)")
+    if args.deep or args.tier3 or args.model or args.tier5 or args.wire:
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     baseline_path = args.baseline
@@ -417,7 +500,8 @@ def main(argv=None):
         out = baseline_path or DEFAULT_BASELINE
         broken = [f.rule for f in findings
                   if f.rule in ("deep-config", "tier3-config",
-                                "proto-model-config", "proto-conc-config")]
+                                "proto-model-config", "proto-conc-config",
+                                "wire-config")]
         if broken:
             # an opt-in tier never actually ran (platform misconfig,
             # explorer failure, or a truncated bound) — writing now would
@@ -433,7 +517,8 @@ def main(argv=None):
         missing = [t for t, ran in (("deep", args.deep),
                                     ("tier3", args.tier3),
                                     ("model", args.model),
-                                    ("tier5", args.tier5)) if not ran]
+                                    ("tier5", args.tier5),
+                                    ("wire", args.wire)) if not ran]
         if missing and os.path.exists(out):
             # a tier that didn't run contributes nothing to this refresh —
             # carry its accepted entries over instead of silently dropping

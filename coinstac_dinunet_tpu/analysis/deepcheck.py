@@ -62,6 +62,12 @@ class EntryPoint:
     arg_names: tuple = ()
 
 
+#: every rule id this tier can emit (``--list-rules`` enumerates opt-in
+#: tiers from these lists without importing JAX)
+DEEP_RULE_IDS = (
+    "deep-config", "deep-entry-build", "deep-eval-shape", "deep-recompile",
+)
+
 DEEP_REGISTRY = {}
 
 

@@ -818,6 +818,51 @@ class Concurrency:
     CONFIG = "proto-conc-config"
 
 
+class WireContract:
+    """Tier-6 wire-contract auditor (``dinulint --wire``,
+    :mod:`coinstac_dinunet_tpu.analysis.wire_schema`).
+
+    Plain constants, mirroring :class:`ModelCheck`/:class:`Concurrency`:
+    the rule vocabulary checked over the typed wire-schema IR lifted from
+    every boundary-crossing artifact (output-dict JSON keys, COINNTW2
+    tensor payloads, daemon frame fields and dirty-key deltas, reducer
+    fan-in views).  All static rules are pure ``ast`` — no JAX import.
+
+    - ``ORPHAN`` — a wire key consumed on one side with no producer on
+      the other (or produced and never consumed): silent schema drift.
+    - ``UNVERSIONED`` — a payload path whose producing phase block does
+      not echo the ``wire_round``/``roster_epoch`` versioning stamps the
+      staleness window and roster machinery refuse deliveries by.
+    - ``DENSE`` — a full-tensor wire path where a registered codec
+      (``parallel/powersgd.py``, ``parallel/rankdad.py``,
+      ``ops/quantize.py``) could apply; each finding carries the static
+      byte-cost model (params × dtype width × per-round multiplicity).
+    - ``LOCK`` — the extracted schema drifted from the checked-in
+      ``wire_schema.lock.json`` (same ratchet contract as
+      ``dinulint_baseline.json``: contract changes must be explicit in
+      the diff — regenerate via ``dinulint --wire --write-lock``).
+    - ``UNMODELED`` — runtime-only (``--reconcile <telemetry dir>``):
+      observed ``wire`` telemetry bytes that no schema entry accounts
+      for, bucketed by the records' ``payload_kind`` field.
+    - ``CONFIG`` — the tier's own error channel (the auditor could not
+      run); survives ``--rules`` filtering like ``proto-model-config``.
+
+    NOTE: the default-tier rule ``wire-atomic-commit`` predates this
+    tier and shares the ``wire-`` spelling; tier ownership is therefore
+    tracked by these EXACT ids, never by the bare ``wire-`` prefix.
+    """
+
+    ORPHAN = "wire-orphan"
+    UNVERSIONED = "wire-unversioned"
+    DENSE = "wire-dense"
+    LOCK = "wire-lock"
+    UNMODELED = "wire-unmodeled"
+    CONFIG = "wire-config"
+
+    #: checked-in lockfile name (repo root, next to dinulint_baseline.json)
+    LOCKFILE = "wire_schema.lock.json"
+
+
 class AggEngine(_StrEnum):
     """Built-in gradient-aggregation engines (≙ AGG_Engine dSGD/powerSGD/rankDAD)."""
     DSGD = "dSGD"

@@ -279,6 +279,11 @@ def worker_main(argv=None):
             write_frame(out, {"ok": False, "pid": os.getpid(),
                               "error": f"unknown op {msg.get('op')!r}"})
             continue
+        # the request's round stamp, echoed verbatim on every response
+        # (success or node error) so the engine can refuse a frame that
+        # answers a different round than the one it just asked — the
+        # frame-lane twin of the wire_round echo in the node handshake
+        rnd = msg.get("round")
         payload = dict(msg.get("payload") or {})
         # engine-authored cache writes (elastic-membership admission
         # requests, ISSUE 15) ride as an explicit patch: a warm worker
@@ -297,6 +302,7 @@ def worker_main(argv=None):
             live_cache = payload["cache"]
             resp = {
                 "ok": True, "pid": os.getpid(), "warm": warm,
+                "round": rnd,
                 "result": utils.clean_recursive(result),
             }
             clean = resp["result"]
@@ -326,7 +332,7 @@ def worker_main(argv=None):
             # is the clean-slate path
             live_cache = payload["cache"]
             write_frame(out, {
-                "ok": False, "pid": os.getpid(),
+                "ok": False, "pid": os.getpid(), "round": rnd,
                 "error": f"{type(exc).__name__}: {exc}"[:500],
                 "traceback": traceback.format_exc()[-4000:],
             })
@@ -622,6 +628,20 @@ class DaemonEngine(SubprocessEngine):
                     {"op": "invoke", "round": rnd, "payload": req},
                     timeout=self.timeout,
                 )
+                echoed = res.get("round")
+                if echoed is not None and echoed != rnd:
+                    # a response answering some OTHER round: the frame
+                    # lane is desynced (leftover/redelivered frame) —
+                    # kill for a clean restart instead of handing the
+                    # round a stale result.  None is tolerated as the
+                    # handshake-level opt-out for out-of-tree workers
+                    # that don't echo (the same latitude as ``delta``).
+                    worker.kill()
+                    raise WorkerCrashed(
+                        f"worker {target} (pid {worker.pid}) answered "
+                        f"round {echoed!r} to a round {rnd!r} request — "
+                        "frame-lane desync"
+                    )
                 return res, worker
             except WorkerTimeout as exc:
                 # same typed attribution as the fresh-process engine's
@@ -683,6 +703,12 @@ class DaemonEngine(SubprocessEngine):
             "daemon:frame", cat="daemon", target=target, site=target,
             tx_bytes=worker.last_tx, rx_bytes=worker.last_rx,
             delta=delta is not None,
+            # satellite telemetry for dinulint --wire --reconcile: which
+            # schema lane these frame bytes rode, the worker's own warmth
+            # report, and the round the response answered
+            payload_kind=("delta" if delta is not None else "json"),
+            warm=bool(res.get("warm")),
+            round=res.get("round"),
         )
         return result
 

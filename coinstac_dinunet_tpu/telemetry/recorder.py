@@ -40,7 +40,8 @@ Record schema (one JSON object per line; absent context fields are omitted)::
      "site": attributed-site-id?, ...context}
     {"v": 1, "kind": "wire",    "op": "save"|"load", "file": basename,
      "bytes": payload-bytes, "arrays": k, "codec": ..., "raw_bytes": n,
-     "ratio": raw/payload, "dur": secs, ...context}
+     "ratio": raw/payload, "payload_kind": "json"|"tensor"|"delta",
+     "dur": secs, ...context}
     {"v": 1, "kind": "counter", "name": ..., "n": total, "t0": flush-time,
      ...context}
 
@@ -113,7 +114,7 @@ class _NullRecorder:
         pass
 
     def wire(self, op, path, nbytes=0, arrays=0, codec=None, raw_bytes=None,
-             dur=0.0):
+             dur=0.0, payload_kind=None):
         pass
 
     def count(self, name, n=1):
@@ -344,14 +345,18 @@ class Recorder:
         self._append(rec)
 
     def wire(self, op, path, nbytes=0, arrays=0, codec=None, raw_bytes=None,
-             dur=0.0):
+             dur=0.0, payload_kind=None):
         """One wire-payload transfer: ``op`` is ``save`` (outbound) or
         ``load`` (inbound), ``nbytes`` the on-disk payload size,
         ``raw_bytes`` the uncompressed array bytes (compression ratio =
-        raw/payload)."""
+        raw/payload), ``payload_kind`` the wire-schema lane the bytes rode
+        (``json``/``tensor``/``delta`` — how ``dinulint --wire
+        --reconcile`` buckets observed bytes per schema entry)."""
         rec = {"v": SCHEMA_VERSION, "kind": "wire", "op": op,
                "file": os.path.basename(str(path)), "t0": time.time(),
                "dur": float(dur), "bytes": int(nbytes), "arrays": int(arrays)}
+        if payload_kind:
+            rec["payload_kind"] = str(payload_kind)
         if codec:
             rec["codec"] = str(codec)
         if raw_bytes is not None:

@@ -265,7 +265,7 @@ def load_arrays(path, retry=None, mmap=False):
         rec.wire(
             "load", path, nbytes=len(payload), arrays=len(out),
             raw_bytes=sum(int(a.nbytes) for a in out),
-            dur=time.perf_counter() - t0,
+            dur=time.perf_counter() - t0, payload_kind="tensor",
         )
     return out
 
@@ -367,6 +367,7 @@ def load_arrays_many(paths, retry=None, mmap=False):
             rec.wire(
                 "load", p, nbytes=len(payload), arrays=len(arrays),
                 raw_bytes=sum(int(a.nbytes) for a in arrays),
+                payload_kind="tensor",
             )
     if rec.enabled:
         rec.event(
@@ -419,7 +420,7 @@ def save_wire(path, arrays, salt="", cache=None, precision_bits=None):
                     "save", path, nbytes=nbytes, arrays=len(host),
                     codec=codec,
                     raw_bytes=sum(int(a.nbytes) for a in host),
-                    dur=time.perf_counter() - t0,
+                    dur=time.perf_counter() - t0, payload_kind="tensor",
                 )
 
         _transport.async_committer().submit(_commit)
@@ -431,7 +432,7 @@ def save_wire(path, arrays, salt="", cache=None, precision_bits=None):
             "save", path, nbytes=nbytes, arrays=len(arr_list), codec=codec,
             # .nbytes exists on numpy AND jax arrays without a host copy
             raw_bytes=sum(int(getattr(a, "nbytes", 0)) for a in arr_list),
-            dur=time.perf_counter() - t0,
+            dur=time.perf_counter() - t0, payload_kind="tensor",
         )
 
 

@@ -58,6 +58,22 @@ if [ "${DINULINT_MODEL:-}" = "1" ]; then
         extra+=(--model-plans "$DINULINT_MODEL_PLANS")
     fi
 fi
+if [ "${DINULINT_WIRE:-}" = "1" ]; then
+    # tier-6 wire-contract auditor: lift the wire schema (pure AST, no
+    # JAX) and ratchet it against the checked-in wire_schema.lock.json —
+    # drift fails the run as wire-lock (docs/ANALYSIS.md "Tier 6").
+    # DINULINT_WIRE_LEDGER names the byte-cost ledger JSON (the CI lint
+    # job uploads it with the lockfile in the lint-findings artifact);
+    # DINULINT_WIRE_RECONCILE names a telemetry workdir to reconcile the
+    # static ledger against real `wire` counter records.
+    extra+=(--wire)
+    if [ -n "${DINULINT_WIRE_LEDGER:-}" ]; then
+        extra+=(--wire-ledger "$DINULINT_WIRE_LEDGER")
+    fi
+    if [ -n "${DINULINT_WIRE_RECONCILE:-}" ]; then
+        extra+=(--reconcile "$DINULINT_WIRE_RECONCILE")
+    fi
+fi
 if [ "${DINULINT_TIER5:-}" = "1" ]; then
     # tier-5 concurrency auditor: static conc-* lock-discipline rules
     # (pure AST) + the proto-conc-* deterministic interleaving explorer

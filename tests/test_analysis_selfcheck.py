@@ -83,9 +83,17 @@ def test_protocol_rule_reports_zero_unmatched_wire_keys():
 MAX_BASELINE_FINDINGS = 0
 
 REFRESH_CMD = (
-    "dinulint coinstac_dinunet_tpu --tier3 --deep --model --tier5 "
+    "dinulint coinstac_dinunet_tpu --tier3 --deep --model --tier5 --wire "
     "--write-baseline --baseline dinulint_baseline.json"
 )
+
+
+def _wire_rule_ids():
+    # tier 6 matches by EXACT id: the default-tier wire-atomic-commit
+    # shares the `wire-` spelling and belongs to the static branch above
+    from coinstac_dinunet_tpu.analysis.wire_schema import WIRE_RULE_IDS
+
+    return set(WIRE_RULE_IDS)
 
 
 def _baseline_entries():
@@ -148,6 +156,13 @@ def test_baseline_ratchet_has_no_stale_suppressions():
 
         findings += run_tier5_static([PACKAGE])
         findings += run_schedule_explorer().findings
+    if any(e["rule"] in _wire_rule_ids() for e in entries):
+        from coinstac_dinunet_tpu.analysis.wire_schema import run_wire
+
+        findings += run_wire(
+            paths=[PACKAGE],
+            lock_path=os.path.join(REPO, "wire_schema.lock.json"),
+        )[0]
     if any(e["rule"].startswith("proto-model-") for e in entries):
         from coinstac_dinunet_tpu.analysis.model_check import run_model_check
 

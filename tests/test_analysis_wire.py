@@ -1,0 +1,525 @@
+"""dinulint tier-6: the wire-contract auditor (ISSUE 16 acceptance).
+
+Three layers, mirroring the tier-4/5 test shape:
+
+- **IR + rule units** — broken-fixture modules (an orphan consumer, an
+  unversioned dump path, a dense raw-tensor write beside a registered
+  codec, a stale lockfile) each make exactly their ``wire-*`` rule fire;
+  the clean counterparts and the real repo produce none.
+- **the ratchet** — lockfile round-trip on the real package (extract →
+  write → re-extract → zero drift), the checked-in
+  ``wire_schema.lock.json`` matches the tree, and the ISSUE-16 mutation
+  acceptance: deleting a producer key from ``nodes/remote.py`` or
+  dropping the ``roster_epoch`` echo from ``nodes/local.py`` fails with
+  the matching ``wire-orphan``/``wire-unversioned``/``wire-lock``.
+- **CLI composition** — ``--wire`` composes with the baseline and
+  ``--rules`` (``wire-config`` survives any filter, exactly like
+  ``proto-model-config``), the tier's knobs require the flag,
+  ``--list-rules`` enumerates every opt-in tier's rules, and a
+  ``--write-baseline`` refresh without ``--wire`` carries tier-6 entries
+  over by EXACT id (never dragging the default-tier
+  ``wire-atomic-commit`` along on the shared prefix).
+"""
+import json
+import os
+import textwrap
+
+from coinstac_dinunet_tpu.analysis import wire_schema as ws
+from coinstac_dinunet_tpu.analysis.__main__ import TIER_PREFIXES, main
+from coinstac_dinunet_tpu.config.keys import WireContract
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "coinstac_dinunet_tpu")
+BASELINE = os.path.join(REPO, "dinulint_baseline.json")
+LOCK = os.path.join(REPO, "wire_schema.lock.json")
+
+
+def _package_sources():
+    """{suffix: source} of the real boundary files (mutation base)."""
+    out = {}
+    for suffix, path in ws._find_package_files([PKG]).items():
+        with open(path, "r", encoding="utf-8") as f:
+            out[suffix] = f.read()
+    return out
+
+
+def _schema(files):
+    return ws.extract_schema(files={k: textwrap.dedent(v)
+                                    for k, v in files.items()})
+
+
+# --------------------------------------------------------------- IR extraction
+def test_real_package_lifts_the_full_contract():
+    schema = ws.extract_schema(paths=[PKG])
+    assert schema is not None
+    by_ident = {e.ident(): e for e in schema.entries}
+    # the handshake lanes carry the tensor keys with their codecs + files
+    grads = by_ident[("site->agg", "grads_file")]
+    assert (grads.payload, grads.codec, grads.file) == (
+        "tensor", "int8", "grads.npy")
+    psgd = by_ident[("site->agg", "powerSGD_P_file")]
+    assert (psgd.payload, psgd.codec) == ("tensor", "powerSGD")
+    dad = by_ident[("agg->site", "dad_data_file")]
+    assert (dad.payload, dad.codec) == ("tensor", "rankDAD")
+    # version stamps echo on both handshake lanes and both frame lanes
+    for direction in ("site->agg", "agg->site"):
+        assert by_ident[(direction, "wire_round")].versioned
+        assert by_ident[(direction, "roster_epoch")].versioned
+    assert by_ident[("engine->worker", "round")].versioned
+    assert by_ident[("worker->engine", "round")].versioned
+    # the daemon delta lanes are typed as deltas
+    assert by_ident[("engine->worker", "cache_patch")].payload == "delta"
+    assert by_ident[("worker->engine", "cache_delta")].payload == "delta"
+    assert by_ident[("worker->engine", "set")].payload == "delta"
+
+
+def test_real_package_has_no_wire_findings():
+    """The fixed tree is clean: no orphans, no unversioned lanes, no dense
+    paths (every tensor write rides the codec-capable save_wire choke
+    point through the atomic transport)."""
+    schema = ws.extract_schema(paths=[PKG])
+    assert ws.orphan_findings(schema) == []
+    assert ws.unversioned_findings(schema) == []
+    assert ws.dense_findings(schema) == []
+
+
+def test_partial_scan_skips_instead_of_orphan_flooding(tmp_path):
+    """A single-file lint must not lift one side of the handshake and
+    report every key of the missing side as an orphan — the protocol-
+    conformance partial-scan contract."""
+    one = tmp_path / "local.py"
+    one.write_text("x = 1\n")
+    assert ws.extract_schema(paths=[str(one)]) is None
+    findings, schema = ws.run_wire(paths=[str(one)])
+    assert (findings, schema) == ([], None)
+
+
+# -------------------------------------------------------------- rule fixtures
+_KEYS_FIXTURE = """
+import enum
+
+class LocalWire(enum.Enum):
+    GRADS_FILE = "grads_file"
+    ROUND = "wire_round"
+    ROSTER_EPOCH = "roster_epoch"
+
+class RemoteWire(enum.Enum):
+    AVG_GRADS_FILE = "avg_grads_file"
+    UPDATE = "update"
+    ROUND = "wire_round"
+    ROSTER_EPOCH = "roster_epoch"
+
+ENGINE_PROVIDED_KEYS = ()
+"""
+
+_LOCAL_OK = """
+from coinstac_dinunet_tpu.config.keys import LocalWire, RemoteWire
+
+class COINNLocal:
+    def compute(self):
+        avg = self.input.get(RemoteWire.AVG_GRADS_FILE.value)
+        update = self.input.get(RemoteWire.UPDATE.value)
+        self.out[LocalWire.GRADS_FILE.value] = "grads.npy"
+        self.out[LocalWire.ROUND.value] = self.input[RemoteWire.ROUND.value]
+        self.out[LocalWire.ROSTER_EPOCH.value] = self.input[
+            RemoteWire.ROSTER_EPOCH.value
+        ]
+"""
+
+_REMOTE_OK = """
+from coinstac_dinunet_tpu.config.keys import LocalWire, RemoteWire
+
+class COINNRemote:
+    def compute(self):
+        for site_vars in self.input.values():
+            grads = site_vars.get(LocalWire.GRADS_FILE.value)
+            echo = site_vars.get(LocalWire.ROUND.value)
+            epoch = site_vars.get(LocalWire.ROSTER_EPOCH.value)
+        self.out[RemoteWire.AVG_GRADS_FILE.value] = "avg_grads.npy"
+        self.out[RemoteWire.UPDATE.value] = True
+        self.out[RemoteWire.ROUND.value] = 1
+        self.out[RemoteWire.ROSTER_EPOCH.value] = 0
+"""
+
+
+def _rules_fired(files, **kw):
+    schema = ws.extract_schema(
+        files={k: textwrap.dedent(v) for k, v in files.items()},
+        keys_source=textwrap.dedent(_KEYS_FIXTURE), **kw)
+    return (schema,
+            ws.orphan_findings(schema)
+            + ws.unversioned_findings(schema)
+            + ws.dense_findings(schema))
+
+
+def test_clean_fixture_pair_has_no_findings():
+    schema, found = _rules_fired({"nodes/local.py": _LOCAL_OK,
+                                  "nodes/remote.py": _REMOTE_OK})
+    assert found == []
+    # update is json, the *_FILE keys are tensors
+    kinds = {e.key: e.payload for e in schema.entries}
+    assert kinds["update"] == "json"
+    assert kinds["grads_file"] == "tensor"
+
+
+def test_orphan_consumer_fires():
+    """The aggregator reads a key no site ever produces → wire-orphan."""
+    local = _LOCAL_OK.replace(
+        'self.out[LocalWire.GRADS_FILE.value] = "grads.npy"', "pass")
+    _, found = _rules_fired({"nodes/local.py": local,
+                             "nodes/remote.py": _REMOTE_OK})
+    orphans = [f for f in found if f.rule == WireContract.ORPHAN]
+    assert len(orphans) == 1
+    assert "'grads_file'" in orphans[0].message
+    assert "no producer" in orphans[0].message
+
+
+def test_orphan_dead_producer_fires():
+    """A key shipped that the peer never reads → wire-orphan (dead wire
+    traffic)."""
+    remote = _REMOTE_OK.replace(
+        "grads = site_vars.get(LocalWire.GRADS_FILE.value)", "pass")
+    _, found = _rules_fired({"nodes/local.py": _LOCAL_OK,
+                             "nodes/remote.py": remote})
+    orphans = [f for f in found if f.rule == WireContract.ORPHAN]
+    assert len(orphans) == 1
+    assert "never consumed" in orphans[0].message
+
+
+def test_unversioned_module_fires_per_missing_stamp():
+    """A boundary module shipping payloads without the wire_round /
+    roster_epoch echoes → one wire-unversioned per missing stamp."""
+    local = _LOCAL_OK.replace(
+        "self.out[LocalWire.ROUND.value] = "
+        "self.input[RemoteWire.ROUND.value]", "pass")
+    schema, found = _rules_fired({"nodes/local.py": local,
+                                  "nodes/remote.py": _REMOTE_OK})
+    unv = [f for f in found if f.rule == WireContract.UNVERSIONED]
+    assert len(unv) == 1
+    assert "'wire_round'" in unv[0].message
+    assert unv[0].path.endswith("nodes/local.py")
+    # the lane's entries record the broken versioning for the lockfile
+    grads = schema.entry("site->agg", "grads_file")
+    assert grads.versioned is False
+
+
+_DAEMON_FIXTURE = """
+def worker_main():
+    while True:
+        msg = read_frame(stdin)
+        op = msg.get("op")
+        payload = msg.get("payload")
+        write_frame(out, {"ok": True, "pid": 1, "result": payload})
+
+class DaemonEngine:
+    def _invoke(self):
+        res = self.worker.request({"op": "invoke", "round": 3,
+                                   "payload": {}}, timeout=5)
+        if not res.get("ok"):
+            raise RuntimeError(res.get("error"))
+        return res["result"]
+"""
+
+
+def test_daemon_unechoed_round_fires_unversioned_and_orphan():
+    """The pre-ISSUE-16 daemon shape: requests stamped with a round the
+    worker never reads, responses carrying no echo — the exact in-tree
+    findings this PR fixed."""
+    _, found = _rules_fired({"federation/daemon.py": _DAEMON_FIXTURE})
+    orphans = [f for f in found if f.rule == WireContract.ORPHAN]
+    unv = [f for f in found if f.rule == WireContract.UNVERSIONED]
+    assert any("'round'" in f.message for f in orphans)
+    assert len(unv) == 1 and "worker->engine" in unv[0].message
+
+
+def test_dense_raw_tensor_write_fires_with_byte_model():
+    """A full-tensor .npy dump into the transfer directory outside the
+    codec-capable choke point → wire-dense carrying the static byte-cost
+    model."""
+    learner = """
+    import os
+    import numpy as np
+
+    def ship(grads):
+        p = os.path.join("transferDirectory", "grads.npy")
+        np.save(p, grads)
+    """
+    _, found = _rules_fired({"nodes/local.py": _LOCAL_OK,
+                             "nodes/remote.py": _REMOTE_OK,
+                             "parallel/learner.py": learner})
+    dense = [f for f in found if f.rule == WireContract.DENSE]
+    assert len(dense) == 1
+    assert "np.save" in dense[0].message
+    assert "params * 4 B * n_sites / round" in dense[0].message
+    assert "powerSGD" in dense[0].message and "rankDAD" in dense[0].message
+
+
+def test_dense_chokepoint_without_codec_hook_fires_per_tensor_entry():
+    """A save_wire stripped of the config.wire_codec hook turns every
+    codec-capable tensor entry dense."""
+    bare = """
+    def save_wire(path, arr_list, precision_bits=32):
+        return save_arrays(path, arr_list)
+    """
+    _, found = _rules_fired({"nodes/local.py": _LOCAL_OK,
+                             "nodes/remote.py": _REMOTE_OK,
+                             "utils/tensorutils.py": bare})
+    dense = {f.message.split("'")[1] for f in found
+             if f.rule == WireContract.DENSE}
+    assert "grads_file" in dense and "avg_grads_file" in dense
+
+
+def test_transport_module_is_the_sanctioned_writer():
+    """resilience/transport.py IS the commit path — its own writes never
+    count as dense."""
+    transport = """
+    def commit_bytes(path, blob):
+        with open(path + ".tmp", "wb") as f:
+            f.write(blob)
+    """
+    _, found = _rules_fired({"nodes/local.py": _LOCAL_OK,
+                             "nodes/remote.py": _REMOTE_OK,
+                             "resilience/transport.py": transport})
+    assert [f for f in found if f.rule == WireContract.DENSE] == []
+
+
+# ------------------------------------------------------------------ the ratchet
+def test_lockfile_round_trip_zero_drift(tmp_path):
+    """extract → write → re-extract → zero drift, on the real package."""
+    schema = ws.extract_schema(paths=[PKG])
+    lock_path = str(tmp_path / "lock.json")
+    ws.write_lock(lock_path, schema)
+    again = ws.extract_schema(paths=[PKG])
+    assert ws.lock_findings(again, ws.load_lock(lock_path), lock_path) == []
+
+
+def test_checked_in_lockfile_matches_the_tree():
+    """The repo's wire_schema.lock.json is current — CI's wire-lock gate."""
+    schema = ws.extract_schema(paths=[PKG])
+    assert ws.lock_findings(schema, ws.load_lock(LOCK), LOCK) == []
+
+
+def test_stale_lockfile_reports_added_removed_and_drifted(tmp_path):
+    schema = ws.extract_schema(paths=[PKG])
+    lock_path = str(tmp_path / "lock.json")
+    data = ws.write_lock(lock_path, schema)
+    entries = data["entries"]
+    removed = entries.pop()  # tree has it, lock doesn't → "added" drift
+    flipped = entries[0]
+    flipped["versioned"] = not flipped["versioned"]  # field drift
+    entries.append({"key": "ghost_key", "direction": "site->agg",
+                    "producer": "site", "consumer": "agg",
+                    "payload": "json", "versioned": True, "codec": None,
+                    "file": None, "source": "handshake"})
+    found = ws.lock_findings(schema, data, lock_path)
+    assert {f.rule for f in found} == {WireContract.LOCK}
+    msgs = " | ".join(f.message for f in found)
+    assert f"'{removed['key']}'" in msgs and "not in the schema" in msgs
+    assert "'ghost_key'" in msgs and "no longer in the code" in msgs
+    assert f"'{flipped['key']}'" in msgs and "drifted" in msgs
+
+
+def test_mutation_deleting_remote_producer_key_fails():
+    """ISSUE-16 acceptance: deleting a producer key from nodes/remote.py
+    fails with the matching wire-orphan + wire-unversioned + wire-lock."""
+    files = _package_sources()
+    files["nodes/remote.py"] = files["nodes/remote.py"].replace(
+        "self.out[RemoteWire.ROUND.value]", "_shadow")
+    schema = ws.extract_schema(files=files)
+    rules = {f.rule for f in (ws.orphan_findings(schema)
+                              + ws.unversioned_findings(schema))}
+    assert WireContract.ORPHAN in rules        # consumed, never produced
+    assert WireContract.UNVERSIONED in rules   # remote no longer stamps
+    drift = ws.lock_findings(schema, ws.load_lock(LOCK), LOCK)
+    assert any(f.rule == WireContract.LOCK and "'wire_round'" in f.message
+               for f in drift)
+
+
+def test_mutation_dropping_roster_epoch_echo_fails():
+    files = _package_sources()
+    files["nodes/local.py"] = files["nodes/local.py"].replace(
+        "self.out[LocalWire.ROSTER_EPOCH.value]", "_shadow")
+    schema = ws.extract_schema(files=files)
+    unv = ws.unversioned_findings(schema)
+    assert any("'roster_epoch'" in f.message
+               and f.path.endswith("nodes/local.py") for f in unv)
+    drift = ws.lock_findings(schema, ws.load_lock(LOCK), LOCK)
+    assert any(f.rule == WireContract.LOCK for f in drift)
+
+
+# -------------------------------------------------------------------- reconcile
+def _write_telemetry(dirpath, records):
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, "telemetry.site_0.jsonl"), "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_reconcile_accounts_modeled_bytes(tmp_path):
+    schema = ws.extract_schema(paths=[PKG])
+    _write_telemetry(str(tmp_path), [
+        {"kind": "wire", "op": "save", "file": "grads.npy",
+         "bytes": 5423, "payload_kind": "tensor"},
+        {"kind": "wire", "op": "load", "file": "avg_grads.npy",
+         "bytes": 2711, "payload_kind": "tensor"},
+        {"kind": "event", "name": "daemon:frame", "tx_bytes": 100,
+         "rx_bytes": 80, "payload_kind": "delta"},
+    ])
+    assert ws.reconcile_findings(schema, str(tmp_path)) == []
+
+
+def test_reconcile_reports_unmodeled_and_unlabeled_bytes(tmp_path):
+    schema = ws.extract_schema(paths=[PKG])
+    _write_telemetry(str(tmp_path), [
+        {"kind": "wire", "op": "save", "file": "mystery.bin",
+         "bytes": 1000, "payload_kind": "tensor"},
+        {"kind": "wire", "op": "save", "file": "grads.npy", "bytes": 77},
+    ])
+    found = ws.reconcile_findings(schema, str(tmp_path))
+    assert {f.rule for f in found} == {WireContract.UNMODELED}
+    msgs = " | ".join(f.message for f in found)
+    assert "1000" in msgs and "mystery.bin" in msgs
+    assert "(unlabeled)" in msgs and "77" in msgs
+
+
+def test_reconcile_with_no_records_is_a_config_finding(tmp_path):
+    schema = ws.extract_schema(paths=[PKG])
+    found = ws.reconcile_findings(schema, str(tmp_path))
+    assert [f.rule for f in found] == [WireContract.CONFIG]
+
+
+def test_reconcile_over_a_real_smoke_run_if_present():
+    """The acceptance gate the CI lint job re-checks: a telemetry_smoke.py
+    run reconciles with zero wire-unmodeled bytes (run here only when a
+    smoke workdir exists — tier-1 must stay JAX-run-free)."""
+    smoke = os.environ.get("WIRE_SMOKE_DIR")
+    if not smoke or not os.path.isdir(smoke):
+        import pytest
+        pytest.skip("no telemetry_smoke workdir (set WIRE_SMOKE_DIR)")
+    schema = ws.extract_schema(paths=[PKG])
+    assert ws.reconcile_findings(schema, smoke) == []
+
+
+# ------------------------------------------------------------------ docs table
+def test_contract_table_renders_and_regenerates_the_doc(tmp_path):
+    schema = ws.extract_schema(paths=[PKG])
+    data = ws.lock_payload(schema)
+    table = ws.render_contract_table(data)
+    assert "| `grads_file` | site->agg | site | agg | tensor | yes |" in table
+    doc = tmp_path / "FEDERATION.md"
+    doc.write_text(f"intro\n{ws.DOC_BEGIN}\nstale\n{ws.DOC_END}\ntail\n")
+    assert ws.update_federation_doc(data, str(doc))
+    text = doc.read_text()
+    assert "stale" not in text and table in text
+    assert text.startswith("intro\n") and text.endswith("tail\n")
+
+
+def test_checked_in_doc_table_matches_the_lockfile():
+    """docs/FEDERATION.md's generated table agrees with the lockfile — the
+    doc can never drift from the code."""
+    doc = os.path.join(REPO, "docs", "FEDERATION.md")
+    with open(doc, "r", encoding="utf-8") as f:
+        text = f.read()
+    table = ws.render_contract_table(ws.load_lock(LOCK))
+    assert table in text
+
+
+# ------------------------------------------------------------- CLI composition
+def test_cli_wire_runs_clean_against_checked_in_lockfile(capsys):
+    rc = main([PKG, "--baseline", BASELINE, "--wire", "--wire-lock", LOCK])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+
+def test_cli_wire_knobs_require_the_flag(capsys):
+    for extra in (["--write-lock"], ["--wire-ledger", "x.json"],
+                  ["--reconcile", "d"], ["--wire-lock", "f.json"]):
+        rc = main([PKG] + extra)
+        assert rc == 2
+        assert "require" in capsys.readouterr().err
+
+
+def test_cli_wire_rules_require_the_tier(capsys):
+    rc = main([PKG, "--rules", "wire-orphan"])
+    assert rc == 2
+    assert "--wire" in capsys.readouterr().err
+
+
+def test_cli_wire_config_survives_rules_filters_like_other_tiers(
+        tmp_path, capsys):
+    """Satellite 1: the tier-6 error channel survives ANY --rules filter,
+    exactly like the existing tiers' config channels — a missing lockfile
+    must never exit clean just because --rules narrowed the run."""
+    missing = str(tmp_path / "absent.lock.json")
+    rc = main([PKG, "--baseline", BASELINE, "--wire",
+               "--wire-lock", missing, "--rules", "wire-atomic-commit"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "wire-config" in out and "missing" in out
+    # the config ids are first-class selectable, tier by tier (the
+    # existing channels' contract, pinned here as the regression guard)
+    rc = main([PKG, "--baseline", BASELINE, "--wire", "--wire-lock", LOCK,
+               "--rules", "wire-config"])
+    assert rc == 0, capsys.readouterr().out
+
+
+def test_cli_list_rules_enumerates_every_opt_in_tier(capsys):
+    """Satellite 6: opt-in tier rules are visible WITHOUT the tier flag,
+    each annotated with its owning tier."""
+    rc = main(["--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "wire-orphan: (tier-6 wire auditor, --wire" in out
+    assert "wire-unmodeled: (tier-6 wire auditor, --wire" in out
+    assert "deep-recompile: (tier-2 deep checker, --deep" in out
+    assert "conc-unguarded-shared-write" in out
+    assert "proto-model-" in out and "tier3-" in out
+    # the default-tier rule keeps its own listing, not a tier-6 label
+    assert "wire-atomic-commit: (tier-6" not in out
+
+
+def test_tier_prefixes_track_tier6_by_exact_id():
+    """The carry-over tuple must never claim the default-tier
+    wire-atomic-commit on the shared 'wire-' spelling."""
+    assert "wire" in TIER_PREFIXES
+    assert not any("wire-atomic-commit".startswith(p)
+                   for p in TIER_PREFIXES["wire"])
+    for rid in ws.WIRE_RULE_IDS:
+        assert any(rid.startswith(p) for p in TIER_PREFIXES["wire"])
+
+
+def test_write_baseline_without_wire_carries_tier6_entries_only(
+        tmp_path, capsys):
+    """A static-only --write-baseline refresh keeps accepted tier-6
+    entries verbatim but drops a stale default-tier wire-atomic-commit
+    entry (the exact-id carry-over contract)."""
+    baseline = tmp_path / "baseline.json"
+    keep = {"rule": WireContract.LOCK, "path": "wire_schema.lock.json",
+            "message": "accepted drift", "count": 1}
+    drop = {"rule": "wire-atomic-commit", "path": "gone.py",
+            "message": "stale", "count": 1}
+    baseline.write_text(json.dumps({"findings": [keep, drop]}))
+    rc = main([PKG, "--baseline", str(baseline), "--write-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    kept = json.loads(baseline.read_text())["findings"]
+    assert any(e["rule"] == WireContract.LOCK for e in kept)
+    assert not any(e["rule"] == "wire-atomic-commit" for e in kept)
+
+
+def test_cli_write_lock_and_ledger_emit_artifacts(tmp_path, capsys, monkeypatch):
+    """--write-lock + --wire-ledger write the CI artifacts; the fresh
+    lockfile immediately verifies clean."""
+    monkeypatch.chdir(tmp_path)
+    lock = str(tmp_path / "lock.json")
+    ledger = str(tmp_path / "ledger.json")
+    rc = main([PKG, "--baseline", BASELINE, "--wire", "--write-lock",
+               "--wire-lock", lock, "--wire-ledger", ledger])
+    assert rc == 0, capsys.readouterr().out
+    data = json.load(open(lock))
+    assert data["v"] == 1 and len(data["entries"]) > 40
+    led = json.load(open(ledger))
+    tensor_rows = [r for r in led["entries"] if r["payload"] == "tensor"]
+    assert tensor_rows and all("formula" in r for r in tensor_rows)
+    rc = main([PKG, "--baseline", BASELINE, "--wire", "--wire-lock", lock])
+    assert rc == 0, capsys.readouterr().out

@@ -316,6 +316,87 @@ def test_write_frame_returns_byte_count():
     assert n == len(buf.getvalue())
 
 
+def test_worker_echoes_request_round_on_every_response(tmp_path):
+    """ISSUE-16 wire-contract fix: worker responses echo the request's
+    round stamp verbatim (the frame-lane twin of the wire_round echo), the
+    engine consumes the worker's warm report, and daemon:frame events
+    carry the payload_kind/warm/round fields --reconcile buckets by."""
+    eng, script = _echo_engine(tmp_path)
+    rec = _engine_rec(eng)
+    try:
+        out = eng._invoke(script, {"cache": {}, "input": {}, "state": {}},
+                          target="site_0", rec=rec, rnd=7)
+        out = eng._invoke(script, {"cache": out["cache"], "input": {},
+                                   "state": {}},
+                          target="site_0", rec=rec, rnd=8)
+        assert out["cache"]["n"] == 2
+        rec.flush()
+        frames = [e for e in load_events(eng.workdir)
+                  if e.get("name") == "daemon:frame"]
+        assert [f["round"] for f in frames] == [7, 8]
+        assert [f["warm"] for f in frames] == [False, True]
+        assert [f["payload_kind"] for f in frames] == ["json", "delta"]
+    finally:
+        eng.close()
+
+
+def test_node_error_response_still_echoes_the_round(tmp_path):
+    """The error frame carries the same round echo as the success frame —
+    a failed node must not open an unversioned hole in the frame lane."""
+    eng, script = _echo_engine(tmp_path)
+    rec = _engine_rec(eng)
+    try:
+        with pytest.raises(RuntimeError, match="node-level failure"):
+            eng._invoke(script, {"cache": {}, "input": {"cmd": "boom"},
+                                 "state": {}},
+                        target="site_0", rec=rec, rnd=5)
+        # drive the raw frame pipe to observe the error frame itself
+        worker = eng._workers["site_0"]
+        res = worker.request({"op": "invoke", "round": 6,
+                              "payload": {"cache": {}, "input":
+                                          {"cmd": "boom"}, "state": {}}},
+                             timeout=5)
+        assert res["ok"] is False
+        assert res["round"] == 6
+    finally:
+        eng.close()
+
+
+def test_round_echo_mismatch_is_a_worker_desync(tmp_path, monkeypatch):
+    """A response answering some OTHER round than the one requested is a
+    frame-lane desync: the engine kills the worker and the supervised
+    restart re-serves the request — the round never sees a stale result."""
+    from coinstac_dinunet_tpu.federation import daemon as daemon_mod
+
+    eng, script = _echo_engine(tmp_path)
+    rec = _engine_rec(eng)
+    real_request = daemon_mod._Worker.request
+    lies = {"left": 1}
+
+    def lying_request(self, msg, timeout=None):
+        res = real_request(self, msg, timeout=timeout)
+        if msg.get("op") == "invoke" and lies["left"]:
+            lies["left"] -= 1
+            res = dict(res)
+            res["round"] = (msg.get("round") or 0) - 1  # stale echo
+        return res
+
+    monkeypatch.setattr(daemon_mod._Worker, "request", lying_request)
+    try:
+        out = eng._invoke(script, {"cache": {}, "input": {}, "state": {}},
+                          target="site_0", rec=rec, rnd=3)
+        # the desynced first attempt was killed + restarted, then served
+        assert out["cache"]["n"] == 1
+        rec.flush()
+        events = load_events(eng.workdir)
+        restarts = [e for e in events
+                    if e.get("name") == Daemon.EVENT_RESTART]
+        assert len(restarts) == 1
+        assert "desync" in restarts[0]["error"]
+    finally:
+        eng.close()
+
+
 # --------------------------------------------- fresh-process timeout satellite
 def test_subprocess_timeout_is_typed_with_partial_stderr(tmp_path):
     """SubprocessEngine._invoke maps subprocess.TimeoutExpired to the typed
