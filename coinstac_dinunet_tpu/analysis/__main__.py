@@ -130,6 +130,24 @@ def build_parser():
                    help="also write the static byte-cost ledger JSON "
                         "(params x dtype x per-round multiplicity per "
                         "tensor path; the CI lint job uploads it)")
+    p.add_argument("--tier7", action="store_true",
+                   help="also run the tier-7 numerics & determinism "
+                        "auditor: the static num-* rules (pure AST: PRNG "
+                        "key discipline, unordered fan-in reduction, codec "
+                        "error accounting), the num-accum-narrow jaxpr "
+                        "pass over the tier-3 lowering cache, and the "
+                        "proto-num-parity bit-parity prover executing "
+                        "every claimed equivalence contract two-armed "
+                        "under virtual time (see docs/ANALYSIS.md "
+                        "'Tier 7')")
+    p.add_argument("--parity", action="store_true",
+                   help="run ONLY the tier-7 bit-parity prover (skip the "
+                        "static num-* scan and the jaxpr pass; numpy only, "
+                        "no JAX)")
+    p.add_argument("--parity-plans", default=None, metavar="DIR",
+                   help="write each proto-num-parity counterexample as a "
+                        "replayable parity plan JSON into DIR (the CI lint "
+                        "job uploads these in the lint-findings artifact)")
     p.add_argument("--reconcile", default=None, metavar="DIR",
                    help="compare the static byte ledger against the "
                         "telemetry wire records under DIR (recursive "
@@ -150,6 +168,7 @@ TIER_PREFIXES = {
     # and its baselined entries must not ride a tier-6 carry-over
     "wire": ("wire-orphan", "wire-unversioned", "wire-dense", "wire-lock",
              "wire-unmodeled", "wire-config"),
+    "tier7": ("num-", "proto-num-"),
 }
 
 
@@ -201,6 +220,16 @@ def main(argv=None):
         for rid in WIRE_RULE_IDS:
             print(f"{rid}: (tier-6 wire auditor, --wire; "
                   "see docs/ANALYSIS.md)")
+        from ..config.keys import Numerics
+        from .numerics import NUMERICS_STATIC_RULE_IDS
+
+        for rid in NUMERICS_STATIC_RULE_IDS:
+            print(f"{rid}: (tier-7 numerics auditor, --tier7; "
+                  "see docs/ANALYSIS.md)")
+        print(f"{Numerics.ACCUM_NARROW}: (tier-7 jaxpr pass, --tier7; "
+              "see docs/ANALYSIS.md)")
+        print(f"{Numerics.PARITY}: (tier-7 parity prover, "
+              "--tier7/--parity; see docs/ANALYSIS.md)")
         return 0
     if args.list_deep:
         from .deepcheck import list_entry_points
@@ -284,20 +313,36 @@ def main(argv=None):
         print("--write-lock/--wire-lock/--wire-ledger/--reconcile require "
               "--wire", file=sys.stderr)
         return 2
+    if args.parity_plans is not None and not (args.tier7 or args.parity):
+        print("--parity-plans requires --tier7 or --parity",
+              file=sys.stderr)
+        return 2
+    if args.write_baseline and args.parity and not args.tier7:
+        print("--write-baseline with --parity (prover only) would drop the "
+              "static num-* baselined findings; refresh with --tier7 "
+              "instead", file=sys.stderr)
+        return 2
     rule_ids = args.rules.split(",") if args.rules else None
     if rule_ids:
         from .concurrency import TIER5_STATIC_RULE_IDS
         from .dataflow import TIER3_RULE_IDS
         from .model_check import MODEL_RULE_IDS
         from .schedule_explorer import EXPLORER_RULE_IDS
+        from ..config.keys import Numerics
+        from .numerics import NUMERICS_STATIC_RULE_IDS
         from .wire_schema import WIRE_RULE_IDS
 
         tier5_ids = set(TIER5_STATIC_RULE_IDS) | set(EXPLORER_RULE_IDS)
-        # tier-3/4/5/6 ids are selectable too (their findings are filtered
-        # after the tier runs below)
+        tier7_static_ids = set(NUMERICS_STATIC_RULE_IDS) | {
+            Numerics.ACCUM_NARROW
+        }
+        # tier-3/4/5/6/7 ids are selectable too (their findings are
+        # filtered after the tier runs below)
         known = {r.id for r in rules} | set(TIER3_RULE_IDS) | set(
             MODEL_RULE_IDS
-        ) | tier5_ids | set(WIRE_RULE_IDS)
+        ) | tier5_ids | set(WIRE_RULE_IDS) | tier7_static_ids | {
+            Numerics.PARITY
+        }
         unknown = sorted(set(rule_ids) - known)
         if unknown:
             print(f"unknown rule id(s): {', '.join(unknown)} "
@@ -324,6 +369,18 @@ def main(argv=None):
         if wire_selected and not args.wire:
             print(f"--rules {','.join(wire_selected)} requires --wire "
                   "(tier-6 rules only run under --wire)", file=sys.stderr)
+            return 2
+        tier7_selected = sorted(set(rule_ids) & tier7_static_ids)
+        if tier7_selected and not args.tier7:
+            print(f"--rules {','.join(tier7_selected)} requires --tier7 "
+                  "(the static tier-7 rules only run under --tier7)",
+                  file=sys.stderr)
+            return 2
+        if Numerics.PARITY in set(rule_ids) and not (args.tier7
+                                                     or args.parity):
+            print(f"--rules {Numerics.PARITY} requires --tier7 or "
+                  "--parity (the parity prover only runs under them)",
+                  file=sys.stderr)
             return 2
     if args.write_baseline and rule_ids:
         print("--write-baseline with --rules would drop every other rule's "
@@ -489,7 +546,32 @@ def main(argv=None):
             print(f"wrote wire-schema lockfile to "
                   f"{args.wire_lock or DEFAULT_LOCK} "
                   f"({len(wire_schema.entries)} entries)")
-    if args.deep or args.tier3 or args.model or args.tier5 or args.wire:
+    if args.tier7 or args.parity:
+        # tier-7: the static num-* numerics rules (pure AST), the
+        # accum-narrow jaxpr pass over the tier-3 lowering cache (imports
+        # JAX), and the bit-parity prover (numpy only)
+        from ..config.keys import Numerics
+        from .numerics import run_accum_narrow, run_tier7_static
+        from .parity import run_parity_prover
+
+        wanted7 = set(rule_ids) if rule_ids else None
+        tier7_findings = []
+        if args.tier7:
+            tier7_findings += list(run_tier7_static(paths=args.paths))
+            if wanted7 is None or Numerics.ACCUM_NARROW in wanted7:
+                # skip the JAX import (and every entry lowering) when
+                # --rules selected no jaxpr-pass rule at all
+                tier7_findings += list(run_accum_narrow())
+        if wanted7 is None or Numerics.PARITY in wanted7:
+            result7 = run_parity_prover(plans_dir=args.parity_plans)
+            tier7_findings += result7.findings
+        if wanted7 is not None:
+            # the tier's own error channel must survive any filter
+            keep = wanted7 | {Numerics.CONFIG}
+            tier7_findings = [f for f in tier7_findings if f.rule in keep]
+        findings = findings + tier7_findings
+    if (args.deep or args.tier3 or args.model or args.tier5 or args.wire
+            or args.tier7 or args.parity):
         findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     baseline_path = args.baseline
@@ -501,7 +583,7 @@ def main(argv=None):
         broken = [f.rule for f in findings
                   if f.rule in ("deep-config", "tier3-config",
                                 "proto-model-config", "proto-conc-config",
-                                "wire-config")]
+                                "wire-config", "num-config")]
         if broken:
             # an opt-in tier never actually ran (platform misconfig,
             # explorer failure, or a truncated bound) — writing now would
@@ -518,7 +600,8 @@ def main(argv=None):
                                     ("tier3", args.tier3),
                                     ("model", args.model),
                                     ("tier5", args.tier5),
-                                    ("wire", args.wire)) if not ran]
+                                    ("wire", args.wire),
+                                    ("tier7", args.tier7)) if not ran]
         if missing and os.path.exists(out):
             # a tier that didn't run contributes nothing to this refresh —
             # carry its accepted entries over instead of silently dropping

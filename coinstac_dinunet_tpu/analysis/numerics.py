@@ -1,0 +1,606 @@
+"""num-*: the tier-7 numerics & determinism auditor (static half).
+
+Every acceptance pin in this repo is a bit-parity claim — run-ahead d=0 ≡
+serial, async k=0 + pool-1 ≡ lockstep, mmap ≡ copy loads, the vectorized
+engine ≡ file transport — and ROADMAP item 1's compressed wire will bend
+floating-point numerics on every one of those boundaries.  This pass
+statically guards the properties the pins rest on:
+
+- ``num-prng-reuse`` — a PRNGKey value consumed by two or more
+  consuming calls (samplers, ``split``) without an intervening
+  re-derivation, or consumed inside a loop without per-iteration
+  re-derivation: both streams draw identical bits.  ``fold_in`` is the
+  sanctioned indexed derivation and never counts as consumption.
+- ``num-prng-discard`` — ``jax.random.split(...)`` immediately
+  subscripted by a literal index: the sibling key is silently dropped,
+  and the kept half can collide with a ``fold_in`` derivation of the
+  same parent (``nn/basetrainer.py``'s dp step was the in-tree case).
+- ``num-prng-constant`` — a literal-seeded key constructed inside a
+  loop or a per-step/per-round function: every pass replays identical
+  noise.
+- ``num-unordered-reduce`` — a reduce fan-in (``load_arrays_many``,
+  ``stack``/``concatenate``, ``sum``) whose operand order depends on
+  dict/set iteration or an unsorted directory listing.  fp addition
+  does not commute bitwise, so operand order IS the parity contract.
+- ``num-codec-unbounded`` — a module defining wire-codec kernels
+  (``compress*``/``quantize*``/…) that neither emits compression
+  telemetry itself (``record_compression_health``, ``rec.event(...,
+  cat="compress")``, ``rec.wire(..., codec=...)``) nor is called from a
+  module that does: a lossy wire path would ship unaccounted.
+- ``num-accum-narrow`` — a sum/mean/optimizer-moment accumulation whose
+  jaxpr lowers in bf16/f16, audited over the tier-3 entry-build cache
+  (:mod:`.dataflow` — trainer, reducer, powersgd, rankdad,
+  ``federation/vector.py``; no JAX builds beyond ``--tier3``'s own).
+
+All rules but ``num-accum-narrow`` are pure stdlib ``ast`` — no JAX, no
+engine import; a whole-package run stays in the tens of milliseconds.
+The dynamic half of tier 7 — the bit-parity prover over the engine's
+claimed equivalence contracts — lives in :mod:`.parity`.
+"""
+import ast
+import os
+
+from ..config.keys import Numerics
+from .core import Finding, Module, dotted_name, iter_python_files
+
+NUMERICS_STATIC_RULE_IDS = (
+    Numerics.CODEC_UNBOUNDED,
+    Numerics.PRNG_CONSTANT,
+    Numerics.PRNG_DISCARD,
+    Numerics.PRNG_REUSE,
+    Numerics.UNORDERED_REDUCE,
+)
+
+#: jax.random callables that CONSUME their key's bits: two calls on the
+#: same key yield correlated (for split: identical) streams.  ``fold_in``
+#: is deliberately absent — fold_in(key, i) with a varying i is the
+#: sanctioned indexed derivation.
+_CONSUMERS = frozenset({
+    "ball", "bernoulli", "beta", "binomial", "bits", "categorical",
+    "cauchy", "chisquare", "choice", "dirichlet", "double_sided_maxwell",
+    "exponential", "gamma", "geometric", "gumbel", "laplace", "loggamma",
+    "logistic", "lognormal", "maxwell", "multivariate_normal", "normal",
+    "orthogonal", "pareto", "permutation", "poisson", "rademacher",
+    "randint", "rayleigh", "shuffle", "split", "t", "triangular",
+    "truncated_normal", "uniform", "wald", "weibull_min",
+})
+
+#: key constructors (num-prng-constant scope)
+_KEY_CTORS = frozenset({"PRNGKey", "key"})
+
+#: function-name fragments marking a per-step/per-round path …
+_STEPPY = ("step", "round", "batch", "epoch", "iteration")
+#: … and the one-time-construction fragments that exempt it
+_STEPPY_EXEMPT = ("init", "seed", "make", "build", "setup", "example",
+                  "test", "entry", "fixture")
+
+#: fan-in sinks whose operand ORDER is the reduce's parity contract
+_SINK_LEAVES = frozenset({
+    "concatenate", "hstack", "load_arrays_many", "stack", "vstack",
+})
+
+#: codec-kernel def-name prefixes (num-codec-unbounded scope)
+_CODEC_PREFIXES = ("compress", "decompress", "dequantize", "quantize",
+                   "reconstruct", "encode_", "decode_")
+
+#: keyword evidence that a ``rec.event(...)`` accounts a codec
+_COMPRESS_EVIDENCE_KWARGS = frozenset({
+    "compression_ratio", "error_norm", "factored_bytes", "full_bytes",
+})
+
+
+# --------------------------------------------------------- jax.random env
+def _jr_env(tree):
+    """(module-alias set, direct-name map) for jax.random in this module:
+    aliases are names the ``jax.random`` MODULE is bound to (``random``,
+    ``jr``); direct names map a bare imported callable to its leaf
+    (``from jax.random import split as sp`` → ``{"sp": "split"}``)."""
+    aliases, direct = set(), {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                # plain ``import jax.random`` binds ``jax`` — the dotted
+                # ``jax.random.X`` spelling is matched structurally below
+                if a.name == "jax.random" and a.asname:
+                    aliases.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "random":
+                        aliases.add(a.asname or "random")
+            elif node.module == "jax.random":
+                for a in node.names:
+                    direct[a.asname or a.name] = a.name
+    return aliases, direct
+
+
+def _jr_leaf(call, env):
+    """The jax.random leaf name of a call (``"split"``, ``"normal"``,
+    ``"PRNGKey"``), or None when the call is not a jax.random call."""
+    aliases, direct = env
+    name = dotted_name(call.func, require_name_root=False) or ""
+    parts = name.split(".")
+    if len(parts) == 1:
+        return direct.get(parts[0])
+    if parts[-2] in aliases:
+        return parts[-1]
+    # structural jax.random.X — bare ``random.X`` without a jax import is
+    # the STDLIB module (random.shuffle) and must not match
+    if len(parts) >= 3 and parts[-2] == "random" and parts[-3] == "jax":
+        return parts[-1]
+    return None
+
+
+def _key_arg_name(call):
+    """The consumed key operand when it is a plain Name (precision over
+    recall: attribute keys like ``ts.rng`` are out of scope)."""
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0].id
+    return None
+
+
+def _target_names(target, out):
+    if isinstance(target, ast.Name):
+        out.add(target.id)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            _target_names(elt, out)
+    elif isinstance(target, ast.Starred):
+        _target_names(target.value, out)
+
+
+def _assigned_names(stmts):
+    """Every plain name (re)bound anywhere under ``stmts`` — loop-carried
+    re-derivation evidence for the loop-reuse check."""
+    names = set()
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    _target_names(t, names)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign,
+                                   ast.NamedExpr)):
+                _target_names(node.target, names)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                _target_names(node.target, names)
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                _target_names(node.optional_vars, names)
+    return names
+
+
+def _stmt_calls(stmt):
+    """Calls in one statement, nested defs/lambdas excluded (they are
+    their own key scope), in source order."""
+    out = []
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not stmt:
+            continue
+        if isinstance(node, ast.Call):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    out.sort(key=lambda c: (c.lineno, c.col_offset))
+    return out
+
+
+# --------------------------------------------------------------- PRNG scan
+class _PrngScan:
+    """num-prng-reuse over one function body: a linear walk tracking which
+    key names have been consumed, cleansed on rebinding; branches walk on
+    copies and merge by union (a key consumed on either branch is consumed
+    after the join)."""
+
+    def __init__(self, mod, env, findings):
+        self.mod, self.env, self.findings = mod, env, findings
+
+    def run(self, fn):
+        self._block(fn.body, {}, frozenset())
+
+    def _flag(self, call, message):
+        self.findings.append(Finding(
+            rule=Numerics.PRNG_REUSE, path=self.mod.path,
+            line=call.lineno, col=call.col_offset, message=message,
+        ))
+
+    def _consume(self, stmt, consumed, loop_locals):
+        for call in _stmt_calls(stmt):
+            leaf = _jr_leaf(call, self.env)
+            if leaf not in _CONSUMERS:
+                continue
+            name = _key_arg_name(call)
+            if name is None:
+                continue
+            if loop_locals is not None and name not in loop_locals:
+                self._flag(call, (
+                    f"PRNGKey '{name}' is consumed by jax.random.{leaf} "
+                    "inside a loop without per-iteration re-derivation — "
+                    "every iteration replays identical bits (split or "
+                    "fold_in the loop index first)"
+                ))
+            elif name in consumed:
+                first = consumed[name]
+                self._flag(call, (
+                    f"PRNGKey '{name}' already consumed at line {first} is "
+                    f"consumed again by jax.random.{leaf} — both draws use "
+                    "identical bits (split/fold_in between consumptions)"
+                ))
+            else:
+                consumed[name] = call.lineno
+
+    def _block(self, stmts, consumed, assigned_in_loops, loop_locals=None):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes are scanned separately
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                body_locals = _assigned_names(stmt.body)
+                self._block(stmt.body, dict(consumed), assigned_in_loops,
+                            loop_locals=body_locals)
+                self._block(stmt.orelse, dict(consumed), assigned_in_loops)
+                # names the loop rebinds are re-derived from the caller's
+                # perspective; the rest keep their pre-loop state
+                for n in body_locals:
+                    consumed.pop(n, None)
+                continue
+            if isinstance(stmt, ast.If):
+                a, b = dict(consumed), dict(consumed)
+                self._block(stmt.body, a, assigned_in_loops, loop_locals)
+                self._block(stmt.orelse, b, assigned_in_loops, loop_locals)
+                consumed.clear()
+                consumed.update(b)
+                consumed.update(a)
+                continue
+            if isinstance(stmt, ast.Try):
+                for block in ([stmt.body, stmt.orelse, stmt.finalbody]
+                              + [h.body for h in stmt.handlers]):
+                    self._block(block, consumed, assigned_in_loops,
+                                loop_locals)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._consume(stmt, consumed, loop_locals)
+                self._block(stmt.body, consumed, assigned_in_loops,
+                            loop_locals)
+                continue
+            self._consume(stmt, consumed, loop_locals)
+            rebound = set()
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    _target_names(t, rebound)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign,
+                                   ast.NamedExpr)):
+                _target_names(stmt.target, rebound)
+            for n in rebound:
+                consumed.pop(n, None)
+
+
+def _scan_prng_reuse(mod, env, findings):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _PrngScan(mod, env, findings).run(node)
+
+
+def _scan_prng_discard(mod, env, findings):
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Subscript):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        if _jr_leaf(node.value, env) != "split":
+            continue
+        ix = node.slice
+        if isinstance(ix, ast.UnaryOp) and isinstance(ix.op, ast.USub):
+            ix = ix.operand
+        if isinstance(ix, ast.Constant) and isinstance(ix.value, int):
+            findings.append(Finding(
+                rule=Numerics.PRNG_DISCARD, path=mod.path,
+                line=node.lineno, col=node.col_offset,
+                message=(
+                    "jax.random.split(...)[literal] silently drops the "
+                    "sibling key — and the kept half can collide with a "
+                    "fold_in derivation of the same parent.  Unpack the "
+                    "split and thread both halves (or fold_in a counter)"
+                ),
+            ))
+
+
+def _is_const_seed(call):
+    return bool(call.args) and all(
+        isinstance(a, ast.Constant) and isinstance(a.value, (int, bool))
+        for a in call.args
+    )
+
+
+def _scan_prng_constant(mod, env, findings):
+    def steppy(name):
+        low = name.lower()
+        return (any(s in low for s in _STEPPY)
+                and not any(s in low for s in _STEPPY_EXEMPT))
+
+    def visit(node, fname, in_loop):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fname, in_loop = node.name, False
+        elif isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            in_loop = True
+        elif isinstance(node, ast.Call):
+            leaf = _jr_leaf(node, env)
+            if leaf in _KEY_CTORS and _is_const_seed(node) and (
+                    in_loop or (fname and steppy(fname))):
+                where = ("a loop" if in_loop
+                         else f"per-step path '{fname}'")
+                findings.append(Finding(
+                    rule=Numerics.PRNG_CONSTANT, path=mod.path,
+                    line=node.lineno, col=node.col_offset,
+                    message=(
+                        f"constant-seeded jax.random.{leaf} constructed "
+                        f"inside {where} — every pass replays identical "
+                        "noise; derive the key from the carried rng (or "
+                        "fold_in the step counter)"
+                    ),
+                ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, fname, in_loop)
+
+    visit(mod.tree, None, False)
+
+
+# ------------------------------------------------------- unordered fan-in
+_UNORDERED_ITER_LEAVES = {
+    "keys": "dict key iteration order",
+    "values": "dict value iteration order",
+    "items": "dict item iteration order",
+    "listdir": "an unsorted os.listdir enumeration",
+    "iterdir": "an unsorted Path.iterdir enumeration",
+    "scandir": "an unsorted os.scandir enumeration",
+    "glob": "an unsorted glob enumeration",
+}
+
+
+def _unordered_reason(node, tainted):
+    """Why this expression's element ORDER is unstable, or None."""
+    if isinstance(node, ast.Name):
+        return tainted.get(node.id)
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "set iteration order"
+    if isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+        for gen in node.generators:
+            reason = _unordered_reason(gen.iter, tainted)
+            if reason:
+                return reason
+        return None
+    if isinstance(node, ast.Starred):
+        return _unordered_reason(node.value, tainted)
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func, require_name_root=False) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in ("sorted",):
+            return None  # explicit ordering cleanses
+        if leaf in _UNORDERED_ITER_LEAVES:
+            return _UNORDERED_ITER_LEAVES[leaf]
+        if leaf == "set":
+            return "set iteration order"
+        if leaf in ("list", "tuple", "reversed", "enumerate", "iter"):
+            return (_unordered_reason(node.args[0], tainted)
+                    if node.args else None)
+    return None
+
+
+def _scan_unordered_reduce(mod, env, findings):
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tainted = {}
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Assign):
+                reason = _unordered_reason(stmt.value, tainted)
+                names = set()
+                for t in stmt.targets:
+                    _target_names(t, names)
+                for n in names:
+                    if reason:
+                        tainted[n] = reason
+                    else:
+                        tainted.pop(n, None)
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            name = dotted_name(call.func, require_name_root=False) or ""
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf not in _SINK_LEAVES and not (
+                    leaf == "sum" and isinstance(call.func, ast.Name)):
+                continue
+            if not call.args:
+                continue
+            reason = _unordered_reason(call.args[0], tainted)
+            if reason:
+                findings.append(Finding(
+                    rule=Numerics.UNORDERED_REDUCE, path=mod.path,
+                    line=call.lineno, col=call.col_offset,
+                    message=(
+                        f"reduce fan-in '{leaf}' consumes operands in "
+                        f"{reason} — fp addition does not commute "
+                        "bitwise, so operand order IS the parity "
+                        "contract; sort the enumeration first"
+                    ),
+                ))
+
+
+# ------------------------------------------------------- codec accounting
+class _CodecAudit:
+    __slots__ = ("path", "codec_defs", "evidence", "called")
+
+    def __init__(self, path):
+        self.path = path
+        self.codec_defs = []   # [(name, line, col)]
+        self.evidence = False
+        self.called = set()    # leaf names of every call in the module
+
+
+def _audit_codecs(mod):
+    audit = _CodecAudit(mod.path)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name.lstrip("_").startswith(_CODEC_PREFIXES):
+                audit.codec_defs.append(
+                    (node.name, node.lineno, node.col_offset)
+                )
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func, require_name_root=False) or ""
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf:
+                audit.called.add(leaf)
+            if leaf == "record_compression_health":
+                audit.evidence = True
+            elif leaf == "event":
+                for kw in node.keywords:
+                    if kw.arg in _COMPRESS_EVIDENCE_KWARGS:
+                        audit.evidence = True
+                    if (kw.arg == "cat"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value == "compress"):
+                        audit.evidence = True
+            elif leaf == "wire":
+                if any(kw.arg == "codec" for kw in node.keywords):
+                    audit.evidence = True
+    return audit
+
+
+def _codec_findings(audits):
+    findings = []
+    for audit in audits:
+        if not audit.codec_defs or audit.evidence:
+            continue
+        defined = {name for name, _l, _c in audit.codec_defs}
+        accounted = any(
+            other.evidence and (other.called & defined)
+            for other in audits if other is not audit
+        )
+        if accounted:
+            continue
+        name, line, col = audit.codec_defs[0]
+        findings.append(Finding(
+            rule=Numerics.CODEC_UNBOUNDED, path=audit.path,
+            line=line, col=col,
+            message=(
+                f"codec kernel(s) {sorted(defined)} never emit "
+                "error/compression-ratio telemetry — neither this module "
+                "nor any consumer calls record_compression_health / "
+                "rec.event(cat='compress') / rec.wire(codec=...); a lossy "
+                "wire path would ship unaccounted"
+            ),
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------- tier run
+def run_tier7_static(paths=None):
+    """The tier-7 static half over ``paths`` (files or directories).
+    Parse failures are skipped silently — the base static scan already
+    reports them through its own error channel."""
+    paths = list(paths) if paths else ["coinstac_dinunet_tpu"]
+    findings, audits = [], []
+    for path in iter_python_files(paths):
+        display = os.path.relpath(path).replace(os.sep, "/")
+        try:
+            mod = Module.parse(path, display)
+        except (SyntaxError, UnicodeDecodeError, OSError, ValueError):
+            continue
+        env = _jr_env(mod.tree)
+        _scan_prng_reuse(mod, env, findings)
+        _scan_prng_discard(mod, env, findings)
+        _scan_prng_constant(mod, env, findings)
+        _scan_unordered_reduce(mod, env, findings)
+        audits.append(_audit_codecs(mod))
+    findings.extend(_codec_findings(audits))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ------------------------------------------------- num-accum-narrow (jaxpr)
+#: accumulation primitives whose output dtype is the accumulator dtype
+_ACCUM_PRIMS = frozenset({"add_any", "cumsum", "psum", "reduce_sum"})
+
+#: dtypes too narrow to accumulate in (mantissa loses low-order grads)
+_NARROW = frozenset({"bfloat16", "float16"})
+
+
+def run_accum_narrow(names=None, extra_jaxprs=None):
+    """``num-accum-narrow`` over the tier-3 lowering cache: every deep-
+    registry entry's jaxpr (built at most once per process — shared with
+    ``--tier3``/``--deep``) is walked for accumulation primitives whose
+    output dtype is bf16/f16.  ``extra_jaxprs`` maps a display path to a
+    ClosedJaxpr (test fixtures).  Platform failures degrade to the typed
+    ``num-config`` channel; never raises."""
+    findings = []
+    try:
+        from . import deepcheck
+        from .dataflow import entry_anchor_line, lower_entry, walk_jaxprs
+
+        targets = []
+        if extra_jaxprs:
+            for label in sorted(extra_jaxprs):
+                targets.append((label, label, 1, extra_jaxprs[label]))
+        if extra_jaxprs is None or names is not None:
+            deepcheck._register_builtin_entries()
+            have = deepcheck.ensure_virtual_devices()
+            if have < deepcheck.REQUIRED_DEVICES:
+                return [Finding(
+                    rule=Numerics.CONFIG,
+                    path="coinstac_dinunet_tpu/analysis/numerics.py",
+                    line=1, col=0,
+                    message=(
+                        "num-accum-narrow needs "
+                        f"{deepcheck.REQUIRED_DEVICES} virtual devices but "
+                        f"the initialized JAX backend has {have} — set "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count"
+                        "=8 before anything imports jax"
+                    ),
+                )]
+            wanted = set(names) if names else None
+            for name in sorted(deepcheck.DEEP_REGISTRY):
+                if wanted is not None and name not in wanted:
+                    continue
+                entry = lower_entry(name)
+                if entry.closed_jaxpr is None:
+                    continue  # tier3-lower owns the build error
+                targets.append((
+                    f"entry '{name}'", entry.path,
+                    entry_anchor_line(entry.path), entry.closed_jaxpr,
+                ))
+        for label, path, line, closed_jaxpr in targets:
+            sites = {}
+            for jaxpr, _consts, _args in walk_jaxprs(closed_jaxpr):
+                for eqn in jaxpr.eqns:
+                    if eqn.primitive.name not in _ACCUM_PRIMS:
+                        continue
+                    for v in eqn.outvars:
+                        dt = getattr(getattr(v, "aval", None), "dtype", None)
+                        if dt is not None and dt.name in _NARROW:
+                            key = (eqn.primitive.name, dt.name)
+                            sites[key] = sites.get(key, 0) + 1
+            if sites:
+                detail = ", ".join(
+                    f"{prim} in {dt} x{n}"
+                    for (prim, dt), n in sorted(sites.items())
+                )
+                findings.append(Finding(
+                    rule=Numerics.ACCUM_NARROW, path=path, line=line, col=0,
+                    message=(
+                        f"{label}: accumulation lowers in a narrow dtype "
+                        f"({detail}) — low-order contributions round away; "
+                        "accumulate in f32 and cast at the boundary "
+                        "(docs/PERF.md)"
+                    ),
+                ))
+    except Exception as exc:  # noqa: BLE001 — typed error channel
+        findings.append(Finding(
+            rule=Numerics.CONFIG,
+            path="coinstac_dinunet_tpu/analysis/numerics.py", line=1, col=0,
+            message=(
+                "the num-accum-narrow audit could not run: "
+                f"{type(exc).__name__}: {exc}"
+            ),
+        ))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -863,6 +863,63 @@ class WireContract:
     LOCKFILE = "wire_schema.lock.json"
 
 
+class Numerics:
+    """Tier-7 numerics & determinism auditor (``dinulint --tier7``,
+    :mod:`coinstac_dinunet_tpu.analysis.numerics` — static half — and
+    :mod:`coinstac_dinunet_tpu.analysis.parity` — the bit-parity
+    prover).
+
+    Plain constants, mirroring :class:`Concurrency`/:class:`WireContract`:
+    the rule vocabulary guarding the floating-point properties every
+    bit-parity pin in the repo rests on (d=0 ≡ serial, k=0+pool-1 ≡
+    lockstep, mmap ≡ copy, vectorized ≡ file transport) before lossy
+    codecs go on the wire (ROADMAP item 1).  Static rules are pure
+    ``ast``; ``ACCUM_NARROW`` additionally walks the tier-3 jaxpr
+    lowering cache (no new JAX builds beyond ``--tier3``'s own).
+
+    - ``PRNG_REUSE`` — a PRNGKey value consumed by two or more sampling
+      calls without an intervening ``split``/``fold_in``: both streams
+      draw identical bits.
+    - ``PRNG_DISCARD`` — a ``jax.random.split(...)`` immediately
+      subscripted by a literal index: the sibling key is silently
+      dropped, and the kept half may collide with a ``fold_in``
+      derivation of the same parent key.
+    - ``PRNG_CONSTANT`` — a constant-seeded key constructed inside a
+      per-round/per-step path: every round replays identical noise.
+    - ``ACCUM_NARROW`` — a sum/mean/optimizer-moment accumulation whose
+      jaxpr lowers in bf16/f16 (audited over the tier-3 entry builds:
+      trainer, reducer, powersgd, rankdad, federation/vector.py).
+    - ``UNORDERED_REDUCE`` — a reduce fan-in whose operand order depends
+      on dict/set iteration or an unsorted directory listing: fp
+      addition does not commute bitwise, so operand order IS the
+      parity contract.
+    - ``CODEC_UNBOUNDED`` — a registered wire-codec path that never
+      emits its error/compression-ratio telemetry, so a lossy wire
+      would ship unaccounted.
+    - ``PARITY`` — dynamic (the prover): a claimed engine equivalence
+      contract whose two arms diverged; the finding carries the first
+      diverging round + tensor and a replayable parity plan JSON.
+    - ``CONFIG`` — the tier's own error channel (the auditor/prover
+      could not run); survives ``--rules`` filtering like
+      ``proto-conc-config``.
+    """
+
+    #: prover bounds: sites × rounds per parity scenario (both arms run
+    #: under virtual time with pure-numpy stubs — seconds, not minutes)
+    DEFAULT_SITES = 3
+    DEFAULT_ROUNDS = 4
+
+    PRNG_REUSE = "num-prng-reuse"
+    PRNG_DISCARD = "num-prng-discard"
+    PRNG_CONSTANT = "num-prng-constant"
+    ACCUM_NARROW = "num-accum-narrow"
+    UNORDERED_REDUCE = "num-unordered-reduce"
+    CODEC_UNBOUNDED = "num-codec-unbounded"
+
+    PARITY = "proto-num-parity"
+    CONFIG = "num-config"
+
+
 class AggEngine(_StrEnum):
     """Built-in gradient-aggregation engines (≙ AGG_Engine dSGD/powerSGD/rankDAD)."""
     DSGD = "dSGD"

@@ -35,7 +35,8 @@ normalization — the in-jit mirror of the file-wire tree-reduce in
 
 Semantics match :class:`~..parallel.mesh.MeshFederation` exactly where the
 math is shared: same per-site forward-rng derivation
-(``fold_in(carried, site_index)``), same identically-advancing carried rng,
+(``fold_in(split(carried)[1], site_index)`` — both split halves consumed,
+per dinulint ``num-prng-discard``), same identically-advancing carried rng,
 same participation weighting (a fully-masked site contributes nothing and
 leaves the denominator), same aux reduction — so the vectorized engine's
 score trajectory equals the file/mesh transports' on the same data + seed
@@ -148,9 +149,12 @@ class SiteVectorizedFederation:
         # steps, hierarchical weighted reduce, per-site optimizer advance
         def one_site(params, rng, step, six, batch):
             # per-site decorrelated forward rng; the carried rng advances
-            # identically at every site (mesh-transport parity)
+            # identically at every site (mesh-transport parity).  Both
+            # split halves are consumed: [0] carries — bit-identical to
+            # the historical split(rng)[0] — and [1] seeds the site stream
+            next_rng, site_rng = jax.random.split(rng)
             ts = TrainState(params=params, opt_state=None, step=step,
-                            rng=jax.random.fold_in(rng, six))
+                            rng=jax.random.fold_in(site_rng, six))
             grads, aux = trainer._grads_uncompiled(
                 ts, batch, metrics_shell, averages_shell
             )
@@ -158,7 +162,7 @@ class SiteVectorizedFederation:
             w = ((jnp.sum(jnp.asarray(mask, jnp.float32)) > 0)
                  .astype(jnp.float32) if mask is not None else jnp.float32(1))
             aux = dict(aux)
-            aux["rng"] = jax.random.split(rng)[0]
+            aux["rng"] = next_rng
             return grads, aux, w
 
         def block(params, site_state, site_ix, stacked):

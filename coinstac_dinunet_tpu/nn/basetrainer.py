@@ -764,16 +764,21 @@ class NNTrainer:
         grad_reduce = self.make_grad_reduce(MeshAxis.DEVICE)
 
         def shard_step(ts, stacked):
-            orig_rng = ts.rng
+            # both split halves are consumed (num-prng-discard): [0]
+            # carries — identically on every shard, and bit-identical to
+            # the historical split(rng)[0] advance — while [1] seeds the
+            # per-shard decorrelated streams, so the parent key is never
+            # consumed twice
+            next_rng, shard_rng = jax.random.split(ts.rng)
             ts = ts.replace(
-                rng=jax.random.fold_in(orig_rng, jax.lax.axis_index(MeshAxis.DEVICE))
+                rng=jax.random.fold_in(shard_rng, jax.lax.axis_index(MeshAxis.DEVICE))
             )
             grads, aux = self._grads_uncompiled(
                 ts, stacked, metrics_shell, averages_shell,
                 grad_reduce=grad_reduce,
             )
             aux = self._reduce_dp_aux(aux, stacked)
-            aux["rng"] = jax.random.split(orig_rng)[0]
+            aux["rng"] = next_rng
             if not apply_updates:
                 return grads, aux
             ts = self._apply_updates(ts, grads)

@@ -454,9 +454,11 @@ class MeshFederation:
         def site_step(ts, stacked, comm):
             # drop the sharded (now size-1) site axis from the batch view
             stacked = jax.tree_util.tree_map(lambda x: x[0], stacked)
-            orig_rng = ts.rng
             # per-site decorrelated randomness for the forward pass…
-            ts = ts.replace(rng=jax.random.fold_in(orig_rng, jax.lax.axis_index(MeshAxis.SITE)))
+            # (both split halves consumed: [0] carries — bit-identical to
+            # the historical split(rng)[0] — and [1] seeds the site streams)
+            next_rng, site_rng = jax.random.split(ts.rng)
+            ts = ts.replace(rng=jax.random.fold_in(site_rng, jax.lax.axis_index(MeshAxis.SITE)))
             grads, aux = trainer._grads_uncompiled(
                 ts, stacked, metrics_shell, averages_shell,
                 grad_reduce=intra_grad_reduce, iteration_fn=iteration_fn,
@@ -473,7 +475,7 @@ class MeshFederation:
             ts = trainer._apply_updates(ts, grads)
             # …but the carried rng advances identically everywhere, keeping
             # the train state bitwise replicated across sites
-            ts = ts.replace(rng=jax.random.split(orig_rng)[0])
+            ts = ts.replace(rng=next_rng)
             aux = dict(aux)
             if aux.get("metrics") is not None:
                 aux["metrics"] = jax.lax.psum(aux["metrics"], aux_axes)

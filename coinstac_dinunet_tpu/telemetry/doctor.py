@@ -27,6 +27,7 @@ Renderers: markdown (the human postmortem, uploaded as a CI artifact), JSON
 import json
 import math
 import os
+import sys
 
 from .collect import fold_metric_sample, new_metric_stats
 
@@ -261,15 +262,70 @@ def _mfu_floor_data(bench_history, metrics, threshold):
     }
 
 
+def bench_regime(seed=None):
+    """The measurement regime a bench ledger line is produced under:
+    jax/numpy versions, platform triple, and the bench seed.  Stamped
+    into every ledger line (``scripts/bench_federation.py`` /
+    ``bench_history.py append``) so the regression verdict can tell a
+    real throughput drop from a library upgrade, a different machine, or
+    a different sampling seed.  Never imports jax just to stamp — the
+    version comes from the already-imported module or the installed
+    distribution metadata."""
+    import platform as _platform
+
+    jax_mod = sys.modules.get("jax")
+    jax_v = getattr(jax_mod, "__version__", None)
+    if jax_v is None:
+        try:
+            from importlib import metadata
+
+            jax_v = metadata.version("jax")
+        except Exception:  # noqa: BLE001 — stamp what's knowable
+            jax_v = None
+    try:
+        import numpy as np
+
+        np_v = np.__version__
+    except Exception:  # noqa: BLE001
+        np_v = None
+    regime = {
+        "jax": jax_v,
+        "numpy": np_v,
+        "platform": (f"{_platform.system()}-{_platform.machine()}"
+                     f"-py{_platform.python_version()}"),
+    }
+    if seed is not None:
+        regime["seed"] = int(seed)
+    return regime
+
+
+def regime_mismatch(prev, last):
+    """The regime keys two ledger entries DISAGREE on, or None when they
+    are comparable.  An unstamped side (pre-regime ledger lines) is
+    comparable — only a key both sides stamped with different values
+    refuses the diff."""
+    ra, rb = prev.get("regime"), last.get("regime")
+    if not isinstance(ra, dict) or not isinstance(rb, dict):
+        return None
+    bad = sorted(k for k in set(ra) & set(rb) if ra[k] != rb[k])
+    return bad or None
+
+
 def _bench_pair_data(prev, last, threshold):
     pv, lv = prev.get("value"), last.get("value")
     if not (_finite(pv) and _finite(lv)) or float(pv) <= 0:
         return None
     drop = 1.0 - float(lv) / float(pv)
+    refused = regime_mismatch(prev, last)
     return {
         "previous": float(pv), "latest": float(lv),
         "drop_pct": round(100.0 * drop, 1),
-        "regressed": drop > threshold,
+        # a cross-regime pair is REFUSED, never silently diffed: the
+        # drop (or gain) would be attributed to the code when it may be
+        # the library, machine, or seed that moved
+        "regressed": drop > threshold and not refused,
+        "refused": bool(refused),
+        "refused_keys": refused or [],
         "threshold_pct": round(100.0 * threshold, 1),
         # name the metric + unit so the verdict reads correctly for any
         # ledger (samples/sec/chip, rounds/sec, per-engine series, ...)
@@ -304,6 +360,11 @@ def _bench_verdict_data(bench_history, threshold):
     regressed = [d for d in candidates.values() if d["regressed"]]
     if regressed:
         return max(regressed, key=lambda d: d["drop_pct"])
+    refused = [d for d in candidates.values() if d.get("refused")]
+    if refused:
+        # a refusal outranks a clean comparison in the verdict: a silent
+        # skip is exactly the failure mode the regime stamp exists to fix
+        return max(refused, key=lambda d: abs(d["drop_pct"]))
     return candidates.get(
         bench_history[-1].get("metric"),
         next(iter(candidates.values())),
@@ -415,6 +476,15 @@ def _rank_verdicts(report):
             f"{bench.get('unit', 'samples/sec/chip')} {bench['latest']:g} "
             f"vs {bench['previous']:g} ({bench['drop_pct']:+.1f}% drop, "
             f"threshold {bench['threshold_pct']:g}%)",
+        )
+    elif bench and bench.get("refused"):
+        add(
+            "warning",
+            "bench regression check refused: cross-regime ledger pair",
+            f"{bench['metric']} entries were measured under different "
+            f"regimes ({', '.join(bench['refused_keys'])} changed) — "
+            f"the {bench['drop_pct']:+.1f}% delta is not attributable to "
+            "the code; re-baseline the ledger on the current regime",
         )
     floor = report.get("mfu_floor")
     if floor and floor["below_floor"]:
@@ -693,7 +763,13 @@ def render_markdown(report):
     if bench:
         lines.append("## Benchmark history")
         lines.append("")
-        state = ("**REGRESSED**" if bench["regressed"] else "within bounds")
+        if bench["regressed"]:
+            state = "**REGRESSED**"
+        elif bench.get("refused"):
+            state = ("**REFUSED** (cross-regime: "
+                     f"{', '.join(bench['refused_keys'])} changed)")
+        else:
+            state = "within bounds"
         lines.append(
             f"{bench.get('unit', 'samples/sec/chip')} {bench['latest']:g} "
             f"vs previous {bench['previous']:g} ({bench['drop_pct']:+.1f}%; "

@@ -328,24 +328,36 @@ def load_arrays_many(paths, retry=None, mmap=False):
     paths = list(paths)
     rec = _telemetry()
     t0 = time.perf_counter() if rec.enabled else 0.0
-    payloads = (
-        native.load_many(paths)
-        if native.available() and not mmap else None
-    )
+    # filesystem-independent dispatch (dinulint num-unordered-reduce):
+    # loads are ISSUED in sorted-path order and the results scatter back
+    # to the caller's positions — the returned operand order stays the
+    # caller's (they zip it positionally), but native batch order, pool
+    # scheduling, and retry-jitter forks key on the sorted rank, so a
+    # shuffled directory enumeration can never change a load's behavior
+    order = sorted(range(len(paths)), key=lambda i: paths[i])
+    rank = {i: r for r, i in enumerate(order)}
+    payloads = None
+    if native.available() and not mmap:
+        ranked = native.load_many([paths[i] for i in order])
+        payloads = [ranked[rank[i]] for i in range(len(paths))]
 
     def _task_retry(i):
         # per-task fork: concurrent loads never share a jitter RNG (draw
         # order would become thread-schedule-dependent) while the retry
         # counts still land in the one shared stats sink
-        return None if retry is None else retry.fork(i)
+        return None if retry is None else retry.fork(rank[i])
 
     if payloads is None:
         # each load_arrays call records its own wire event
-        return list(fan_in_pool().map(
-            lambda ip: load_arrays(ip[1], retry=_task_retry(ip[0]),
-                                   mmap=mmap),
-            enumerate(paths),
+        ranked = list(fan_in_pool().map(
+            lambda i: load_arrays(paths[i], retry=_task_retry(i),
+                                  mmap=mmap),
+            order,
         ))
+        out = [None] * len(paths)
+        for r, i in enumerate(order):
+            out[i] = ranked[r]
+        return out
     out = []
     for i, (p, payload) in enumerate(zip(paths, payloads)):
         if payload is None:  # transient native failure: retry via Python IO

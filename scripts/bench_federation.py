@@ -103,6 +103,18 @@ _SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
 ENGINE_KINDS = ("inprocess", "subprocess", "daemon")
 
 
+def _emit(line):
+    """Print one ledger JSON line stamped with the measurement regime
+    (jax/numpy versions, platform triple, task seed).  The doctor's
+    regression verdict keys on the stamp to REFUSE cross-regime pairs —
+    a library upgrade or machine swap must never be silently diffed as
+    a code regression (ISSUE 17)."""
+    from coinstac_dinunet_tpu.telemetry.doctor import bench_regime
+
+    line.setdefault("regime", bench_regime(seed=_CACHE.get("seed")))
+    print(json.dumps(line))
+
+
 # -------------------------------------------------------------- vectorized
 def _sample_hbm():
     """One flight-recorder device-memory sample
@@ -355,7 +367,7 @@ def _engine_main(args, workdir, probe):
         if kind == "daemon":
             line["daemon_vs_inprocess"] = ab.get("daemon_vs_inprocess")
             line["daemon_vs_subprocess"] = ab.get("daemon_vs_subprocess")
-        print(json.dumps(line))
+        _emit(line)
     if args.engine_assert:
         vs_ip = ab.get("daemon_vs_inprocess") or 0.0
         vs_sp = ab.get("daemon_vs_subprocess") or 0.0
@@ -562,15 +574,15 @@ def _async_main(args, workdir, probe):
         "slow_factor": float(args.slow_factor),
         "workdir": workdir, "backend_probe": probe,
     }
-    print(json.dumps({
+    _emit({
         "metric": f"engine_{kind}_lockstep_slow_rounds_per_sec",
         "value": lock["rounds_per_sec"], "unit": "rounds/sec",
         "rounds_per_sec_median": lock["rounds_per_sec_median"],
         "rounds_timed": lock["rounds_timed"], "round_ms": lock["round_ms"],
         "round_ms_median": lock["round_ms_median"],
         "wire_overlap_ratio": lock["wire_overlap_ratio"], **common,
-    }))
-    print(json.dumps({
+    })
+    _emit({
         "metric": f"engine_{kind}_async_rounds_per_sec",
         "value": asy["rounds_per_sec"], "unit": "rounds/sec",
         "rounds_per_sec_median": asy["rounds_per_sec_median"],
@@ -579,15 +591,15 @@ def _async_main(args, workdir, probe):
         "async_staleness": k, "async_vs_lockstep": speedup,
         "no_straggler_rounds_per_sec": probe_arm["rounds_per_sec"],
         **common,
-    }))
-    print(json.dumps({
+    })
+    _emit({
         "metric": "async_wire_overlap_ratio",
         "value": asy["wire_overlap_ratio"], "unit": "ratio",
         "lockstep_wire_overlap_ratio": lock["wire_overlap_ratio"],
         "async_staleness": k, **common,
-    }))
+    })
     if ra is not None:
-        print(json.dumps({
+        _emit({
             "metric": f"engine_{kind}_run_ahead_rounds_per_sec",
             "value": ra["rounds_per_sec"], "unit": "rounds/sec",
             "rounds_per_sec_median": ra["rounds_per_sec_median"],
@@ -598,14 +610,14 @@ def _async_main(args, workdir, probe):
             "async_rounds_per_sec": asy["rounds_per_sec"],
             "lockstep_rounds_per_sec": lock["rounds_per_sec"],
             **common,
-        }))
-        print(json.dumps({
+        })
+        _emit({
             "metric": "run_ahead_wire_overlap_ratio",
             "value": ra["wire_overlap_ratio"], "unit": "ratio",
             "async_wire_overlap_ratio": asy["wire_overlap_ratio"],
             "run_ahead": int(args.run_ahead), "async_staleness": k,
             **common,
-        }))
+        })
     if args.assert_speedup is not None:
         if ra is None:
             print("--assert-speedup needs --run-ahead (the arm it gates)",
@@ -841,7 +853,7 @@ def _churn_main(args, workdir, probe):
         "churn_fraction": frac, "workdir": workdir,
         "backend_probe": probe,
     }
-    print(json.dumps({
+    _emit({
         "metric": "vector_churn_rounds_per_sec",
         "value": churn_v["rounds_per_sec"], "unit": "rounds/sec",
         "sites": n_sites, "rounds_timed": rounds,
@@ -852,8 +864,8 @@ def _churn_main(args, workdir, probe):
         "membership_ops_planned": churn_v["membership_ops_planned"],
         "fixed_rounds_per_sec": fixed_v["rounds_per_sec"],
         "churn_vs_fixed": ratio_v, **common,
-    }))
-    print(json.dumps({
+    })
+    _emit({
         "metric": "engine_daemon_churn_rounds_per_sec",
         "value": churn_d["rounds_per_sec"], "unit": "rounds/sec",
         "sites": d_sites, "rounds_timed": churn_d["rounds_timed"],
@@ -865,7 +877,7 @@ def _churn_main(args, workdir, probe):
         "fixed_rounds_per_sec": fixed_d["rounds_per_sec"],
         "fixed_rounds_per_sec_median": fixed_d["rounds_per_sec_median"],
         "churn_vs_fixed": ratio_d, **common,
-    }))
+    })
     need = float(args.churn_assert_ratio)
     mismatch_v = (
         churn_v["membership_ops_applied"]
@@ -924,19 +936,19 @@ def _vector_straggler_main(args, workdir, probe):
         "sites": n_sites, "rounds_timed": rounds, "workdir": workdir,
         "backend_probe": probe,
     }
-    print(json.dumps({
+    _emit({
         "metric": "vector_rounds_per_sec",
         "value": clean["rounds_per_sec"], "unit": "rounds/sec",
         "round_ms": clean["round_ms"], "shards": clean["shards"], **common,
-    }))
-    print(json.dumps({
+    })
+    _emit({
         "metric": "vector_straggler_rounds_per_sec",
         "value": straggler["rounds_per_sec"], "unit": "rounds/sec",
         "round_ms": straggler["round_ms"], "shards": straggler["shards"],
         "slow_site": "site_0", "slow_seconds": slow_seconds,
         "slow_factor": float(args.slow_factor),
         "slowdown_vs_clean": slowdown, **common,
-    }))
+    })
     return 0
 
 
@@ -1049,12 +1061,12 @@ def main(argv=None):
     )
     if not probe.get("ok"):
         # typed result instead of a silent hang/timeout (BENCH_r03–r05)
-        print(json.dumps({
+        _emit({
             "metric": "federation_rounds_per_sec",
             "value": None, "unit": "rounds/sec", "sites": args.sites,
             "error": probe.get("error", "backend_init_failed"),
             "backend_probe": probe,
-        }))
+        })
         return 0
     if probe.get("fallback"):
         # jax is already imported (via _bench_util), so the env var alone
@@ -1123,7 +1135,7 @@ def main(argv=None):
             / serial[str(common)]["rounds_per_sec"], 2,
         )
     head = str(max(vec_points))
-    print(json.dumps({
+    _emit({
         "metric": "federation_rounds_per_sec",
         "value": vectorized[head]["rounds_per_sec"],
         "unit": "rounds/sec",
@@ -1135,7 +1147,7 @@ def main(argv=None):
         "speedup_at_sites": common,
         "workdir": workdir,
         "backend_probe": probe,
-    }))
+    })
     return 0
 
 

@@ -32,6 +32,19 @@ def _load_history(path):
     return load_bench_history(path)
 
 
+def _stamp_regime(entry):
+    """Ensure the entry carries its measurement regime (jax/numpy
+    versions, platform, seed).  The bench scripts stamp at emission;
+    this is the appender's backstop for lines produced by older scripts
+    — an UNSTAMPED ledger line can never be refused, so a stamp at
+    append time is strictly more honest than none."""
+    sys.path.insert(0, _REPO)
+    from coinstac_dinunet_tpu.telemetry.doctor import bench_regime
+
+    entry.setdefault("regime", bench_regime(seed=entry.get("seed")))
+    return entry
+
+
 def _compare(history, threshold):
     """(message, regressed) for the latest entry vs the PREVIOUS entry of
     the same metric — a ledger may interleave metrics (the engine A/B
@@ -55,6 +68,16 @@ def _compare(history, threshold):
         return "previous or latest entry has no numeric 'value'", False
     if pv <= 0:
         return f"previous value {pv} not positive; skipping comparison", False
+    from coinstac_dinunet_tpu.telemetry.doctor import regime_mismatch
+
+    mismatch = regime_mismatch(prev, last)
+    if mismatch:
+        # same refusal the doctor's verdict applies: a cross-regime pair
+        # is not a code regression signal, and silently diffing it would
+        # gate CI on a library upgrade or machine swap
+        return (f"REFUSED: {metric or 'bench'} entries span different "
+                f"regimes ({', '.join(mismatch)} changed) — re-baseline "
+                "the ledger on the current regime"), False
     drop = 1.0 - lv / pv
     unit = last.get("unit") or "samples/sec/chip"
     msg = (
@@ -105,7 +128,8 @@ def main(argv=None):
             return 2
         with open(args.history, "a", encoding="utf-8") as f:
             for entry in entries:
-                f.write(json.dumps(entry, separators=(",", ":"),
+                f.write(json.dumps(_stamp_regime(entry),
+                                   separators=(",", ":"),
                                    sort_keys=True) + "\n")
         history = _load_history(args.history)
         regressed_any, msgs = False, []

@@ -74,6 +74,19 @@ if [ "${DINULINT_WIRE:-}" = "1" ]; then
         extra+=(--reconcile "$DINULINT_WIRE_RECONCILE")
     fi
 fi
+if [ "${DINULINT_TIER7:-}" = "1" ]; then
+    # tier-7 numerics & determinism auditor: static num-* PRNG/reduction
+    # rules (pure AST), the num-accum-narrow jaxpr pass (shares tier-3's
+    # entry-build cache when combined), and the proto-num-parity
+    # bit-parity prover over the engine-equivalence contracts (numpy
+    # only, no JAX; docs/ANALYSIS.md "Tier 7").  DINULINT_TIER7_PLANS
+    # names a directory for the replayable parity plans (the CI lint job
+    # uploads it in the lint-findings artifact).
+    extra+=(--tier7)
+    if [ -n "${DINULINT_TIER7_PLANS:-}" ]; then
+        extra+=(--parity-plans "$DINULINT_TIER7_PLANS")
+    fi
+fi
 if [ "${DINULINT_TIER5:-}" = "1" ]; then
     # tier-5 concurrency auditor: static conc-* lock-discipline rules
     # (pure AST) + the proto-conc-* deterministic interleaving explorer

@@ -1,0 +1,60 @@
+"""Shared ULP-aware comparison helpers for the parity-sensitive tests.
+
+The repo pins several equivalence contracts (async k=0 vs lockstep,
+run-ahead d=0 drained vs serial, mmap views vs heap copies, daemon vs
+in-process goldens) and before tier 7 each test rolled its own
+``(a == b).all()`` / ``assert_allclose`` spelling.  These helpers wrap
+the prover's comparator (``analysis/parity.py``) so a failure always
+reports the DISTANCE in ulp — "30 ulp off" (one reordered summand) and
+"2⁵² ulp off" (a wrong tensor) are very different bugs, and a raw
+boolean assert hides which one you have.
+
+``assert_bit_identical`` is the bit-parity contract (0 ulp, same dtype);
+``assert_close`` keeps the tolerance-based contracts' semantics exactly
+(it delegates to ``np.testing.assert_allclose``) while annotating any
+failure with the max ulp distance when the dtypes admit one.
+"""
+import numpy as np
+
+from coinstac_dinunet_tpu.analysis.parity import (  # noqa: F401 (re-export)
+    max_ulp_diff,
+    tree_max_ulp,
+    ulp_diff,
+)
+
+
+def assert_bit_identical(got, want, msg=""):
+    """The bit-parity contract: same shape, same dtype, 0 ulp apart
+    (which for floats means identical bit patterns, -0.0 vs +0.0 and
+    differing NaN payloads included — they are not the same wire
+    bytes)."""
+    got, want = np.asarray(got), np.asarray(want)
+    label = f" [{msg}]" if msg else ""
+    assert got.shape == want.shape, (
+        f"shape mismatch{label}: {got.shape} vs {want.shape}"
+    )
+    assert got.dtype == want.dtype, (
+        f"dtype mismatch{label}: {got.dtype} vs {want.dtype}"
+    )
+    d = max_ulp_diff(got, want)
+    assert d == 0, (
+        f"not bit-identical{label}: max {d} ulp apart\n"
+        f"got:  {got!r}\nwant: {want!r}"
+    )
+
+
+def assert_close(got, want, rtol=1e-7, atol=0, msg=""):
+    """Tolerance-based comparison with a ulp-annotated failure: exactly
+    ``np.testing.assert_allclose`` semantics (same defaults), but the
+    error message also carries the max ulp distance so a near-miss is
+    distinguishable from a wrong answer at a glance."""
+    got, want = np.asarray(got), np.asarray(want)
+    label = f" [{msg}]" if msg else ""
+    assert got.shape == want.shape, (
+        f"shape mismatch{label}: {got.shape} vs {want.shape}"
+    )
+    err = msg
+    if got.dtype == want.dtype and got.dtype.kind == "f":
+        err = f"{msg} (max {max_ulp_diff(got, want)} ulp apart)"
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=atol,
+                               err_msg=err)

@@ -84,7 +84,7 @@ MAX_BASELINE_FINDINGS = 0
 
 REFRESH_CMD = (
     "dinulint coinstac_dinunet_tpu --tier3 --deep --model --tier5 --wire "
-    "--write-baseline --baseline dinulint_baseline.json"
+    "--tier7 --write-baseline --baseline dinulint_baseline.json"
 )
 
 
@@ -167,8 +167,19 @@ def test_baseline_ratchet_has_no_stale_suppressions():
         from coinstac_dinunet_tpu.analysis.model_check import run_model_check
 
         findings += run_model_check().findings
+    if any(e["rule"].startswith(("num-", "proto-num-")) for e in entries):
+        from coinstac_dinunet_tpu.analysis.numerics import (
+            run_accum_narrow,
+            run_tier7_static,
+        )
+        from coinstac_dinunet_tpu.analysis.parity import run_parity_prover
+
+        findings += run_tier7_static([PACKAGE])
+        findings += run_accum_narrow()
+        findings += run_parity_prover().findings
     if any(e["rule"].startswith(("perf-", "proto-", "tier3-"))
-           and not e["rule"].startswith(("proto-conc-", "proto-model-"))
+           and not e["rule"].startswith(
+               ("proto-conc-", "proto-model-", "proto-num-"))
            for e in entries):
         from coinstac_dinunet_tpu.analysis.dataflow import run_tier3
 

@@ -28,6 +28,7 @@ import sys
 import numpy as np
 import pytest
 
+from _parity import assert_bit_identical
 from coinstac_dinunet_tpu.config.keys import Live, Metric, ModelCheck
 from coinstac_dinunet_tpu.engine import InProcessEngine
 from coinstac_dinunet_tpu.nodes import COINNRemote
@@ -98,8 +99,7 @@ def test_async_k0_pool1_is_bit_identical_to_serial(tmp_path):
     for key in ("train_log", "validation_log", "test_metrics"):
         got = np.asarray(eng.remote_cache[key], np.float64)
         golden = np.asarray(serial.remote_cache[key], np.float64)
-        assert got.shape == golden.shape, key
-        assert (got == golden).all(), (key, got, golden)
+        assert_bit_identical(got, golden, msg=key)
 
 
 # ---------------------------------------------------- straggler span overlap
@@ -279,8 +279,7 @@ def test_run_ahead_pipelines_reduce_and_drain_matches_d0(tmp_path,
     assert cur_drained == cur_d0
     assert len(avg_drained) == len(avg_d0) > 0
     for a, b in zip(avg_drained, avg_d0):
-        assert np.asarray(a).shape == np.asarray(b).shape
-        assert (np.asarray(a) == np.asarray(b)).all()
+        assert_bit_identical(np.asarray(a), np.asarray(b))
 
 
 @pytest.mark.slow
@@ -547,8 +546,7 @@ def test_run_ahead_0_bit_identical_and_in_process_clamps(tmp_path):
         for key in ("train_log", "validation_log", "test_metrics"):
             got = np.asarray(eng.remote_cache[key], np.float64)
             golden = np.asarray(serial.remote_cache[key], np.float64)
-            assert got.shape == golden.shape, (tag, key)
-            assert (got == golden).all(), (tag, key)
+            assert_bit_identical(got, golden, msg=f"{tag}:{key}")
     # the process-backed engines lift the cap: run-ahead is real there
     assert SubprocessEngine._RUN_AHEAD_CAP is None
 

@@ -18,6 +18,7 @@ import textwrap
 import numpy as np
 import pytest
 
+from _parity import assert_close
 from coinstac_dinunet_tpu.config.keys import Daemon, Live
 from coinstac_dinunet_tpu.engine import InProcessEngine, InvokeTimeout
 from coinstac_dinunet_tpu.federation.daemon import (
@@ -445,8 +446,7 @@ def test_daemon_run_matches_in_process_and_reuses_workers(
 
         for key, golden in inproc_golden.items():
             got = np.asarray(eng.remote_cache[key], np.float64)
-            assert got.shape == golden.shape, (key, got, golden)
-            np.testing.assert_allclose(got, golden, atol=2e-3, err_msg=key)
+            assert_close(got, golden, atol=2e-3, msg=key)
     finally:
         eng.close()
 
@@ -498,7 +498,7 @@ def test_chaos_worker_kill_drill_survives_via_restart(
 
         for key, golden in inproc_golden.items():
             got = np.asarray(eng.remote_cache[key], np.float64)
-            np.testing.assert_allclose(got, golden, atol=2e-3, err_msg=key)
+            assert_close(got, golden, atol=2e-3, msg=key)
     finally:
         eng.close()
 

@@ -17,6 +17,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from _parity import assert_close
 from coinstac_dinunet_tpu.config.keys import Federation
 from coinstac_dinunet_tpu.engine import InProcessEngine, MeshEngine
 from coinstac_dinunet_tpu.federation import (
@@ -83,11 +84,10 @@ def test_vectorized_engine_matches_file_and_mesh_transports(tmp_path):
 
     got, mesh, want = _logs(ve.cache), _logs(me.cache), _logs(fe.remote_cache)
     for key in want:
-        assert want[key].shape == got[key].shape, key
-        np.testing.assert_allclose(got[key], want[key], atol=2e-3,
-                                   err_msg=f"file vs vectorized: {key}")
-        np.testing.assert_allclose(got[key], mesh[key], atol=2e-3,
-                                   err_msg=f"mesh vs vectorized: {key}")
+        assert_close(got[key], want[key], atol=2e-3,
+                     msg=f"file vs vectorized: {key}")
+        assert_close(got[key], mesh[key], atol=2e-3,
+                     msg=f"mesh vs vectorized: {key}")
 
 
 def test_vectorized_roster_larger_than_device_count(tmp_path):
@@ -345,8 +345,7 @@ def test_tree_reduce_golden_equality_on_chaos_acceptance_run(tmp_path):
     for key in ("train_log", "validation_log", "test_metrics"):
         a = np.asarray(tree.remote_cache[key], np.float64)
         b = np.asarray(flat.remote_cache[key], np.float64)
-        assert a.shape == b.shape, key
-        np.testing.assert_allclose(a, b, atol=1e-6, err_msg=key)
+        assert_close(a, b, atol=1e-6, msg=key)
 
 
 # ------------------------------------------------- quorum normalization fix

@@ -356,6 +356,54 @@ def test_bench_history_append_and_regression(tmp_path):
     # within-threshold history produces no bench verdict
     report = build_report([], bench_history=entries[:2])
     assert report["bench"]["regressed"] is False
+    # the appender stamped each line with the measurement regime
+    assert all(isinstance(e.get("regime"), dict) for e in entries)
+    assert all(e["regime"].get("numpy") for e in entries)
+
+
+def test_bench_regression_refuses_cross_regime_pairs(tmp_path):
+    """ISSUE 17 satellite: a ledger pair measured under different regimes
+    (jax/numpy version, platform, seed) is REFUSED by the regression
+    verdict — never silently diffed — and the refusal itself surfaces as
+    a ranked verdict + markdown state."""
+    from coinstac_dinunet_tpu.telemetry.doctor import bench_regime
+
+    regime = bench_regime(seed=11)
+    prev = {"metric": "m", "value": 100.0, "unit": "rounds/sec",
+            "regime": dict(regime)}
+    last = {"metric": "m", "value": 40.0, "unit": "rounds/sec",
+            "regime": dict(regime, jax="999.0.0")}
+    report = build_report([], bench_history=[prev, last])
+    bench = report["bench"]
+    assert bench["refused"] is True and bench["refused_keys"] == ["jax"]
+    assert bench["regressed"] is False  # refused, not regressed
+    causes = [v["cause"] for v in report["verdicts"]]
+    assert any("cross-regime" in c for c in causes)
+    assert not any("regressed" in c for c in causes)
+    md = render_markdown(report)
+    assert "REFUSED" in md and "jax changed" in md
+
+    # same-regime pairs still regress exactly as before
+    last_same = dict(last, regime=dict(regime))
+    report = build_report([], bench_history=[prev, last_same])
+    assert report["bench"]["regressed"] is True
+    # an UNSTAMPED side stays comparable (pre-regime ledger lines)
+    report = build_report([], bench_history=[{"metric": "m", "value": 100.0},
+                                             last])
+    assert report["bench"]["regressed"] is True
+
+    # the standalone CI gate refuses the same way
+    script = os.path.join(REPO, "scripts", "bench_history.py")
+    hist = tmp_path / "h.jsonl"
+    with open(str(hist), "w", encoding="utf-8") as f:
+        f.write(json.dumps(prev) + "\n")
+        f.write(json.dumps(last) + "\n")
+    chk = subprocess.run(
+        [sys.executable, script, "check", "--history", str(hist)],
+        text=True, capture_output=True,
+    )
+    assert chk.returncode == 0, chk.stderr
+    assert "REFUSED" in chk.stdout and "jax changed" in chk.stdout
 
 
 # -------------------------------------------------------------- lint fixtures

@@ -66,6 +66,8 @@ def test_load_arrays_mmap_zero_copy_and_crc(tmp_path):
         load_arrays_many,
     )
 
+    from _parity import assert_bit_identical
+
     p = str(tmp_path / "grads.npy")
     arrays = [np.random.randn(64, 8).astype(np.float32),
               np.arange(11, dtype=np.int64)]
@@ -73,16 +75,15 @@ def test_load_arrays_mmap_zero_copy_and_crc(tmp_path):
     heap = load_arrays(p)
     mapped = load_arrays(p, mmap=True)
     for a, b, c in zip(arrays, heap, mapped):
-        np.testing.assert_array_equal(a, b)
-        np.testing.assert_array_equal(a, c)
-        assert a.dtype == c.dtype
+        assert_bit_identical(b, a, msg="heap vs saved")
+        assert_bit_identical(c, a, msg="mmap vs saved")
     # views into the map, not heap copies: read-only with a buffer base
     assert not mapped[0].flags.writeable
     assert mapped[0].base is not None
 
     many = load_arrays_many([p, p], mmap=True)
-    np.testing.assert_array_equal(many[0][0], arrays[0])
-    np.testing.assert_array_equal(many[1][1], arrays[1])
+    assert_bit_identical(many[0][0], arrays[0])
+    assert_bit_identical(many[1][1], arrays[1])
 
     # bit-flip inside the data section -> WireCorruption over the view
     corrupt = str(tmp_path / "bad.npy")
